@@ -1,0 +1,27 @@
+"""keystone_tpu — a TPU-native ML pipeline framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of KeystoneML
+(the reference at /root/reference): declaratively chained featurization +
+solver pipelines over a whole-pipeline optimizer, executing as sharded XLA
+computations on TPU device meshes instead of Spark RDD jobs.
+"""
+
+__version__ = "0.1.0"
+
+from .data.dataset import ArrayDataset, Dataset, ObjectDataset
+from .workflow import (
+    Estimator,
+    FittedPipeline,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    PipelineEnv,
+    Transformer,
+)
+
+__all__ = [
+    "ArrayDataset", "Dataset", "ObjectDataset",
+    "Transformer", "Estimator", "LabelEstimator",
+    "Pipeline", "FittedPipeline", "Identity", "PipelineEnv",
+    "__version__",
+]

@@ -1,0 +1,27 @@
+"""Test configuration: virtual 8-device CPU mesh + per-test env reset.
+
+Mirrors the reference's test strategy of standing in for a cluster with
+local-mode partitions (reference: src/test/scala/keystoneml/workflow/
+PipelineContext.scala:9-25): here, N virtual CPU devices via
+``--xla_force_host_platform_device_count`` stand in for a TPU slice, and
+the process-wide PipelineEnv is reset after every test.
+"""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_pipeline_env():
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    PipelineEnv.reset()
+    yield
+    PipelineEnv.reset()

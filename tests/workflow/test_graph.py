@@ -1,0 +1,118 @@
+"""Graph IR surgery invariants (reference: workflow/GraphSuite.scala)."""
+
+import pytest
+
+from keystone_tpu.workflow.graph import Graph, NodeId, SinkId, SourceId
+from keystone_tpu.workflow.operators import TransformerOperator
+from keystone_tpu.workflow import analysis
+
+
+class Op(TransformerOperator):
+    def __init__(self, name):
+        self.name = name
+
+    @property
+    def label(self):
+        return self.name
+
+    def single_transform(self, datums):
+        return datums[0]
+
+
+def simple_graph():
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(Op("a"), [src])
+    g, b = g.add_node(Op("b"), [a])
+    g, sink = g.add_sink(b)
+    return g, src, a, b, sink
+
+
+def test_add_node_and_sink():
+    g, src, a, b, sink = simple_graph()
+    assert g.sources == {src}
+    assert g.nodes == {a, b}
+    assert g.get_sink_dependency(sink) == b
+    assert g.get_dependencies(b) == (a,)
+
+
+def test_ids_are_unique():
+    g, src, a, b, sink = simple_graph()
+    ids = {src.id, a.id, b.id, sink.id}
+    assert len(ids) == 4
+
+
+def test_remove_referenced_node_fails():
+    g, src, a, b, sink = simple_graph()
+    with pytest.raises(ValueError):
+        g.remove_node(a)  # b depends on a
+    with pytest.raises(ValueError):
+        g.remove_source(src)  # a depends on src
+
+
+def test_remove_after_redirect():
+    g, src, a, b, sink = simple_graph()
+    g = g.replace_dependency(a, src)
+    g = g.remove_node(a)
+    assert g.nodes == {b}
+    assert g.get_dependencies(b) == (src,)
+
+
+def test_replace_dependency_affects_sinks():
+    g, src, a, b, sink = simple_graph()
+    g = g.replace_dependency(b, a)
+    assert g.get_sink_dependency(sink) == a
+
+
+def test_add_graph_remaps_ids_disjointly():
+    g1, src1, a1, b1, sink1 = simple_graph()
+    g2, src2, a2, b2, sink2 = simple_graph()
+    combined, source_map, sink_map = g1.add_graph(g2)
+    assert len(combined.nodes) == 4
+    assert len(combined.sources) == 2
+    assert len(combined.sinks) == 2
+    assert source_map[src2] != src1
+    # original graph untouched
+    assert len(g1.nodes) == 2
+
+
+def test_connect_graph_splices():
+    g1, src1, a1, b1, sink1 = simple_graph()
+    g2, src2, c, d, sink2 = simple_graph()
+    combined, source_map, sink_map = g1.connect_graph(g2, {src2: sink1})
+    # spliced source and sink are gone
+    assert len(combined.sources) == 1
+    assert len(combined.sinks) == 1
+    # g2's first node now depends on g1's last node
+    new_sink = sink_map[sink2]
+    order = analysis.linearize(combined, new_sink)
+    assert order[0] == src1
+    assert len([v for v in order if isinstance(v, NodeId)]) == 4
+
+
+def test_operator_update():
+    g, src, a, b, sink = simple_graph()
+    new_op = Op("z")
+    g = g.set_operator(a, new_op)
+    assert g.get_operator(a) is new_op
+
+
+def test_dot_export_contains_all_vertices():
+    g, src, a, b, sink = simple_graph()
+    dot = g.to_dot()
+    for vid in [src, a, b, sink]:
+        assert repr(vid) in dot
+
+
+def test_analysis_ancestors_descendants():
+    g, src, a, b, sink = simple_graph()
+    assert analysis.get_ancestors(g, sink) == {src, a, b}
+    assert analysis.get_descendants(g, src) == {a, b, sink}
+    assert analysis.get_children(g, a) == {b}
+    assert analysis.get_parents(g, b) == [a]
+
+
+def test_linearize_is_topological():
+    g, src, a, b, sink = simple_graph()
+    order = analysis.linearize(g, sink)
+    assert order.index(src) < order.index(a) < order.index(b) < order.index(sink)

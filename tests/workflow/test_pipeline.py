@@ -1,0 +1,138 @@
+"""Pipeline semantics: laziness, chaining, fit-once, gather, FittedPipeline.
+
+Mirrors the reference's behavioral contract
+(reference: workflow/PipelineSuite.scala:28-52 "Do not fit estimators
+multiple times", EstimatorSuite.scala, LabelEstimatorSuite.scala).
+"""
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ObjectDataset
+from keystone_tpu.workflow import (
+    Estimator,
+    FittedPipeline,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    Transformer,
+)
+
+
+class Plus(Transformer):
+    def __init__(self, k):
+        self.k = k
+
+    def apply(self, x):
+        return x + self.k
+
+
+class CountingEstimator(Estimator):
+    """Fits a transformer adding the dataset mean; counts fit calls."""
+
+    def __init__(self):
+        self.fit_count = 0
+
+    def fit(self, data):
+        self.fit_count += 1
+        mean = float(np.mean(data.collect()))
+        return Plus(mean)
+
+
+class CountingLabelEstimator(LabelEstimator):
+    def __init__(self):
+        self.fit_count = 0
+
+    def fit(self, data, labels):
+        self.fit_count += 1
+        offset = float(np.mean(labels.collect())) - float(np.mean(data.collect()))
+        return Plus(offset)
+
+
+def test_transformer_single_and_batch():
+    t = Plus(2)
+    assert t(3) == 5
+    out = t(ObjectDataset([1, 2, 3])).get()
+    assert out.collect() == [3, 4, 5]
+
+
+def test_chaining():
+    pipe = Plus(1) >> Plus(10)
+    assert pipe(1).get() == 12
+    assert pipe(ObjectDataset([0, 5])).get().collect() == [11, 16]
+
+
+def test_estimator_with_data():
+    est = CountingEstimator()
+    data = ObjectDataset([1.0, 2.0, 3.0])  # mean 2
+    pipe = est.with_data(data)
+    assert pipe(10.0).get() == 12.0
+    assert est.fit_count == 1
+
+
+def test_laziness_no_fit_until_forced():
+    est = CountingEstimator()
+    pipe = est.with_data(ObjectDataset([1.0, 3.0]))
+    result = pipe(0.0)
+    assert est.fit_count == 0  # nothing forced yet
+    result.get()
+    assert est.fit_count == 1
+
+
+def test_fit_once_across_applications():
+    """reference: PipelineSuite.scala:28-52"""
+    est = CountingEstimator()
+    pipe = est.with_data(ObjectDataset([2.0, 4.0]))  # mean 3
+    assert pipe(1.0).get() == 4.0
+    assert pipe(2.0).get() == 5.0
+    assert pipe(ObjectDataset([0.0])).get().collect() == [3.0]
+    assert est.fit_count == 1
+
+
+def test_then_estimator():
+    est = CountingEstimator()
+    data = ObjectDataset([0.0, 2.0])
+    pipe = Plus(1).then_estimator(est, data)  # est fits on [1,3]: mean 2
+    assert pipe(0.0).get() == 3.0  # 0 +1 +2
+    assert est.fit_count == 1
+
+
+def test_then_label_estimator():
+    lest = CountingLabelEstimator()
+    data = ObjectDataset([1.0, 3.0])    # mean 2 after Plus(0)=identity path
+    labels = ObjectDataset([11.0, 13.0])  # mean 12 -> offset 10
+    pipe = Identity().then_label_estimator(lest, data, labels)
+    assert pipe(5.0).get() == 15.0
+    assert lest.fit_count == 1
+
+
+def test_gather():
+    pipe = Pipeline.gather([Plus(1), Plus(2), Plus(3)])
+    assert pipe(10).get() == [11, 12, 13]
+    batch = pipe(ObjectDataset([0, 10])).get().collect()
+    assert batch == [[1, 2, 3], [11, 12, 13]]
+
+
+def test_fit_produces_estimator_free_pipeline(tmp_path):
+    est = CountingEstimator()
+    pipe = Plus(1) >> est.with_data(ObjectDataset([2.0, 4.0]))  # mean 3
+    fitted = pipe.fit()
+    assert isinstance(fitted, FittedPipeline)
+    assert est.fit_count == 1
+    assert fitted.apply(0.0) == 4.0
+    # fitting again or applying repeatedly never re-fits
+    assert fitted.apply(1.0) == 5.0
+    assert est.fit_count == 1
+    # round-trips through pickle
+    path = str(tmp_path / "pipe.pkl")
+    fitted.save(path)
+    loaded = FittedPipeline.load(path)
+    assert loaded.apply(0.0) == 4.0
+
+
+def test_fitted_pipeline_composes():
+    est = CountingEstimator()
+    # est fits on the raw bound data [0.0] (mean 0); the upstream Plus(1)
+    # only feeds the apply-time path.
+    fitted = (Plus(1) >> est.with_data(ObjectDataset([0.0]))).fit()
+    pipe2 = fitted >> Plus(100)
+    assert pipe2(0.0).get() == 101.0

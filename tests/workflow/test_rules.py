@@ -1,0 +1,103 @@
+"""Optimizer rule tests (reference: workflow/EquivalentNodeMergeRule,
+UnusedBranchRemovalRule, SavedStateLoadRule suites)."""
+
+from keystone_tpu.data.dataset import ObjectDataset
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator, Expression, ExpressionOperator
+from keystone_tpu.workflow.rules import (
+    EquivalentNodeMergeRule,
+    SavedStateLoadRule,
+    UnusedBranchRemovalRule,
+)
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.prefix import find_prefix
+from tests.workflow.test_graph import Op
+
+
+def test_cse_merges_equal_nodes():
+    op = Op("same")  # same instance → equal
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(op, [src])
+    g, b = g.add_node(op, [src])
+    g, s1 = g.add_sink(a)
+    g, s2 = g.add_sink(b)
+    merged, _ = EquivalentNodeMergeRule().apply(g, {})
+    assert len(merged.nodes) == 1
+    assert merged.get_sink_dependency(s1) == merged.get_sink_dependency(s2)
+
+
+def test_cse_merges_chains_to_fixed_point():
+    op1, op2 = Op("x"), Op("y")
+    g = Graph()
+    g, src = g.add_source()
+    g, a1 = g.add_node(op1, [src])
+    g, a2 = g.add_node(op1, [src])
+    g, b1 = g.add_node(op2, [a1])
+    g, b2 = g.add_node(op2, [a2])
+    g, s1 = g.add_sink(b1)
+    g, s2 = g.add_sink(b2)
+    merged, _ = EquivalentNodeMergeRule().apply(g, {})
+    assert len(merged.nodes) == 2
+
+
+def test_cse_does_not_merge_different_ops():
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(Op("x"), [src])
+    g, b = g.add_node(Op("x"), [src])  # different instances: not equal
+    g, s1 = g.add_sink(a)
+    g, s2 = g.add_sink(b)
+    merged, _ = EquivalentNodeMergeRule().apply(g, {})
+    assert len(merged.nodes) == 2
+
+
+def test_unused_branch_removal():
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(Op("live"), [src])
+    g, dead1 = g.add_node(Op("dead1"), [src])
+    g, dead2 = g.add_node(Op("dead2"), [dead1])
+    g, sink = g.add_sink(a)
+    pruned, _ = UnusedBranchRemovalRule().apply(g, {})
+    assert pruned.nodes == {a}
+
+
+def test_prefix_none_with_unbound_source():
+    g = Graph()
+    g, src = g.add_source()
+    g, a = g.add_node(Op("a"), [src])
+    assert find_prefix(g, a) is None
+
+
+def test_prefix_equality_across_graphs():
+    op = Op("a")
+    ds = ObjectDataset([1, 2])
+    dop1, dop2 = DatasetOperator(ds), DatasetOperator(ds)
+
+    g1 = Graph()
+    g1, d1 = g1.add_node(dop1, [])
+    g1, a1 = g1.add_node(op, [d1])
+
+    g2 = Graph()
+    g2, d2 = g2.add_node(dop2, [])
+    g2, a2 = g2.add_node(op, [d2])
+
+    assert find_prefix(g1, a1) == find_prefix(g2, a2)
+
+
+def test_saved_state_load_splices_expression():
+    op = Op("a")
+    ds = ObjectDataset([1, 2])
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(ds), [])
+    g, a = g.add_node(op, [d])
+    g, sink = g.add_sink(a)
+    prefix = find_prefix(g, a)
+
+    stored = Expression.of("stored-result")
+    PipelineEnv.get_or_create().state[prefix] = stored
+    new_graph, prefixes = SavedStateLoadRule().apply(g, {a: prefix})
+    assert isinstance(new_graph.get_operator(a), ExpressionOperator)
+    assert new_graph.get_dependencies(a) == ()
+    assert a not in prefixes
