@@ -1,0 +1,103 @@
+"""Device-mesh management.
+
+The reference discovers cluster topology through Spark
+(``getExecutorStorageStatus`` for machine counts / memory budgets,
+reference: nodes/learning/LeastSquaresEstimator.scala:70-75,
+workflow/AutoCacheRule.scala:572-585). The TPU equivalent is a
+``jax.sharding.Mesh`` over ``jax.devices()`` plus per-device HBM
+accounting.
+
+Axis conventions used throughout the framework:
+
+- ``data``  — example (row) sharding; every featurizer and every solver's
+  Gram/gradient accumulation is data-parallel over this axis.
+- ``model`` — feature/class (column) sharding for block solvers (the
+  reference's ``VectorSplitter`` feature-block parallelism re-designed as a
+  real mesh axis).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_current_mesh: Optional[Mesh] = None
+
+
+def make_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = (DATA_AXIS,),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh over the available devices.
+
+    With no arguments: a 1-D ``data`` mesh over every device.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    if math.prod(shape) != len(devices):
+        raise ValueError(f"mesh shape {shape} does not cover {len(devices)} devices")
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def get_mesh() -> Mesh:
+    """The active mesh (a default 1-D data mesh if none was set)."""
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = make_mesh()
+    return _current_mesh
+
+
+def set_mesh(mesh: Optional[Mesh]) -> None:
+    global _current_mesh
+    _current_mesh = mesh
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    global _current_mesh
+    prev = _current_mesh
+    _current_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _current_mesh = prev
+
+
+def data_axis_size(mesh: Optional[Mesh] = None) -> int:
+    mesh = mesh or get_mesh()
+    return mesh.shape.get(DATA_AXIS, 1)
+
+
+def num_devices() -> int:
+    return len(jax.devices())
+
+
+def device_memory_budget_bytes(fraction: float = 0.75) -> int:
+    """Per-device memory budget for residency planning.
+
+    Analog of the reference's 75%-of-cluster-free-memory default cache
+    budget (reference: workflow/AutoCacheRule.scala:572-585). Falls back to
+    a conservative constant when the platform exposes no memory stats
+    (CPU test meshes).
+    """
+    dev = jax.devices()[0]
+    try:
+        stats = dev.memory_stats()
+        if stats and "bytes_limit" in stats:
+            in_use = stats.get("bytes_in_use", 0)
+            return int((stats["bytes_limit"] - in_use) * fraction)
+    except Exception:
+        pass
+    return int(4e9 * fraction)

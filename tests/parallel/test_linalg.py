@@ -1,0 +1,121 @@
+"""Distributed linalg vs local numpy golden values, on an 8-device CPU mesh
+(the reference's local-partitions-stand-in-for-cluster strategy)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.parallel import linalg
+from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def rand(shape, seed=0):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def test_mesh_has_8_devices(mesh):
+    assert len(jax.devices()) == 8
+    assert mesh.shape["data"] == 8
+
+
+def test_gram(mesh):
+    a = rand((64, 12))
+    b = rand((64, 3), seed=1)
+    with use_mesh(mesh):
+        A = linalg.prepare_row_sharded(a)
+        B = linalg.prepare_row_sharded(b)
+        ata, atb = linalg.gram(A, B)
+    np.testing.assert_allclose(np.asarray(ata), a.T @ a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(atb), a.T @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_gram_with_padding(mesh):
+    a = rand((61, 5))  # 61 not divisible by 8 → zero-padded
+    with use_mesh(mesh):
+        A = linalg.prepare_row_sharded(a)
+        assert A.shape[0] == 64
+        ata, _ = linalg.gram(A)
+    np.testing.assert_allclose(np.asarray(ata), a.T @ a, rtol=1e-4, atol=1e-4)
+
+
+def test_normal_equations_solve(mesh):
+    a = rand((128, 10))
+    x_true = rand((10, 4), seed=2)
+    b = a @ x_true
+    with use_mesh(mesh):
+        A = linalg.prepare_row_sharded(a)
+        B = linalg.prepare_row_sharded(b)
+        x = linalg.normal_equations_solve(A, B, reg=0.0)
+    np.testing.assert_allclose(np.asarray(x), x_true, rtol=1e-2, atol=1e-3)
+
+
+def test_ridge_matches_closed_form(mesh):
+    a = rand((96, 8))
+    b = rand((96, 2), seed=3)
+    lam = 0.5
+    expected = np.linalg.solve(a.T @ a + lam * np.eye(8), a.T @ b)
+    with use_mesh(mesh):
+        x = linalg.normal_equations_solve(
+            linalg.prepare_row_sharded(a), linalg.prepare_row_sharded(b), reg=lam
+        )
+    np.testing.assert_allclose(np.asarray(x), expected, rtol=1e-3, atol=1e-3)
+
+
+def test_tsqr_r_gram_identity(mesh):
+    """RᵀR must equal AᵀA (QR correctness without fixing R's sign)."""
+    a = rand((80, 6))
+    with use_mesh(mesh):
+        r = linalg.tsqr_r(linalg.prepare_row_sharded(a))
+    np.testing.assert_allclose(np.asarray(r.T @ r), a.T @ a, rtol=1e-3, atol=1e-3)
+
+
+def test_tsqr_svd_matches_local(mesh):
+    a = rand((120, 7))
+    _, s_expected, vt_expected = np.linalg.svd(a, full_matrices=False)
+    with use_mesh(mesh):
+        s, vt = linalg.tsqr_svd(linalg.prepare_row_sharded(a))
+    np.testing.assert_allclose(np.asarray(s), s_expected, rtol=1e-3, atol=1e-3)
+    # columns defined up to sign
+    for i in range(7):
+        vi, wi = np.asarray(vt)[i], vt_expected[i]
+        assert min(np.linalg.norm(vi - wi), np.linalg.norm(vi + wi)) < 1e-2
+
+
+def test_bcd_converges_to_ridge_solution(mesh):
+    a = rand((160, 12))
+    x_true = rand((12, 3), seed=5)
+    y = a @ x_true
+    lam = 0.1
+    expected = np.linalg.solve(a.T @ a + lam * np.eye(12), a.T @ y)
+    with use_mesh(mesh):
+        w = linalg.block_coordinate_descent(
+            linalg.prepare_row_sharded(a),
+            linalg.prepare_row_sharded(y),
+            reg=lam,
+            num_epochs=30,
+            block_size=4,
+        )
+    np.testing.assert_allclose(np.asarray(w), expected, rtol=5e-2, atol=5e-3)
+
+
+def test_bcd_single_block_equals_exact(mesh):
+    """One epoch, one block == exact normal-equation solve."""
+    a = rand((64, 6))
+    y = rand((64, 2), seed=7)
+    lam = 0.3
+    expected = np.linalg.solve(a.T @ a + lam * np.eye(6), a.T @ y)
+    with use_mesh(mesh):
+        w = linalg.block_coordinate_descent(
+            linalg.prepare_row_sharded(a),
+            linalg.prepare_row_sharded(y),
+            reg=lam,
+            num_epochs=1,
+            block_size=6,
+        )
+    np.testing.assert_allclose(np.asarray(w), expected, rtol=1e-3, atol=1e-3)
