@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Headline benchmark: TIMIT-shape exact least-squares fit on one chip.
+
+Reference baseline (BASELINE.md): the reference's solver-comparison table
+measures the Exact (normal-equations) solver on TIMIT — n=2.2M, d=1024,
+k=138, dense — at 7,323 ms on a 16-machine r3.4xlarge Spark cluster
+(reference: scripts/solver-comparisons-final.csv:14).
+
+This benchmark runs the same-shape problem through keystone_tpu's
+LinearMapEstimator fit path (sharded Gram over the mesh + centered normal
+equations + Cholesky) on the available accelerator and prints one JSON
+line. vs_baseline > 1 means faster than the 16-node reference cluster.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    on_accelerator = platform not in ("cpu",)
+
+    # TIMIT shape (reference: scripts/constantEstimator.R:33-36).
+    n, d, k = (2_200_000, 1024, 138) if on_accelerator else (100_000, 256, 32)
+    baseline_ms = 7_323.0  # 16-node Spark cluster, Exact solver, d=1024
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+    from keystone_tpu.parallel.mesh import get_mesh
+
+    mesh = get_mesh()
+    ndev = mesh.devices.size
+    n -= n % ndev  # keep rows divisible by the data axis
+
+    key = jax.random.PRNGKey(0)
+    ka, kb = jax.random.split(key)
+    x = jax.random.normal(ka, (n, d), dtype=jnp.float32)
+    y = jax.random.normal(kb, (n, k), dtype=jnp.float32)
+    jax.block_until_ready((x, y))
+
+    features, labels = ArrayDataset(x), ArrayDataset(y)
+    est = LinearMapEstimator(reg=1e-2)
+
+    def force(model):
+        # Materialize a scalar derived from the weights: robust against
+        # backends where block_until_ready does not force execution.
+        return float(jnp.sum(model.weights))
+
+    # Warm-up compiles everything; then measure steady-state fit.
+    force(est.fit(features, labels))
+
+    times = []
+    for _ in range(3):
+        start = time.perf_counter()
+        force(est.fit(features, labels))
+        times.append((time.perf_counter() - start) * 1000.0)
+    ms = float(np.median(times))
+
+    scale = 1.0
+    if not on_accelerator:  # extrapolate the smaller CPU problem linearly
+        scale = (2_200_000 / n) * (1024 / d) ** 2
+
+    print(
+        json.dumps(
+            {
+                "metric": "timit_exact_lstsq_fit_ms_n2.2M_d1024_k138",
+                "value": round(ms * scale, 2),
+                "unit": "ms",
+                "vs_baseline": round(baseline_ms / (ms * scale), 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

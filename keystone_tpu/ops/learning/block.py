@@ -1,0 +1,115 @@
+"""Block least-squares solvers (feature-block coordinate descent).
+
+TPU-native re-design of the reference's block solver
+(reference: nodes/learning/BlockLinearMapper.scala:22-283): features are
+split into blocks (``VectorSplitter``), per-block mean-centering is
+applied, and block coordinate descent minimizes ‖AW − Y‖² + λ‖W‖².
+
+The reference materializes each block as its own RDD and treeReduces
+per-block Grams to the driver; here the whole epoch×block loop is one
+compiled XLA computation over the row-sharded feature matrix
+(``parallel.linalg.block_coordinate_descent``) — block slicing is a
+``dynamic_slice`` on the device-resident array, and per-block Gram sums
+are one psum over ICI each.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...data.dataset import ArrayDataset, Dataset
+from ...parallel import linalg
+from ...parallel.mesh import get_mesh
+from ...workflow.pipeline import BatchTransformer, LabelEstimator
+from ..stats.core import _as_array_dataset
+
+
+class BlockLinearMapper(BatchTransformer):
+    """Apply a block-solved linear model: (x − μ_A)·W + b.
+
+    Equivalent to applying each feature-block's weights and summing the
+    partial predictions (reference: BlockLinearMapper.scala:50-73); on TPU
+    one fused matmul over the concatenated blocks is strictly better.
+    """
+
+    def __init__(
+        self,
+        weights: jnp.ndarray,  # (d_padded, k)
+        block_size: int,
+        intercept: Optional[jnp.ndarray] = None,
+        feature_mean: Optional[jnp.ndarray] = None,  # (d,)
+    ):
+        self.weights = jnp.asarray(weights)
+        self.block_size = block_size
+        self.intercept = None if intercept is None else jnp.asarray(intercept)
+        self.feature_mean = None if feature_mean is None else jnp.asarray(feature_mean)
+
+    def apply_arrays(self, x):
+        d = x.shape[-1]
+        if self.feature_mean is not None:
+            x = x - self.feature_mean
+        w = self.weights[:d]  # drop padded feature rows
+        out = linalg.mm(x, w)
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+
+class BlockLeastSquaresEstimator(LabelEstimator):
+    """Feature-block coordinate-descent least squares
+    (reference: BlockLinearMapper.scala:199-283 BlockLeastSquaresEstimator).
+
+    ``num_iter`` full epochs over the feature blocks; λ is applied per
+    block. The node is weighted for the auto-cache planner the same way the
+    reference weights it: 3·num_iter + 1 passes over the data.
+    """
+
+    def __init__(self, block_size: int, num_iter: int = 1, reg: float = 0.0):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.reg = reg
+
+    @property
+    def weight(self) -> int:
+        return 3 * self.num_iter + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        mesh = get_mesh()
+
+        x = jnp.asarray(features.data, dtype=jnp.float32)
+        y = jnp.asarray(targets.data, dtype=jnp.float32)
+        n = features.num_examples
+        d = x.shape[1]
+        mask = features.mask().reshape(-1, 1)
+
+        mu_a = jnp.sum(x * mask, axis=0) / n
+        mu_b = jnp.sum(y * mask, axis=0) / n
+        xc = (x - mu_a) * mask
+        yc = (y - mu_b) * mask
+
+        # Pad the feature dim to a whole number of blocks (zero columns are
+        # inert: their Gram rows/cols are zero and λ keeps the solve PD).
+        block = min(self.block_size, _round_up(d, 1))
+        d_pad = _round_up(d, block)
+        if d_pad != d:
+            xc = jnp.pad(xc, ((0, 0), (0, d_pad - d)))
+
+        xc = linalg.prepare_row_sharded(xc, mesh)
+        yc = linalg.prepare_row_sharded(yc, mesh)
+        reg = self.reg if self.reg > 0 else 1e-6  # keep padded blocks PD
+        w = linalg.block_coordinate_descent(
+            xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
+        )
+        return BlockLinearMapper(
+            w, block_size=block, intercept=mu_b, feature_mean=mu_a
+        )
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m

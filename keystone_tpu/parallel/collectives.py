@@ -26,10 +26,14 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
+try:  # jax >= 0.4.35 exposes shard_map at top level (kwarg: check_vma)
     from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
+
+    _CHECK_KWARG = "check_vma"
+except ImportError:  # pragma: no cover - older jax (kwarg: check_rep)
     from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KWARG = "check_rep"
 
 from .mesh import DATA_AXIS, get_mesh
 
@@ -37,8 +41,8 @@ from .mesh import DATA_AXIS, get_mesh
 def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=False):
     """Thin wrapper pinning this framework's defaults."""
     mesh = mesh or get_mesh()
-    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_vma=check_vma)
+    kwargs = {_CHECK_KWARG: check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
 
 
 def allreduce_sum(x: jnp.ndarray, axis: str = DATA_AXIS) -> jnp.ndarray:

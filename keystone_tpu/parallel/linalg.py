@@ -44,7 +44,17 @@ def mm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 def _row_sharded(mesh: Mesh, a: jnp.ndarray) -> jnp.ndarray:
     spec = P(DATA_AXIS, *([None] * (a.ndim - 1)))
-    return jax.device_put(a, NamedSharding(mesh, spec))
+    target = NamedSharding(mesh, spec)
+    current = getattr(a, "sharding", None)
+    # Skip the placement when the array is already laid out correctly —
+    # a redundant device_put of a multi-GB matrix is pure HBM traffic.
+    if current is not None:
+        try:
+            if current.is_equivalent_to(target, a.ndim):
+                return a
+        except Exception:
+            pass
+    return jax.device_put(a, target)
 
 
 def _pad_rows(a: np.ndarray, multiple: int) -> jnp.ndarray:
@@ -65,6 +75,55 @@ def prepare_row_sharded(a, mesh: Optional[Mesh] = None) -> jnp.ndarray:
 # ------------------------------------------------------------------ gram/solve
 
 
+# Compiled-function caches: shard_map closures are rebuilt per call site,
+# which would defeat jax.jit's cache and recompile on every invocation —
+# a multi-second tax per solver call. Cache keyed on (mesh, static config).
+
+
+@functools.lru_cache(maxsize=None)
+def _gram_fn(mesh: Mesh):
+    def f(a_local):
+        return lax.psum(mm(a_local.T, a_local), DATA_AXIS)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS, None), out_specs=P()))
+
+
+@functools.lru_cache(maxsize=None)
+def _gram2_fn(mesh: Mesh):
+    def f2(a_local, b_local):
+        ata = lax.psum(mm(a_local.T, a_local), DATA_AXIS)
+        atb = lax.psum(mm(a_local.T, b_local), DATA_AXIS)
+        return ata, atb
+
+    return jax.jit(
+        shard_map(
+            f2,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+            out_specs=(P(), P()),
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _gram_with_sums_fn(mesh: Mesh):
+    def f(a_local, b_local):
+        ata = lax.psum(mm(a_local.T, a_local), DATA_AXIS)
+        atb = lax.psum(mm(a_local.T, b_local), DATA_AXIS)
+        sa = lax.psum(jnp.sum(a_local, axis=0), DATA_AXIS)
+        sb = lax.psum(jnp.sum(b_local, axis=0), DATA_AXIS)
+        return ata, atb, sa, sb
+
+    return jax.jit(
+        shard_map(
+            f,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+            out_specs=(P(), P(), P(), P()),
+        )
+    )
+
+
 def gram(
     a: jnp.ndarray,
     b: Optional[jnp.ndarray] = None,
@@ -76,31 +135,39 @@ def gram(
     (Replaces mlmatrix ``NormalEquations``' treeReduce of partition Grams.)
     """
     mesh = mesh or get_mesh()
-
     if b is None:
-        def f(a_local):
-            return lax.psum(mm(a_local.T, a_local), DATA_AXIS)
-
-        fn = shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS, None), out_specs=P())
-        return jax.jit(fn)(a), None
-
-    def f2(a_local, b_local):
-        ata = lax.psum(mm(a_local.T, a_local), DATA_AXIS)
-        atb = lax.psum(mm(a_local.T, b_local), DATA_AXIS)
-        return ata, atb
-
-    fn = shard_map(
-        f2, mesh=mesh, in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)), out_specs=(P(), P())
-    )
-    return jax.jit(fn)(a, b)
+        return _gram_fn(mesh)(a), None
+    return _gram2_fn(mesh)(a, b)
 
 
-def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg: float = 0.0) -> jnp.ndarray:
-    """Solve (AᵀA + reg·I) x = Aᵀb by Cholesky (the reference's local solve)."""
+def gram_with_sums(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One pass producing AᵀA, AᵀB, Σa_i, Σb_i.
+
+    Lets callers solve *centered* least squares without materializing a
+    centered copy of A (9 GB at TIMIT scale):
+        Σ(aᵢ−μ)(aᵢ−μ)ᵀ = AᵀA − n·μμᵀ  (zero-padded rows cancel exactly).
+    """
+    mesh = mesh or get_mesh()
+    return _gram_with_sums_fn(mesh)(a, b)
+
+
+def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg=0.0) -> jnp.ndarray:
+    """Solve (AᵀA + reg·I) x = Aᵀb by Cholesky (the reference's local solve).
+
+    ``reg`` may be a traced scalar (it participates in jit caches as a
+    value, not a shape).
+    """
     d = ata.shape[0]
     lhs = ata + reg * jnp.eye(d, dtype=ata.dtype)
     factor = jax.scipy.linalg.cho_factor(lhs, lower=True)
     return jax.scipy.linalg.cho_solve(factor, atb)
+
+
+_solve_spd_jit = jax.jit(solve_spd)
 
 
 def normal_equations_solve(
@@ -111,7 +178,7 @@ def normal_equations_solve(
 ) -> jnp.ndarray:
     """One-shot distributed least squares: x = (AᵀA + λI)⁻¹ Aᵀb."""
     ata, atb = gram(a, b, mesh=mesh)
-    return jax.jit(functools.partial(solve_spd, reg=reg))(ata, atb)
+    return _solve_spd_jit(ata, atb, jnp.asarray(reg, dtype=ata.dtype))
 
 
 # ------------------------------------------------------------------------ TSQR
@@ -127,15 +194,24 @@ def tsqr_r(a: jnp.ndarray, mesh: Optional[Mesh] = None) -> jnp.ndarray:
     single gather beats a multi-level tree on-slice).
     """
     mesh = mesh or get_mesh()
-    d = a.shape[1]
+    return _tsqr_fn(mesh)(a)
 
+
+@functools.lru_cache(maxsize=None)
+def _tsqr_fn(mesh: Mesh):
     def f(a_local):
+        d = a_local.shape[1]
         r_local = jnp.linalg.qr(a_local, mode="r")
         stacked = lax.all_gather(r_local, DATA_AXIS)  # (ndev, min(n_local,d), d)
         return jnp.linalg.qr(stacked.reshape(-1, d), mode="r")
 
-    fn = shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS, None), out_specs=P())
-    return jax.jit(fn)(a)
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS, None), out_specs=P()))
+
+
+@jax.jit
+def _svd_of_r(r):
+    _, s, vt = jnp.linalg.svd(r, full_matrices=False)
+    return s, vt
 
 
 def tsqr_svd(
@@ -143,14 +219,7 @@ def tsqr_svd(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Singular values and right singular vectors of a row-sharded matrix,
     via SVD of the TSQR R factor: A = QR, R = UΣVᵀ ⇒ A's (Σ, V) = R's."""
-    r = tsqr_r(a, mesh=mesh)
-
-    @jax.jit
-    def svd_r(r):
-        _, s, vt = jnp.linalg.svd(r, full_matrices=False)
-        return s, vt
-
-    return svd_r(r)
+    return _svd_of_r(tsqr_r(a, mesh=mesh))
 
 
 # ---------------------------------------------------------------------- BCD
@@ -182,14 +251,20 @@ def block_coordinate_descent(
     """
     mesh = mesh or get_mesh()
     n, d = a.shape
-    k = y.shape[1]
     if d % block_size != 0:
         raise ValueError(f"d={d} not divisible by block_size={block_size}")
-    num_blocks = d // block_size
-    eye = jnp.eye(block_size, dtype=a.dtype)
+    fn = _bcd_fn(mesh, num_epochs, block_size)
+    return fn(a, y, jnp.asarray(reg, dtype=a.dtype))
 
-    def per_device(a_local, y_local):
-        w0 = jnp.zeros((d, k), dtype=a.dtype)
+
+@functools.lru_cache(maxsize=None)
+def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
+    def per_device(a_local, y_local, reg):
+        d = a_local.shape[1]
+        k = y_local.shape[1]
+        num_blocks = d // block_size
+        eye = jnp.eye(block_size, dtype=a_local.dtype)
+        w0 = jnp.zeros((d, k), dtype=a_local.dtype)
         p0 = jnp.zeros_like(y_local)
 
         def block_step(carry, block_idx):
@@ -210,10 +285,11 @@ def block_coordinate_descent(
         (w, _), _ = lax.scan(block_step, (w0, p0), blocks)
         return w
 
-    fn = shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
-        out_specs=P(),
+    return jax.jit(
+        shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
+            out_specs=P(),
+        )
     )
-    return jax.jit(fn)(a, y)

@@ -183,6 +183,11 @@ class BatchTransformer(Transformer):
 
     Subclasses implement ``apply_arrays(pytree) -> pytree`` (jit-friendly);
     per-datum apply wraps it with a singleton batch dimension.
+
+    Batch application preserves the framework-wide invariant that rows past
+    ``num_examples`` (mesh padding) stay exactly zero, so downstream
+    Gram/gradient accumulations over the data axis are unaffected by
+    padding no matter what elementwise work happens in between.
     """
 
     def apply_arrays(self, data: Any) -> Any:
@@ -190,16 +195,35 @@ class BatchTransformer(Transformer):
 
     def apply(self, datum: Any) -> Any:
         import jax
+        import jax.numpy as jnp
 
-        batched = jax.tree_util.tree_map(lambda a: a[None], datum)
+        # jnp.asarray keeps device arrays on device (np.asarray would force
+        # a host round-trip per datum) and still handles scalars/lists.
+        batched = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], datum)
         out = self.apply_arrays(batched)
         return jax.tree_util.tree_map(lambda a: a[0], out)
 
     def apply_batch(self, dataset: Dataset) -> Dataset:
+        import jax
+        import jax.numpy as jnp
+
         if isinstance(dataset, ObjectDataset):
             dataset = dataset.to_arrays()
         assert isinstance(dataset, ArrayDataset)
-        return dataset.map_batched(self.apply_arrays)
+        out = dataset.map_batched(self.apply_arrays)
+        if out.physical_rows > out.num_examples:
+            real_row = jnp.arange(out.physical_rows) < out.num_examples
+
+            def zero_pad_rows(a):
+                # where (not multiply): ops like log/div turn zero pad rows
+                # into NaN/Inf, and 0*NaN is NaN — select restores exact 0.
+                m = real_row.reshape((-1,) + (1,) * (a.ndim - 1))
+                return jnp.where(m, a, jnp.zeros((), dtype=a.dtype))
+
+            out = ArrayDataset(
+                jax.tree_util.tree_map(zero_pad_rows, out.data), out.num_examples
+            )
+        return out
 
 
 # ------------------------------------------------------------------ estimators

@@ -1,0 +1,96 @@
+"""Stats ops vs numpy golden values."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.stats.core import (
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+    StandardScaler,
+    Sampler,
+)
+
+
+def test_random_sign_node():
+    node = RandomSignNode.create(16, seed=0)
+    signs = np.asarray(node.signs)
+    assert set(np.unique(signs)) <= {-1.0, 1.0}
+    x = np.random.default_rng(1).normal(size=(4, 16)).astype(np.float32)
+    out = np.asarray(node.apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(out, x * signs, rtol=1e-6)
+
+
+def test_padded_fft_matches_numpy():
+    x = np.random.default_rng(0).normal(size=(3, 20)).astype(np.float32)
+    out = np.asarray(PaddedFFT().apply_batch(ArrayDataset(x)).data)
+    # pad 20 -> 32, full fft, real part of first 16
+    padded = np.pad(x, ((0, 0), (0, 12)))
+    expected = np.fft.fft(padded, axis=-1).real[:, :16]
+    assert out.shape == (3, 16)
+    np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_padded_fft_power_of_two_input():
+    x = np.random.default_rng(0).normal(size=(2, 16)).astype(np.float32)
+    out = np.asarray(PaddedFFT().apply_batch(ArrayDataset(x)).data)
+    assert out.shape == (2, 8)
+
+
+def test_linear_rectifier():
+    x = np.array([[-1.0, 0.5, 2.0]], dtype=np.float32)
+    out = np.asarray(LinearRectifier(0.0, 1.0).apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(out, [[0.0, 0.0, 1.0]])
+
+
+def test_normalize_rows():
+    x = np.array([[3.0, 4.0], [0.0, 0.0]], dtype=np.float32)
+    out = np.asarray(NormalizeRows().apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(out, [[0.6, 0.8], [0.0, 0.0]], rtol=1e-6)
+
+
+def test_signed_hellinger():
+    x = np.array([[-4.0, 9.0]], dtype=np.float32)
+    out = np.asarray(SignedHellingerMapper().apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(out, [[-2.0, 3.0]], rtol=1e-6)
+
+
+def test_standard_scaler_mean_and_std():
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=(200, 5)) * [1, 2, 3, 4, 5] + [10, 0, -5, 1, 2]).astype(np.float32)
+    model = StandardScaler().fit(ArrayDataset(x))
+    out = np.asarray(model.apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(out.std(axis=0, ddof=1), 1.0, atol=1e-3)
+
+
+def test_standard_scaler_mean_only():
+    x = np.random.default_rng(0).normal(size=(50, 3)).astype(np.float32)
+    model = StandardScaler(normalize_std_dev=False).fit(ArrayDataset(x))
+    assert model.std is None
+    out = np.asarray(model.apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+
+
+def test_standard_scaler_constant_column_guard():
+    x = np.ones((10, 2), dtype=np.float32)
+    model = StandardScaler().fit(ArrayDataset(x))
+    np.testing.assert_allclose(np.asarray(model.std), 1.0)
+
+
+def test_standard_scaler_respects_padding_mask():
+    x = np.random.default_rng(0).normal(size=(10, 3)).astype(np.float32)
+    padded = np.concatenate([x, np.zeros((6, 3), dtype=np.float32)])
+    model_pad = StandardScaler().fit(ArrayDataset(padded, num_examples=10))
+    model_raw = StandardScaler().fit(ArrayDataset(x))
+    np.testing.assert_allclose(np.asarray(model_pad.mean), np.asarray(model_raw.mean), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(model_pad.std), np.asarray(model_raw.std), atol=1e-5)
+
+
+def test_sampler():
+    x = np.arange(100, dtype=np.float32).reshape(100, 1)
+    out = Sampler(10, seed=0).apply_batch(ArrayDataset(x))
+    assert len(out) == 10

@@ -1,0 +1,36 @@
+"""End-to-end MNIST RandomFFT slice (reference: MnistRandomFFT.scala +
+README.md:14-28 config). Exercises API, executor, gather, substrate,
+block solver, and the evaluator in one pipeline."""
+
+import numpy as np
+
+from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
+from keystone_tpu.pipelines import mnist_random_fft as m
+
+
+def test_end_to_end_synthetic():
+    config = m.MnistRandomFFTConfig(num_ffts=2, block_size=512, reg=10.0)
+    train = m.synthetic_mnist(1024, seed=0)
+    pipeline = m.build_pipeline(config, train)
+    evaluator = MulticlassClassifierEvaluator(m.NUM_CLASSES)
+    metrics = evaluator.evaluate(pipeline(train.data), train.labels)
+    # Chance is 90% error; the random-FFT features must do far better.
+    assert metrics.total_error < 0.5, metrics.summary()
+
+
+def test_featurizer_output_width():
+    config = m.MnistRandomFFTConfig(num_ffts=3)
+    train = m.synthetic_mnist(64, seed=1)
+    feats = m.build_featurizer(config)(train.data).get()
+    # 784 → pad 1024 → 512 per branch, 3 branches
+    assert np.asarray(feats.data).shape == (64, 3 * 512)
+
+
+def test_fit_returns_reusable_pipeline():
+    config = m.MnistRandomFFTConfig(num_ffts=1, block_size=512, reg=10.0)
+    train = m.synthetic_mnist(512, seed=2)
+    pipeline = m.build_pipeline(config, train)
+    fitted = pipeline.fit()
+    test = m.synthetic_mnist(128, seed=3)
+    preds = fitted.apply_batch(test.data)
+    assert len(np.asarray(preds.data)) >= 128
