@@ -62,20 +62,25 @@ def main() -> None:
         times.append((time.perf_counter() - start) * 1000.0)
     ms = float(np.median(times))
 
-    scale = 1.0
-    if not on_accelerator:  # extrapolate the smaller CPU problem linearly
+    result = {
+        "metric": "timit_exact_lstsq_fit_ms_n2.2M_d1024_k138",
+        "value": round(ms, 2),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / ms, 3),
+    }
+    if not on_accelerator:
+        # CPU fallback runs a smaller problem; report it as an explicit
+        # extrapolation rather than passing it off as the measured metric.
         scale = (2_200_000 / n) * (1024 / d) ** 2
-
-    print(
-        json.dumps(
+        result.update(
             {
-                "metric": "timit_exact_lstsq_fit_ms_n2.2M_d1024_k138",
                 "value": round(ms * scale, 2),
-                "unit": "ms",
                 "vs_baseline": round(baseline_ms / (ms * scale), 3),
+                "extrapolated": True,
+                "measured_shape": [n, d, k],
             }
         )
-    )
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

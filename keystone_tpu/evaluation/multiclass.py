@@ -99,9 +99,18 @@ class MulticlassClassifierEvaluator:
     def evaluate(self, predictions: Any, labels: Any) -> MulticlassMetrics:
         pred = _to_int_array(predictions)
         lab = _to_int_array(labels)
-        n = min(len(pred), len(lab))
-        pred, lab = pred[:n], lab[:n]
+        if len(pred) != len(lab):
+            raise ValueError(
+                f"predictions ({len(pred)}) and labels ({len(lab)}) differ in "
+                "length — misaligned splits or unstripped padding rows"
+            )
         k = self.num_classes
+        for name, arr in (("labels", lab), ("predictions", pred)):
+            if len(arr) and (arr.min() < 0 or arr.max() >= k):
+                raise ValueError(
+                    f"{name} outside [0, {k}): found range "
+                    f"[{arr.min()}, {arr.max()}]"
+                )
         cm = np.zeros((k, k), dtype=np.int64)
         np.add.at(cm, (lab, pred), 1)
         return MulticlassMetrics(cm)

@@ -95,7 +95,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
         # Pad the feature dim to a whole number of blocks (zero columns are
         # inert: their Gram rows/cols are zero and λ keeps the solve PD).
-        block = min(self.block_size, _round_up(d, 1))
+        block = min(self.block_size, d)
         d_pad = _round_up(d, block)
         if d_pad != d:
             xc = jnp.pad(xc, ((0, 0), (0, d_pad - d)))

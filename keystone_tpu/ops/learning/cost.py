@@ -1,0 +1,44 @@
+"""Cost-model framework for optimizable operators.
+
+TPU-native re-design of the reference's solver cost models
+(reference: nodes/learning/CostModel.scala:6-17,
+nodes/learning/LeastSquaresEstimator.scala:17-31). Costs combine cpu
+(flops), memory-bandwidth (bytes scanned) and network (bytes moved across
+the mesh) terms:  max(cpu·flops, mem·bytes) + network·moved.
+
+The default weights are the reference's — "determined empirically via
+results run on a 16 r3.4xlarge node cluster" — kept as the starting point;
+``tpu_weights()`` rescales them with first-principles v5e numbers
+(MXU ~200 TFLOP/s bf16, HBM ~819 GB/s, ICI ~400 GB/s per link) so the
+meta-solvers make sane choices on-chip until measured constants land.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class CostWeights:
+    cpu: float
+    mem: float
+    network: float
+
+
+# reference: LeastSquaresEstimator.scala:29-31 (16×r3.4xlarge cluster)
+DEFAULT_COST_WEIGHTS = CostWeights(cpu=3.8e-4, mem=2.9e-1, network=1.32)
+
+
+def tpu_weights() -> CostWeights:
+    """First-principles per-unit costs (ms per Mflop / MB) for one v5e."""
+    cpu = 1.0 / 2.0e8   # ~200 TFLOP/s → 2e8 flops per ms
+    mem = 1.0 / 8.2e5   # ~819 GB/s → 8.2e5 bytes per ms... scaled to MB
+    network = 1.0 / 4.0e5
+    return CostWeights(cpu=cpu, mem=mem, network=network)
+
+
+class CostModel:
+    """Mixin: operators expose cost(n, d, k, sparsity, num_machines)."""
+
+    def cost(self, n, d, k, sparsity, num_machines, w=DEFAULT_COST_WEIGHTS) -> float:
+        raise NotImplementedError

@@ -1,0 +1,184 @@
+"""Distributed L-BFGS least-squares solvers (dense + sparse data).
+
+TPU-native re-design of reference: nodes/learning/LBFGS.scala:14-281 and
+nodes/learning/Gradient.scala:10-119. The reference drives Breeze's L-BFGS
+on the master with per-iteration gradients treeReduce'd from the cluster;
+here the entire optimization — two-loop recursion, zoom line search
+(optax.lbfgs), and the data-parallel gradient — is one compiled XLA loop.
+With the feature matrix row-sharded over the mesh, XLA partitions the
+gradient matmuls and inserts the ICI all-reduce automatically.
+
+Loss (matching LeastSquaresDenseGradient): ½‖XW − Y‖²/n + ½λ‖W‖².
+
+The sparse variant keeps the reference's capability (Amazon-style
+n=65M, d=16k, 0.5% dense): data arrives as host CSR rows and is fed
+through batched BCOO sparse-dense matmuls on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ...parallel import linalg
+from ...parallel.mesh import get_mesh
+from ...workflow.pipeline import LabelEstimator
+from ..stats.core import _as_array_dataset
+from .linear import LinearMapper, SparseLinearMapper
+
+
+class DenseLBFGSEstimator(LabelEstimator):
+    """reference: LBFGS.scala DenseLBFGSwithL2 (weight = 2·numIterations)."""
+
+    def __init__(
+        self,
+        reg: float = 0.0,
+        num_iterations: int = 100,
+        memory_size: int = 10,
+        tol: float = 1e-6,
+        fit_intercept: bool = True,
+    ):
+        self.reg = reg
+        self.num_iterations = num_iterations
+        self.memory_size = memory_size
+        self.tol = tol
+        self.fit_intercept = fit_intercept
+
+    @property
+    def weight(self) -> int:
+        return 2 * self.num_iterations
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        mesh = get_mesh()
+        x = linalg.prepare_row_sharded(jnp.asarray(features.data, jnp.float32), mesh)
+        y = linalg.prepare_row_sharded(jnp.asarray(targets.data, jnp.float32), mesh)
+        n = features.num_examples
+
+        mu_a = jnp.sum(x, axis=0) / n
+        mu_b = jnp.sum(y, axis=0) / n
+        if not self.fit_intercept:
+            mu_a = jnp.zeros_like(mu_a)
+            mu_b = jnp.zeros_like(mu_b)
+        mask = (jnp.arange(x.shape[0]) < n).astype(x.dtype)[:, None]
+
+        w = _lbfgs_least_squares(
+            x, y, mu_a, mu_b, mask,
+            jnp.float32(n), jnp.float32(self.reg),
+            self.num_iterations, self.memory_size, self.tol,
+        )
+        return LinearMapper(w, intercept=mu_b if self.fit_intercept else None,
+                            feature_mean=mu_a if self.fit_intercept else None)
+
+
+@functools.partial(jax.jit, static_argnums=(7, 8, 9))
+def _lbfgs_least_squares(x, y, mu_a, mu_b, mask, n, reg,
+                         num_iterations, memory_size, tol):
+    d, k = x.shape[1], y.shape[1]
+
+    def loss(w):
+        # centered residuals; padded rows masked out of the objective
+        pred = linalg.mm(x - mu_a, w)
+        r = (pred - (y - mu_b)) * mask
+        return 0.5 * jnp.sum(r * r) / n + 0.5 * reg * jnp.sum(w * w)
+
+    solver = optax.lbfgs(memory_size=memory_size)
+    value_and_grad = optax.value_and_grad_from_state(loss)
+
+    w0 = jnp.zeros((d, k), dtype=x.dtype)
+    state0 = solver.init(w0)
+
+    def cond(carry):
+        _, state, i, gnorm = carry
+        return (i < num_iterations) & (gnorm > tol)
+
+    def body(carry):
+        w, state, i, _ = carry
+        value, grad = value_and_grad(w, state=state)
+        updates, state = solver.update(
+            grad, state, w, value=value, grad=grad, value_fn=loss
+        )
+        w = optax.apply_updates(w, updates)
+        return w, state, i + 1, jnp.linalg.norm(grad)
+
+    w, *_ = jax.lax.while_loop(cond, body, (w0, state0, jnp.int32(0), jnp.float32(jnp.inf)))
+    return w
+
+
+class SparseLBFGSEstimator(LabelEstimator):
+    """reference: LBFGS.scala SparseLBFGSwithL2.
+
+    Accepts an ObjectDataset of scipy CSR rows (the Sparsify output) or a
+    dense ArrayDataset. Data is packed once into a BCOO matrix; gradients
+    use sparse·dense matmuls so HBM holds only the nonzeros.
+    """
+
+    def __init__(self, reg: float = 0.0, num_iterations: int = 100,
+                 memory_size: int = 10, tol: float = 1e-6):
+        self.reg = reg
+        self.num_iterations = num_iterations
+        self.memory_size = memory_size
+        self.tol = tol
+
+    @property
+    def weight(self) -> int:
+        return 2 * self.num_iterations
+
+    def fit(self, data: Dataset, labels: Dataset) -> SparseLinearMapper:
+        from jax.experimental import sparse as jsparse
+        import scipy.sparse as sp
+
+        targets = _as_array_dataset(labels)
+        y = jnp.asarray(targets.data, jnp.float32)[: targets.num_examples]
+
+        if isinstance(data, ArrayDataset):
+            mat = sp.csr_matrix(np.asarray(jax.device_get(data.data))[: data.num_examples])
+        else:
+            rows = data.collect()
+            mat = sp.vstack([r if sp.issparse(r) else sp.csr_matrix(np.asarray(r).reshape(1, -1)) for r in rows])
+        n, d = mat.shape
+        coo = mat.tocoo()
+        x_sp = jsparse.BCOO(
+            (jnp.asarray(coo.data, jnp.float32),
+             jnp.asarray(np.stack([coo.row, coo.col], axis=1))),
+            shape=(n, d),
+        )
+
+        w = _sparse_lbfgs(
+            x_sp, y, jnp.float32(self.reg),
+            self.num_iterations, self.memory_size, self.tol,
+        )
+        return SparseLinearMapper(w)
+
+
+def _sparse_lbfgs(x_sp, y, reg, num_iterations, memory_size, tol):
+    from jax.experimental import sparse as jsparse
+
+    n, d = x_sp.shape
+    k = y.shape[1]
+
+    def loss(w):
+        r = x_sp @ w - y
+        return 0.5 * jnp.sum(r * r) / n + 0.5 * reg * jnp.sum(w * w)
+
+    solver = optax.lbfgs(memory_size=memory_size)
+    value_and_grad = optax.value_and_grad_from_state(loss)
+    w = jnp.zeros((d, k), dtype=jnp.float32)
+    state = solver.init(w)
+    for _ in range(num_iterations):
+        value, grad = value_and_grad(w, state=state)
+        if float(jnp.linalg.norm(grad)) <= tol:
+            break
+        updates, state = solver.update(
+            grad, state, w, value=value, grad=grad, value_fn=loss
+        )
+        w = optax.apply_updates(w, updates)
+    return w

@@ -170,16 +170,21 @@ class ColumnSampler(Transformer):
         self.num_samples_per_item = num_samples_per_item
         self.seed = seed
 
-    def apply(self, datum):
-        rng = np.random.default_rng(self.seed)
+    def _sample(self, datum, rng) -> np.ndarray:
         mat = np.asarray(datum)
         n_cols = mat.shape[1]
         take = min(self.num_samples_per_item, n_cols)
         idx = rng.choice(n_cols, size=take, replace=False)
         return mat[:, idx].T  # (take, d)
 
+    def apply(self, datum):
+        return self._sample(datum, np.random.default_rng(self.seed))
+
     def apply_batch(self, dataset: Dataset) -> ArrayDataset:
-        rows = [self.apply(item) for item in dataset.collect()]
+        # One rng threaded across items — re-seeding per item would sample
+        # identical column positions from every matrix.
+        rng = np.random.default_rng(self.seed)
+        rows = [self._sample(item, rng) for item in dataset.collect()]
         return ArrayDataset(np.concatenate(rows, axis=0))
 
 

@@ -26,14 +26,20 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level (kwarg: check_vma)
+try:  # newer jax exposes shard_map at top level
     from jax import shard_map as _shard_map
-
-    _CHECK_KWARG = "check_vma"
-except ImportError:  # pragma: no cover - older jax (kwarg: check_rep)
+except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
-    _CHECK_KWARG = "check_rep"
+# The replication-check kwarg was renamed check_rep → check_vma; pick by
+# signature, not import location (top-level shard_map existed with either).
+import inspect as _inspect
+
+_CHECK_KWARG = (
+    "check_vma"
+    if "check_vma" in _inspect.signature(_shard_map).parameters
+    else "check_rep"
+)
 
 from .mesh import DATA_AXIS, get_mesh
 

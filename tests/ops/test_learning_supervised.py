@@ -1,0 +1,163 @@
+"""LBFGS / logistic / weighted-BCD / meta-solver tests (reference:
+LBFGSSuite, LogisticRegressionSuite, BlockWeightedLeastSquaresSuite,
+LeastSquaresEstimatorSuite)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset, ObjectDataset
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.lbfgs import DenseLBFGSEstimator, SparseLBFGSEstimator
+from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.ops.learning.logistic import LogisticRegressionEstimator
+from keystone_tpu.ops.learning.weighted import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.workflow.optimize import DataStats
+
+
+def ridge_problem(n=256, d=12, k=3, seed=0, noise=0.1):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    y = (x @ w + noise * rng.normal(size=(n, k))).astype(np.float32)
+    return x, y, w
+
+
+def test_dense_lbfgs_matches_ridge():
+    x, y, _ = ridge_problem()
+    reg = 0.5
+    # note: lbfgs objective is ||XW-Y||^2/(2n) + reg/2 ||W||^2
+    # closed form: (X'X/n + reg I)^-1 X'Y/n on centered data
+    n = len(x)
+    mu_a, mu_b = x.mean(0), y.mean(0)
+    xc, yc = x - mu_a, y - mu_b
+    expected = np.linalg.solve(xc.T @ xc / n + reg * np.eye(x.shape[1]), xc.T @ yc / n)
+    model = DenseLBFGSEstimator(reg=reg, num_iterations=80).fit(ArrayDataset(x), ArrayDataset(y))
+    np.testing.assert_allclose(np.asarray(model.weights), expected, rtol=5e-2, atol=5e-3)
+
+
+def test_dense_lbfgs_prediction_quality():
+    x, y, _ = ridge_problem(noise=0.0)
+    model = DenseLBFGSEstimator(reg=1e-6, num_iterations=200).fit(ArrayDataset(x), ArrayDataset(y))
+    pred = np.asarray(model.apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(pred, y, rtol=5e-2, atol=5e-2)
+
+
+def test_sparse_lbfgs_on_csr_rows():
+    import scipy.sparse as sp
+
+    rng = np.random.default_rng(1)
+    n, d, k = 200, 30, 2
+    x = (rng.random((n, d)) < 0.1) * rng.normal(size=(n, d))
+    x = x.astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    y = x @ w
+    rows = [sp.csr_matrix(x[i : i + 1]) for i in range(n)]
+    model = SparseLBFGSEstimator(reg=1e-4, num_iterations=100).fit(
+        ObjectDataset(rows), ArrayDataset(y)
+    )
+    pred = np.asarray(model.apply_batch(ArrayDataset(x)).data)
+    np.testing.assert_allclose(pred, y, atol=0.2)
+
+
+def test_logistic_regression_separates():
+    rng = np.random.default_rng(2)
+    n = 300
+    x = rng.normal(size=(n, 5)).astype(np.float32)
+    w_true = rng.normal(size=(5, 3))
+    y = np.argmax(x @ w_true, axis=1).astype(np.int32)
+    model = LogisticRegressionEstimator(3, reg=1e-4, num_iterations=100).fit(
+        ArrayDataset(x), ArrayDataset(y)
+    )
+    scores = np.asarray(model.apply_batch(ArrayDataset(x)).data)
+    assert (scores.argmax(1) == y).mean() > 0.95
+
+
+def numpy_weighted_reference(x, y, reg, mw, num_iter):
+    """Direct numpy transcription of the reference's math (single block)."""
+    n, d = x.shape
+    C = y.shape[1]
+    cls = np.argmax(y, 1)
+    counts = np.bincount(cls, minlength=C).astype(np.float64)
+    jlm = 2 * mw + 2 * (1 - mw) * counts / n - 1
+    R = y - jlm
+    W = np.zeros((d, C))
+    pop_mean = x.mean(0)
+    pop_cov = x.T @ x / n - np.outer(pop_mean, pop_mean)
+    joint_means = np.zeros((C, d))
+    for _ in range(num_iter):
+        pop_xtr = x.T @ R / n
+        res_mean = R.mean(0)
+        dW = np.zeros_like(W)
+        for c in range(C):
+            xc = x[cls == c]
+            rc = R[cls == c, c]
+            nc = counts[c]
+            cm = xc.mean(0)
+            ccov = xc.T @ xc / nc - np.outer(cm, cm)
+            cxtr = xc.T @ rc / nc
+            delta = cm - pop_mean
+            jm = mw * cm + (1 - mw) * pop_mean
+            joint_means[c] = jm
+            jxtx = (1 - mw) * pop_cov + mw * ccov + mw * (1 - mw) * np.outer(delta, delta)
+            mean_mix = (1 - mw) * res_mean[c] + mw * rc.mean()
+            jxtr = (1 - mw) * pop_xtr[:, c] + mw * cxtr - jm * mean_mix
+            dW[:, c] = np.linalg.solve(jxtx + reg * np.eye(d), jxtr - reg * W[:, c])
+        W += dW
+        R = R - x @ dW
+    b = jlm - np.einsum("cd,dc->c", joint_means, W)
+    return W, b
+
+
+def test_weighted_bcd_matches_numpy_reference():
+    rng = np.random.default_rng(3)
+    n, d, C = 120, 8, 3
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    cls = rng.integers(0, C, size=n)
+    y = np.full((n, C), -1.0, dtype=np.float32)
+    y[np.arange(n), cls] = 1.0
+
+    est = BlockWeightedLeastSquaresEstimator(block_size=8, num_iter=2, reg=0.3,
+                                             mixture_weight=0.25)
+    model = est.fit(ArrayDataset(x), ArrayDataset(y))
+    w_ref, b_ref = numpy_weighted_reference(
+        x.astype(np.float64), y.astype(np.float64), 0.3, 0.25, 2
+    )
+    np.testing.assert_allclose(np.asarray(model.weights)[:d], w_ref, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(model.intercept), b_ref, rtol=1e-3, atol=1e-3)
+
+
+def test_weighted_bcd_classifies():
+    rng = np.random.default_rng(4)
+    n, d, C = 300, 6, 3
+    centers = rng.normal(size=(C, d)) * 4
+    cls = rng.integers(0, C, size=n)
+    x = (centers[cls] + rng.normal(size=(n, d))).astype(np.float32)
+    y = np.full((n, C), -1.0, dtype=np.float32)
+    y[np.arange(n), cls] = 1.0
+    model = BlockWeightedLeastSquaresEstimator(3, 3, 0.1, 0.25).fit(
+        ArrayDataset(x), ArrayDataset(y)
+    )
+    scores = np.asarray(model.apply_batch(ArrayDataset(x)).data)
+    assert (scores.argmax(1) == cls).mean() > 0.9
+
+
+def test_meta_solver_picks_exact_for_small_dense():
+    est = LeastSquaresEstimator(reg=0.1)
+    x = np.random.default_rng(0).normal(size=(100, 8)).astype(np.float32)
+    y = np.random.default_rng(1).normal(size=(100, 2)).astype(np.float32)
+    stats = DataStats(n_total=100_000, num_shards=8, n_per_shard=[12500] * 8)
+    chosen = est.optimize([ArrayDataset(x), ArrayDataset(y)], stats)
+    assert isinstance(chosen, LinearMapEstimator)
+
+
+def test_meta_solver_picks_sparse_for_sparse_data():
+    import scipy.sparse as sp
+
+    est = LeastSquaresEstimator(reg=0.1)
+    rng = np.random.default_rng(0)
+    rows = [sp.csr_matrix((rng.random((1, 20000)) < 0.004) * 1.0) for _ in range(50)]
+    y = rng.normal(size=(50, 2)).astype(np.float32)
+    stats = DataStats(n_total=65_000_000, num_shards=8, n_per_shard=[1] * 8)
+    chosen = est.optimize([ObjectDataset(rows), ArrayDataset(y)], stats)
+    assert isinstance(chosen, SparseLBFGSEstimator)
