@@ -1,0 +1,39 @@
+"""Image featurization operators (reference: nodes/images/)."""
+
+from .core import (
+    CenterCornerPatcher,
+    Convolver,
+    Cropper,
+    GrayScaler,
+    ImageExtractor,
+    ImageVectorizer,
+    LabelExtractor,
+    MultiLabelExtractor,
+    MultiLabeledImageExtractor,
+    PixelScaler,
+    Pooler,
+    RandomImageTransformer,
+    RandomPatcher,
+    SymmetricRectifier,
+    Windower,
+    pack_filters,
+)
+
+__all__ = [
+    "CenterCornerPatcher",
+    "Convolver",
+    "Cropper",
+    "GrayScaler",
+    "ImageExtractor",
+    "ImageVectorizer",
+    "LabelExtractor",
+    "MultiLabelExtractor",
+    "MultiLabeledImageExtractor",
+    "PixelScaler",
+    "Pooler",
+    "RandomImageTransformer",
+    "RandomPatcher",
+    "SymmetricRectifier",
+    "Windower",
+    "pack_filters",
+]

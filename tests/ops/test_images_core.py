@@ -1,0 +1,188 @@
+"""Image-core operator tests.
+
+Mirrors the reference's test strategy of comparing operator output against
+straight-line reference implementations / golden conv values
+(reference: nodes/images/ConvolverSuite.scala, PoolerSuite.scala).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.images import (
+    CenterCornerPatcher,
+    Convolver,
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    Pooler,
+    RandomPatcher,
+    SymmetricRectifier,
+    Windower,
+    pack_filters,
+)
+from keystone_tpu.ops.learning.zca import ZCAWhitenerEstimator
+from keystone_tpu.utils import image as imutil
+
+
+def reference_convolve(img, packed_filters, channels, normalize, whitener_means, var_constant=10.0):
+    """Direct im2col transliteration of Convolver.scala:128-204 semantics."""
+    s = int(np.sqrt(packed_filters.shape[1] // channels))
+    rx = img.shape[0] - s + 1
+    ry = img.shape[1] - s + 1
+    patches = np.zeros((rx * ry, s * s * channels))
+    for y in range(ry):
+        for x in range(rx):
+            for poy in range(s):
+                for pox in range(s):
+                    for c in range(channels):
+                        px = c + pox * channels + poy * channels * s
+                        patches[x + y * rx, px] = img[x + pox, y + poy, c]
+    if normalize:
+        means = patches.mean(axis=1, keepdims=True)
+        var = ((patches - means) ** 2).sum(axis=1, keepdims=True) / (patches.shape[1] - 1)
+        patches = (patches - means) / np.sqrt(var + var_constant)
+    if whitener_means is not None:
+        patches = patches - whitener_means
+    res = patches @ packed_filters.T  # (rx*ry, F)
+    out = np.zeros((rx, ry, packed_filters.shape[0]))
+    for y in range(ry):
+        for x in range(rx):
+            out[x, y, :] = res[x + y * rx]
+    return out
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+def test_convolver_matches_im2col_reference(normalize):
+    rng = np.random.default_rng(0)
+    imgs = rng.normal(size=(3, 10, 9, 3)).astype(np.float32)
+    filters = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    packed = pack_filters(filters)
+
+    conv = Convolver(packed, img_channels=3, normalize_patches=normalize)
+    out = np.asarray(conv.apply_batch(ArrayDataset(imgs)).data)
+
+    for i in range(imgs.shape[0]):
+        want = reference_convolve(imgs[i], packed, 3, normalize, None)
+        np.testing.assert_allclose(out[i], want, rtol=2e-4, atol=2e-4)
+
+
+def test_convolver_with_whitener_matches_reference():
+    rng = np.random.default_rng(1)
+    imgs = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+    filters = rng.normal(size=(5, 3, 3, 1)).astype(np.float32)
+    patch_samples = rng.normal(size=(200, 9)).astype(np.float32)
+    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(patch_samples)
+
+    conv = Convolver.create(filters, whitener=whitener, normalize_patches=True)
+    out = np.asarray(conv.apply_batch(ArrayDataset(imgs)).data)
+
+    w = np.asarray(whitener.whitener)
+    mu = np.asarray(whitener.means)
+    packed_whitened = (pack_filters(filters) - mu) @ w @ w.T
+    for i in range(imgs.shape[0]):
+        want = reference_convolve(imgs[i], packed_whitened, 1, True, mu)
+        np.testing.assert_allclose(out[i], want, rtol=3e-3, atol=3e-3)
+
+
+def reference_pool(img, stride, pool_size, pixel_fn, pool_fn=np.sum):
+    """Transliteration of Pooler.scala:29-68."""
+    x_dim, y_dim, channels = img.shape
+    start = pool_size // 2
+    nx = int(np.ceil((x_dim - start) / stride))
+    ny = int(np.ceil((y_dim - start) / stride))
+    out = np.zeros((nx, ny, channels))
+    for x in range(start, x_dim, stride):
+        for y in range(start, y_dim, stride):
+            sx, ex = x - pool_size // 2, min(x + pool_size // 2, x_dim)
+            sy, ey = y - pool_size // 2, min(y + pool_size // 2, y_dim)
+            for c in range(channels):
+                pool = np.zeros(pool_size * pool_size)
+                idx = 0
+                for yy in range(sy, ey):
+                    for xx in range(sx, ex):
+                        pool[idx] = pixel_fn(img[xx, yy, c])
+                        idx += 1
+                out[(x - start) // stride, (y - start) // stride, c] = pool_fn(pool)
+    return out
+
+
+@pytest.mark.parametrize("shape,stride,pool", [((12, 12, 2), 4, 4), ((13, 11, 1), 3, 6)])
+def test_pooler_matches_reference(shape, stride, pool):
+    rng = np.random.default_rng(2)
+    img = rng.normal(size=shape)
+    pooler = Pooler(stride, pool, pixel_function=abs)
+    out = np.asarray(pooler.apply_batch(ArrayDataset(img[None].astype(np.float32))).data[0])
+    want = reference_pool(img, stride, pool, abs)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_symmetric_rectifier():
+    img = np.array([[[1.0, -2.0]]])[None]  # (1,1,1,2)
+    out = np.asarray(SymmetricRectifier(alpha=0.5).apply_batch(ArrayDataset(img)).data)
+    np.testing.assert_allclose(out[0, 0, 0], [0.5, 0.0, 0.0, 1.5])
+
+
+def test_grayscale_bgr_weights():
+    img = np.zeros((1, 2, 2, 3))
+    img[..., 2] = 100.0  # R channel (BGR order)
+    out = np.asarray(GrayScaler().apply_batch(ArrayDataset(img)).data)
+    np.testing.assert_allclose(out, np.full((1, 2, 2, 1), 29.89), rtol=1e-5)
+
+
+def test_pixel_scaler_and_vectorizer_layout():
+    img = np.arange(2 * 3 * 2, dtype=np.float64).reshape(1, 2, 3, 2)
+    vec = np.asarray(ImageVectorizer().apply_batch(ArrayDataset(img)).data)[0]
+    # out[c + x*C + y*C*X] == img[x, y, c]
+    X, C = 2, 2
+    for x in range(2):
+        for y in range(3):
+            for c in range(2):
+                assert vec[c + x * C + y * C * X] == img[0, x, y, c]
+    scaled = np.asarray(PixelScaler().apply_batch(ArrayDataset(img)).data)
+    np.testing.assert_allclose(scaled, img / 255.0)
+
+
+def test_windower_counts_and_content():
+    rng = np.random.default_rng(3)
+    imgs = rng.normal(size=(2, 8, 6, 3)).astype(np.float32)
+    out = Windower(stride=2, window_size=4).apply_batch(ArrayDataset(imgs))
+    # per image: ((8-4)/2+1) * ((6-4)/2+1) = 3*2 = 6 windows
+    assert out.physical_rows == 12
+    first = np.asarray(out.data)[0]
+    np.testing.assert_allclose(first, imgs[0, 0:4, 0:4, :])
+    # x-major ordering: second window advances y first
+    second = np.asarray(out.data)[1]
+    np.testing.assert_allclose(second, imgs[0, 0:4, 2:6, :])
+
+
+def test_random_patcher_shapes():
+    rng = np.random.default_rng(4)
+    imgs = rng.normal(size=(3, 10, 10, 2)).astype(np.float32)
+    out = RandomPatcher(5, 4, 4).apply_batch(ArrayDataset(imgs))
+    assert np.asarray(out.data).shape == (15, 4, 4, 2)
+
+
+def test_center_corner_patcher():
+    img = np.arange(5 * 5, dtype=np.float64).reshape(1, 5, 5, 1)
+    out = CenterCornerPatcher(3, 3, horizontal_flips=True).apply_batch(ArrayDataset(img))
+    arr = np.asarray(out.data)
+    assert arr.shape == (10, 3, 3, 1)
+    np.testing.assert_allclose(arr[0], img[0, 0:3, 0:3, :])  # top-left corner
+    np.testing.assert_allclose(arr[1], imutil.flip_horizontal(img[0, 0:3, 0:3, :]))
+    np.testing.assert_allclose(arr[8], img[0, 1:4, 1:4, :])  # center
+
+
+def test_conv2d_separable_same_shape():
+    rng = np.random.default_rng(5)
+    img = rng.normal(size=(9, 7, 2))
+    out = imutil.conv2d_separable(img, np.array([1.0, 2.0, 1.0]), np.array([1.0, 1.0]))
+    assert out.shape == img.shape
+
+
+def test_vectorize_roundtrip():
+    rng = np.random.default_rng(6)
+    img = rng.normal(size=(4, 5, 3))
+    meta = imutil.ImageMetadata.of(img)
+    vec = imutil.vectorize(img)
+    np.testing.assert_allclose(imutil.unvectorize(vec, meta), img)
