@@ -119,7 +119,7 @@ class MulticlassClassifierEvaluator:
 def _to_int_array(x: Any) -> np.ndarray:
     if hasattr(x, "get"):  # PipelineResult
         x = x.get()
-    if hasattr(x, "data"):  # ArrayDataset
+    if hasattr(x, "num_examples"):  # ArrayDataset (np arrays also have .data)
         return np.asarray(x.data)[: x.num_examples].astype(np.int64).ravel()
     if hasattr(x, "collect"):
         return np.asarray(x.collect(), dtype=np.int64).ravel()

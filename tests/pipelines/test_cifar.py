@@ -1,0 +1,71 @@
+"""End-to-end CIFAR workload tests on synthetic data.
+
+Mirrors the reference's strategy of running full pipelines in local mode
+and asserting they learn (reference: RandomPatchCifar's structure; the
+suite-level analog of KernelModelSuite's learnability checks).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.data.loaders.cifar import decode_cifar_bytes
+from keystone_tpu.pipelines import cifar
+
+
+def make_synthetic_cifar(n, seed=0):
+    """Class-dependent mean images + noise: trivially learnable."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, 10, size=n).astype(np.int32)
+    protos = rng.normal(size=(10, 32, 32, 3)) * 40 + 128
+    images = protos[labels] + rng.normal(size=(n, 32, 32, 3)) * 10
+    images = np.clip(images, 0, 255).astype(np.float32)
+    return ArrayDataset({"image": images, "label": labels})
+
+
+def test_cifar_binary_decode_layout():
+    # one record: label 7, R plane all 1, G plane all 2, B plane all 3,
+    # except R[x=1,y=2] = 9
+    rec = np.zeros(1 + 3072, dtype=np.uint8)
+    rec[0] = 7
+    rec[1 : 1025] = 1
+    rec[1025 : 2049] = 2
+    rec[2049 :] = 3
+    rec[1 + 1 * 32 + 2] = 9
+    ds = decode_cifar_bytes(rec.tobytes())
+    img = np.asarray(ds.data["image"])[0]
+    assert np.asarray(ds.data["label"])[0] == 7
+    assert img[0, 0, 0] == 1 and img[0, 0, 1] == 2 and img[0, 0, 2] == 3
+    assert img[1, 2, 0] == 9
+
+
+def test_linear_pixels_learns():
+    # n must exceed the 1024 grayscale features for the OLS normal equations
+    # to be well-posed (the reference runs this with n=50000).
+    train = make_synthetic_cifar(1536)
+    pipeline = cifar.build_linear_pixels(train)
+    images = ArrayDataset(train.data["image"], train.num_examples)
+    from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
+
+    ev = MulticlassClassifierEvaluator(10).evaluate(pipeline(images), train.data["label"])
+    assert ev.total_error < 0.15
+
+
+@pytest.mark.parametrize("solver", ["block", "kernel"])
+def test_random_patch_cifar_learns(solver):
+    train = make_synthetic_cifar(192, seed=1)
+    config = cifar.RandomCifarConfig(
+        num_filters=32,
+        patch_steps=4,
+        reg=1.0 if solver == "block" else 1e-4,
+        kernel_block_size=64,
+        gamma=1e-3,
+    )
+    images = ArrayDataset(train.data["image"], train.num_examples)
+    filters, whitener = cifar.learn_random_patch_filters(images, config, whitener_size=2000)
+    assert filters.shape == (32, 6 * 6 * 3)
+    pipeline = cifar.build_random_patch(train, config, filters, whitener, solver=solver)
+    from keystone_tpu.evaluation.multiclass import MulticlassClassifierEvaluator
+
+    ev = MulticlassClassifierEvaluator(10).evaluate(pipeline(images), train.data["label"])
+    assert ev.total_error < 0.2
