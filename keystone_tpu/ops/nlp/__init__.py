@@ -1,0 +1,33 @@
+"""Text / NLP operators (reference: nodes/nlp/)."""
+
+from .indexers import NaiveBitPackIndexer, NGramIndexer
+from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
+from .text import (
+    HashingTF,
+    LowerCase,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    WordFrequencyTransformer,
+)
+
+__all__ = [
+    "HashingTF",
+    "LowerCase",
+    "NGramsCounts",
+    "NGramsFeaturizer",
+    "NGramsHashingTF",
+    "NaiveBitPackIndexer",
+    "NGramIndexer",
+    "StupidBackoffEstimator",
+    "StupidBackoffModel",
+    "TermFrequency",
+    "Tokenizer",
+    "Trim",
+    "WordFrequencyEncoder",
+    "WordFrequencyTransformer",
+]

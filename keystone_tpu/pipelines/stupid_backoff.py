@@ -1,0 +1,54 @@
+"""Stupid Backoff language-model workload.
+
+Reference: pipelines/nlp/StupidBackoffPipeline.scala — tokenize a corpus,
+fit a frequency vocabulary, featurize 2..n-grams over encoded ids, count
+them, and fit the Stupid Backoff scorer.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+from ..data.dataset import ObjectDataset
+from ..ops.nlp import (
+    NGramsCounts,
+    NGramsFeaturizer,
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class StupidBackoffConfig:
+    train_data: str = ""
+    n: int = 3
+
+
+def fit_language_model(lines, n: int = 3) -> StupidBackoffModel:
+    text = Tokenizer().apply_batch(ObjectDataset(list(lines)))
+    frequency_encode = WordFrequencyEncoder().fit(text)
+    unigram_counts = frequency_encode.unigram_counts
+
+    make_ngrams = frequency_encode.to_pipeline().then(NGramsFeaturizer(range(2, n + 1)))
+    ngram_counts = NGramsCounts("no_add")(make_ngrams(text))
+    return StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
+
+
+def run(config: StupidBackoffConfig) -> dict:
+    start = time.time()
+    with open(config.train_data) as f:
+        lines = [l for l in f.read().splitlines() if l.strip()]
+    model = fit_language_model(lines, config.n)
+    logger.info(
+        "number of tokens: %d | vocab: %d | ngrams: %d",
+        model.num_tokens,
+        len(model.unigram_counts),
+        len(model.scores),
+    )
+    return {"model": model, "seconds": time.time() - start}

@@ -1,0 +1,61 @@
+"""Evaluator tests (reference: evaluation/*Suite.scala)."""
+
+import numpy as np
+
+from keystone_tpu.evaluation import (
+    AugmentedExamplesEvaluator,
+    BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
+)
+
+
+def test_binary_metrics():
+    pred = [True, True, False, False, True]
+    act = [True, False, False, True, True]
+    m = BinaryClassifierEvaluator().evaluate(pred, act)
+    assert (m.tp, m.fp, m.tn, m.fn) == (2.0, 1.0, 1.0, 1.0)
+    assert m.accuracy == 3 / 5
+    assert m.precision == 2 / 3
+    assert m.recall == 2 / 3
+    assert m.specificity == 1 / 2
+    np.testing.assert_allclose(m.f_score(), 2 / 3)
+
+
+def test_map_perfect_ranking_is_one():
+    # class 0 scores rank all its positives first -> AP = 1
+    scores = np.array([[0.9, 0.1], [0.8, 0.6], [0.2, 0.9], [0.1, 0.7]])
+    labels = [[0], [0], [1], [1]]
+    aps = MeanAveragePrecisionEvaluator(2).evaluate(scores, labels)
+    np.testing.assert_allclose(aps, [1.0, 1.0])
+
+
+def test_map_matches_hand_computation():
+    # one class, ranking: pos, neg, pos  -> precisions 1, 1/2, 2/3 at
+    # recalls 1/2, 1/2, 1. 11-point AP: levels <=0.5 take max prec at
+    # recall>=t which is 1.0 (6 levels), levels >0.5 take 2/3 (5 levels).
+    scores = np.array([[0.9], [0.8], [0.7]])
+    labels = [[0], [], [0]]
+    aps = MeanAveragePrecisionEvaluator(1).evaluate(scores, labels)
+    want = (6 * 1.0 + 5 * (2 / 3)) / 11.0
+    np.testing.assert_allclose(aps, [want])
+
+
+def test_augmented_average_policy():
+    # two examples, three copies each; average of copies decides
+    names = ["a", "a", "a", "b", "b", "b"]
+    scores = np.array(
+        [[0.9, 0.1], [0.0, 0.4], [0.2, 0.3],  # a: avg (0.367, 0.267) -> 0
+         [0.1, 0.2], [0.3, 0.25], [0.1, 0.5]]  # b: avg (0.167, 0.317) -> 1
+    )
+    labels = np.array([0, 0, 0, 1, 1, 1])
+    m = AugmentedExamplesEvaluator(names, 2).evaluate(scores, labels)
+    assert m.total_error == 0.0
+
+
+def test_augmented_borda_policy():
+    names = ["a", "a"]
+    # borda: ranks per copy — copy1 favors class2, copy2 favors class2
+    scores = np.array([[0.1, 0.5, 0.9], [0.3, 0.2, 0.8]])
+    labels = np.array([2, 2])
+    m = AugmentedExamplesEvaluator(names, 3, policy="borda").evaluate(scores, labels)
+    assert m.total_error == 0.0

@@ -1,0 +1,117 @@
+"""NLP operator tests (reference: nlp suites — NGramsFeaturizerSuite,
+NGramsHashingTFSuite, StupidBackoffSuite, indexer suites)."""
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ObjectDataset
+from keystone_tpu.ops.nlp import (
+    HashingTF,
+    NaiveBitPackIndexer,
+    NGramIndexer,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    StupidBackoffEstimator,
+    TermFrequency,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+from keystone_tpu.ops.util.sparse import AllSparseFeatures, CommonSparseFeatures
+
+
+def test_tokenizer_splits_punct_and_space():
+    assert Tokenizer().apply("Hello, world!  foo_bar") == ["Hello", "world", "foo", "bar"]
+
+
+def test_ngrams_featurizer_orders():
+    grams = NGramsFeaturizer([1, 2, 3]).apply(["a", "b", "c"])
+    assert ("a",) in grams and ("a", "b") in grams and ("a", "b", "c") in grams
+    assert ("b", "c") in grams and ("c",) in grams
+    assert len(grams) == 6
+
+
+def test_ngrams_counts_sorted():
+    ds = ObjectDataset([[("a",), ("b",)], [("a",)]])
+    pairs = NGramsCounts()(ds)
+    assert pairs[0] == (("a",), 2)
+    assert (("b",), 1) in pairs
+
+
+def test_term_frequency():
+    tf = dict(TermFrequency().apply(["x", "y", "x"]))
+    assert tf[("x")] == 2.0 and tf["y"] == 1.0
+    tf1 = dict(TermFrequency(lambda x: 1).apply(["x", "y", "x"]))
+    assert tf1["x"] == 1.0
+
+
+def test_ngrams_hashing_tf_equals_unfused():
+    """The reference's contract: NGramsHashingTF == NGramsFeaturizer then
+    HashingTF (reference: NGramsHashingTF.scala:17-21)."""
+    line = "the quick brown fox jumps over the lazy dog the quick".split()
+    for orders in ([1, 2], [2, 3], [1, 2, 3]):
+        fused = NGramsHashingTF(orders, 512).apply(line)
+        unfused = HashingTF(512).apply(NGramsFeaturizer(orders).apply(line))
+        assert (fused != unfused).nnz == 0
+
+
+def test_hashing_tf_deterministic_across_processes():
+    # java_string_hash is salt-free; fixed expected column for a known term
+    v = HashingTF(1000).apply(["hello"])
+    v2 = HashingTF(1000).apply(["hello"])
+    assert (v != v2).nnz == 0
+    assert v.nnz == 1
+
+
+def test_word_frequency_encoder():
+    data = ObjectDataset([["a", "b", "a"], ["a", "c"]])
+    enc = WordFrequencyEncoder().fit(data)
+    assert enc.apply(["a", "b", "zzz"]) == [0, enc.word_index["b"], -1]
+    assert enc.unigram_counts[0] == 3  # "a" is rank 0 with count 3
+
+
+def test_bitpack_indexer_roundtrip():
+    idx = NaiveBitPackIndexer()
+    packed = idx.pack([3, 7, 11])
+    assert idx.ngram_order(packed) == 3
+    assert [idx.unpack(packed, p) for p in range(3)] == [3, 7, 11]
+    # strip farthest: [7, 11]
+    stripped = idx.remove_farthest_word(packed)
+    assert idx.ngram_order(stripped) == 2
+    assert idx.unpack(stripped, 0) == 7 and idx.unpack(stripped, 1) == 11
+    # strip current: [3, 7]
+    ctx = idx.remove_current_word(packed)
+    assert idx.ngram_order(ctx) == 2
+    assert idx.unpack(ctx, 0) == 3 and idx.unpack(ctx, 1) == 7
+
+
+def test_stupid_backoff_scores():
+    """Hand-checkable corpus: 'a a b' — unigrams a:2 b:1, bigrams (a,a):1,
+    (a,b):1."""
+    unigram_counts = {0: 2, 1: 1}  # a->0, b->1
+    ngram_counts = [((0, 0), 1), ((0, 1), 1)]
+    model = StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
+    # seen bigram: freq(a,a)/freq(a) = 1/2
+    np.testing.assert_allclose(model.score((0, 0)), 0.5)
+    np.testing.assert_allclose(model.score((0, 1)), 0.5)
+    # unseen bigram (b, a): backoff alpha * freq(a)/N = 0.4 * 2/3
+    np.testing.assert_allclose(model.score((1, 0)), 0.4 * 2 / 3)
+    # unseen trigram (a, a, b): backoff to seen bigram (a,b): 0.4 * 1/2
+    np.testing.assert_allclose(model.score((0, 0, 1)), 0.4 * 0.5)
+
+
+def test_common_sparse_features_top_k():
+    docs = ObjectDataset(
+        [[("a", 1.0), ("b", 1.0)], [("a", 1.0), ("c", 2.0)], [("a", 1.0), ("b", 3.0)]]
+    )
+    vec = CommonSparseFeatures(2).fit(docs)
+    assert set(vec.feature_space) == {"a", "b"}
+    row = vec.apply([("a", 5.0), ("c", 7.0), ("b", 1.0)])
+    assert row.shape == (1, 2)
+    assert row[0, vec.feature_space["a"]] == 5.0
+    assert row.nnz == 2  # "c" dropped
+
+
+def test_all_sparse_features_order():
+    docs = ObjectDataset([[("x", 1.0)], [("y", 1.0), ("x", 1.0)], [("z", 1.0)]])
+    vec = AllSparseFeatures().fit(docs)
+    assert vec.feature_space == {"x": 0, "y": 1, "z": 2}

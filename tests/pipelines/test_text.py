@@ -1,0 +1,93 @@
+"""End-to-end text workload tests on synthetic corpora."""
+
+import json
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.loaders.text import load_amazon_reviews
+from keystone_tpu.pipelines import stupid_backoff, text
+
+
+POS_WORDS = ["great", "excellent", "love", "wonderful", "amazing", "perfect"]
+NEG_WORDS = ["terrible", "awful", "hate", "broken", "worst", "refund"]
+FILLER = ["the", "product", "arrived", "yesterday", "and", "it", "was", "box"]
+
+
+def make_reviews(n, seed):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for _ in range(n):
+        pos = rng.random() < 0.5
+        words = list(rng.choice(POS_WORDS if pos else NEG_WORDS, size=4)) + list(
+            rng.choice(FILLER, size=6)
+        )
+        rng.shuffle(words)
+        rows.append(
+            {"reviewText": " ".join(words), "overall": 5.0 if pos else 1.0}
+        )
+    return rows
+
+
+def write_reviews(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_amazon_reviews_pipeline(tmp_path):
+    train_p, test_p = tmp_path / "train.json", tmp_path / "test.json"
+    write_reviews(train_p, make_reviews(300, 0))
+    write_reviews(test_p, make_reviews(80, 1))
+    config = text.AmazonReviewsConfig(
+        train_location=str(train_p),
+        test_location=str(test_p),
+        common_features=500,
+        num_iters=30,
+    )
+    res = text.run_amazon(config)
+    assert res["metrics"].accuracy > 0.9
+
+
+def test_newsgroups_pipeline(tmp_path):
+    # two tiny fake newsgroups with distinct vocab
+    from keystone_tpu.data.loaders.text import NEWSGROUPS_CLASSES
+
+    rng = np.random.default_rng(2)
+    for cls, vocab in [
+        ("comp.graphics", ["pixel", "render", "opengl", "shader"]),
+        ("rec.autos", ["engine", "wheel", "brake", "clutch"]),
+    ]:
+        for split in ("train", "test"):
+            d = tmp_path / split / cls
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(30 if split == "train" else 8):
+                words = rng.choice(vocab, size=12)
+                (d / f"doc{i}.txt").write_text(" ".join(words))
+    config = text.NewsgroupsConfig(
+        train_location=str(tmp_path / "train"),
+        test_location=str(tmp_path / "test"),
+        common_features=200,
+    )
+    res = text.run_newsgroups(config)
+    assert res["metrics"].total_error < 0.1
+
+
+def test_stupid_backoff_pipeline(tmp_path):
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the cat sat on the mat\nthe cat ate the fish\n")
+    res = stupid_backoff.run(stupid_backoff.StupidBackoffConfig(str(corpus), n=3))
+    model = res["model"]
+    assert model.num_tokens == 11
+    # "the" is the most frequent word -> id 0; "cat" follows "the" 2 of 4 times
+    np.testing.assert_allclose(model.score((0, 1)), 0.5)
+    for s in model.scores.values():
+        assert 0.0 <= s <= 1.0
+
+
+def test_amazon_loader_threshold(tmp_path):
+    p = tmp_path / "r.json"
+    write_reviews(p, [{"reviewText": "ok", "overall": 4.0}, {"reviewText": "bad", "overall": 2.0}])
+    data = load_amazon_reviews(str(p))
+    assert data.labels.collect() == [1, 0]
+    assert data.data.collect() == ["ok", "bad"]
