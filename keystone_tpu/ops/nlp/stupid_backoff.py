@@ -38,16 +38,19 @@ class StupidBackoffModel(Transformer):
         self.alpha = alpha
         self.indexer = indexer or NGramIndexer()
 
-    def score(self, ngram: Sequence) -> float:
-        """Recursive backoff score (reference: StupidBackoff.scoreLocally)."""
-        ngram = tuple(ngram)
-        if self.indexer.ngram_order(ngram) == 1:
-            freq = self.unigram_counts.get(self.indexer.unpack(ngram, 0), 0)
-        else:
-            freq = self.ngram_counts.get(ngram, 0)
-        return self._score(1.0, ngram, freq)
+    def score(self, ngram) -> float:
+        """Recursive backoff score (reference: StupidBackoff.scoreLocally).
 
-    def _score(self, accum: float, ngram: Tuple, freq: int) -> float:
+        Accepts either a word sequence (packed through the indexer) or an
+        already-packed key (e.g. a NaiveBitPackIndexer 64-bit int)."""
+        key = self.indexer.pack(ngram) if isinstance(ngram, (list, tuple)) else ngram
+        if self.indexer.ngram_order(key) == 1:
+            freq = self.unigram_counts.get(self.indexer.unpack(key, 0), 0)
+        else:
+            freq = self.ngram_counts.get(key, 0)
+        return self._score(1.0, key, freq)
+
+    def _score(self, accum: float, ngram, freq: int) -> float:
         idx = self.indexer
         order = idx.ngram_order(ngram)
         if order == 1:
@@ -80,21 +83,23 @@ class StupidBackoffEstimator(Estimator):
     """Fit from (ngram, count) pairs
     (reference: StupidBackoff.scala:138-180 StupidBackoffEstimator)."""
 
-    def __init__(self, unigram_counts: Mapping, alpha: float = 0.4):
+    def __init__(self, unigram_counts: Mapping, alpha: float = 0.4, indexer: NGramIndexer = None):
         self.unigram_counts = unigram_counts
         self.alpha = alpha
+        self.indexer = indexer or NGramIndexer()
 
     def fit(self, data: Dataset) -> StupidBackoffModel:
         if isinstance(data, Dataset):
             pairs = data.collect()
         else:
             pairs = list(data)
-        counts: Dict[Tuple, int] = {}
+        counts: Dict = {}
         for ngram, c in pairs:
-            counts[tuple(ngram)] = counts.get(tuple(ngram), 0) + c
+            key = self.indexer.pack(ngram) if isinstance(ngram, (list, tuple)) else ngram
+            counts[key] = counts.get(key, 0) + c
         num_tokens = sum(self.unigram_counts.values())
         model = StupidBackoffModel(
-            {}, counts, self.unigram_counts, num_tokens, self.alpha
+            {}, counts, self.unigram_counts, num_tokens, self.alpha, self.indexer
         )
         scores = {}
         for ngram, freq in counts.items():

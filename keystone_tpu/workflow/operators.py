@@ -52,6 +52,15 @@ class TransformerExpression(Expression):
     """Lazily yields a fit :class:`TransformerOperator`."""
 
 
+def wrap_expression(value: Any) -> "Expression":
+    """Wrap an already-computed value, preserving dataset-ness so
+    :meth:`TransformerOperator.execute` picks the batch path. Used by the
+    sample/profiling mini-interpreters in the optimizer layer."""
+    if isinstance(value, Dataset):
+        return DatasetExpression.of(value)
+    return Expression.of(value)
+
+
 class Operator:
     """Base execution unit stored at graph nodes."""
 

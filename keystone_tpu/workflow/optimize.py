@@ -27,6 +27,7 @@ from .operators import (
     DatumOperator,
     Expression,
     Operator,
+    wrap_expression,
 )
 from .rules import PrefixMap, Rule
 
@@ -100,7 +101,7 @@ class _SampleInterpreter:
             result = _subsample(full, self.sample_size)
         else:
             deps = [self.execute(d) for d in self.graph.get_dependencies(graph_id)]
-            expressions = [Expression.of(d) for d in deps]
+            expressions = [wrap_expression(d) for d in deps]
             result = op.execute(expressions).get()
         self._memo[graph_id] = result
         return result

@@ -99,6 +99,22 @@ def test_stupid_backoff_scores():
     np.testing.assert_allclose(model.score((0, 0, 1)), 0.4 * 0.5)
 
 
+def test_stupid_backoff_with_bitpack_indexer():
+    """The bit-pack indexer path must produce identical scores to the tuple
+    indexer (reference: StupidBackoffSuite uses NaiveBitPackIndexer)."""
+    from keystone_tpu.ops.nlp.indexers import NaiveBitPackIndexer
+
+    unigram_counts = {0: 2, 1: 1}
+    ngram_counts = [((0, 0), 1), ((0, 1), 1)]
+    idx = NaiveBitPackIndexer()
+    model = StupidBackoffEstimator(unigram_counts, indexer=idx).fit(ngram_counts)
+    np.testing.assert_allclose(model.score((0, 0)), 0.5)
+    np.testing.assert_allclose(model.score((1, 0)), 0.4 * 2 / 3)
+    np.testing.assert_allclose(model.score((0, 0, 1)), 0.4 * 0.5)
+    # already-packed query gives the same answer
+    np.testing.assert_allclose(model.score(idx.pack((0, 1))), 0.5)
+
+
 def test_common_sparse_features_top_k():
     docs = ObjectDataset(
         [[("a", 1.0), ("b", 1.0)], [("a", 1.0), ("c", 2.0)], [("a", 1.0), ("b", 3.0)]]

@@ -1,0 +1,164 @@
+"""Auto-cache planner tests (reference: workflow/AutocCacheRuleSuite.scala:27-50).
+
+The reference suite builds graphs by hand with toy transformers and
+weighted estimators, then asserts on the selected cache set; same here.
+"""
+
+import time
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset, Dataset
+from keystone_tpu.ops.util.misc import CacherOperator
+from keystone_tpu.workflow.autocache import AutoCacheRule, Profile, _fit_linear, SampleProfile
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator, TransformerOperator
+
+
+class CountingOp(TransformerOperator):
+    """Identity-ish op that counts batch executions and can sleep."""
+
+    def __init__(self, name, delay_s=0.0, weight=1):
+        self.name = name
+        self.delay_s = delay_s
+        self.weight = weight
+        self.batch_calls = 0
+
+    @property
+    def label(self):
+        return self.name
+
+    def single_transform(self, datums):
+        return datums[0]
+
+    def batch_transform(self, datasets):
+        self.batch_calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return datasets[0]
+
+
+def diamond_graph(n=64, delay_s=0.0, weight=1):
+    """source-bound dataset → expensive shared node → two consumers → sinks."""
+    data = ArrayDataset(np.ones((n, 4), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    shared = CountingOp("shared", delay_s=delay_s)
+    g, sh = g.add_node(shared, [d])
+    g, c1 = g.add_node(CountingOp("left", weight=weight), [sh])
+    g, c2 = g.add_node(CountingOp("right"), [sh])
+    g, s1 = g.add_sink(c1)
+    g, s2 = g.add_sink(c2)
+    return g, sh, shared
+
+
+def cacher_nodes(graph):
+    return [n for n in graph.nodes if isinstance(graph.get_operator(n), CacherOperator)]
+
+
+def test_aggressive_caches_every_reused_node():
+    g, shared_id, _ = diamond_graph()
+    out, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
+    caches = cacher_nodes(out)
+    assert len(caches) == 1
+    assert out.get_dependencies(caches[0]) == (shared_id,)
+    # both consumers repointed at the cacher
+    consumers = [
+        n
+        for n in out.nodes
+        if caches[0] in out.get_dependencies(n) and n != caches[0]
+    ]
+    assert len(consumers) == 2
+
+
+def test_greedy_caches_expensive_shared_node_under_budget():
+    g, shared_id, _ = diamond_graph(delay_s=0.01)
+    out, _ = AutoCacheRule(budget_bytes=1 << 30, strategy="greedy").apply(g, {})
+    caches = cacher_nodes(out)
+    assert len(caches) == 1
+    assert out.get_dependencies(caches[0]) == (shared_id,)
+
+
+def test_greedy_zero_budget_caches_nothing():
+    g, _, _ = diamond_graph(delay_s=0.01)
+    out, _ = AutoCacheRule(budget_bytes=0, strategy="greedy").apply(g, {})
+    assert cacher_nodes(out) == []
+
+
+def test_single_use_node_never_cached():
+    data = ArrayDataset(np.ones((16, 4), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, a = g.add_node(CountingOp("a", delay_s=0.005), [d])
+    g, b = g.add_node(CountingOp("b"), [a])
+    g, s = g.add_sink(b)
+    out, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
+    assert cacher_nodes(out) == []
+
+
+def test_weighted_consumer_counts_as_multiple_uses():
+    """A single downstream consumer with weight>1 (iterative solver) makes
+    its input cache-worthy (reference: WeightedNode, BCD weight 3·iter+1)."""
+    data = ArrayDataset(np.ones((16, 4), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, a = g.add_node(CountingOp("feat"), [d])
+    g, b = g.add_node(CountingOp("solver", weight=7), [a])
+    g, s = g.add_sink(b)
+    out, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
+    caches = cacher_nodes(out)
+    assert len(caches) == 1
+    assert out.get_dependencies(caches[0]) == (a,)
+
+
+def test_already_cached_node_not_recached():
+    g, shared_id, _ = diamond_graph()
+    g, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
+    out, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
+    assert len(cacher_nodes(out)) == 1
+
+
+def test_execution_still_correct_and_shared_runs_once():
+    """End-to-end through the executor: cache insertion preserves results and
+    collapses recomputation (reference: PipelineSuite fit-once semantics)."""
+    from keystone_tpu.workflow.executor import GraphExecutor
+
+    g, shared_id, shared_op = diamond_graph(n=8)
+    out, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
+    sinks = sorted(out.sinks)
+    executor = GraphExecutor(out, optimize=False)
+    results = [executor.execute(s).get() for s in sinks]
+    for r in results:
+        assert isinstance(r, Dataset)
+        np.testing.assert_allclose(np.asarray(r.data), np.ones((8, 4)))
+    assert shared_op.batch_calls == 1
+
+
+def test_greedy_credits_ancestor_recompute_savings():
+    """Caching a cheap shared node whose ancestor is expensive must win over
+    caching a moderately expensive independent shared node: the cost model
+    sees the ancestor's time through the runs() recursion."""
+    data = ArrayDataset(np.ones((64, 4), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, a = g.add_node(CountingOp("expensive-ancestor", delay_s=0.02), [d])
+    g, s_cheap = g.add_node(CountingOp("cheap-shared"), [a])
+    g, c1 = g.add_node(CountingOp("u1"), [s_cheap])
+    g, c2 = g.add_node(CountingOp("u2"), [s_cheap])
+    g, b = g.add_node(CountingOp("independent-shared", delay_s=0.005), [d])
+    g, c3 = g.add_node(CountingOp("u3"), [b])
+    g, c4 = g.add_node(CountingOp("u4"), [b])
+    for n in (c1, c2, c3, c4):
+        g, _ = g.add_sink(n)
+    # Budget fits exactly one cached copy of (64,4) float32 = 1024 bytes.
+    out, _ = AutoCacheRule(budget_bytes=1100, strategy="greedy").apply(g, {})
+    caches = cacher_nodes(out)
+    assert len(caches) == 1
+    assert out.get_dependencies(caches[0]) == (s_cheap,)
+
+
+def test_linear_fit_extrapolates():
+    samples = [SampleProfile(2, 0.2, 200), SampleProfile(4, 0.4, 400)]
+    p = _fit_linear(samples, 100)
+    assert abs(p.run_time_s - 10.0) < 1e-6
+    assert p.size_bytes == 10_000
