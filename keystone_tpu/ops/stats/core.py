@@ -56,6 +56,44 @@ class PaddedFFT(BatchTransformer):
         return jnp.fft.rfft(padded, axis=-1).real[..., : p // 2].astype(x.dtype)
 
 
+class CosineRandomFeatures(BatchTransformer):
+    """Rahimi-Recht random cosine features: cos(x·Wᵀ + b)
+    (reference: nodes/stats/CosineRandomFeatures.scala:19-75).
+
+    One whole-batch GEMM on the MXU replaces the reference's
+    partition-blocked Breeze GEMM; W rides along as a (d_out, d_in)
+    device constant."""
+
+    def __init__(self, w: np.ndarray, b: np.ndarray):
+        if b.shape[0] != w.shape[0]:
+            raise ValueError("rows of W and size of b must match")
+        self.w = jnp.asarray(w, dtype=jnp.float32)
+        self.b = jnp.asarray(b, dtype=jnp.float32)
+
+    @staticmethod
+    def create(
+        num_input_features: int,
+        num_output_features: int,
+        gamma: float,
+        dist: str = "gaussian",
+        seed: int = 0,
+    ) -> "CosineRandomFeatures":
+        """W ~ gamma·dist, b ~ U[0, 2π) (reference: CosineRandomFeatures
+        companion object; Cauchy variant for the TIMIT rfType flag)."""
+        rng = np.random.default_rng(seed)
+        if dist == "gaussian":
+            w = rng.normal(size=(num_output_features, num_input_features))
+        elif dist == "cauchy":
+            w = rng.standard_cauchy(size=(num_output_features, num_input_features))
+        else:
+            raise ValueError(f"unknown distribution {dist!r}")
+        b = rng.uniform(0.0, 2.0 * np.pi, size=num_output_features)
+        return CosineRandomFeatures(w * gamma, b)
+
+    def apply_arrays(self, x):
+        return jnp.cos(x @ self.w.T + self.b)
+
+
 class LinearRectifier(BatchTransformer):
     """f(x) = max(max_val, x - alpha)."""
 

@@ -5,6 +5,7 @@ import pytest
 
 from keystone_tpu.data.dataset import ArrayDataset
 from keystone_tpu.ops.stats.core import (
+    CosineRandomFeatures,
     LinearRectifier,
     NormalizeRows,
     PaddedFFT,
@@ -94,3 +95,31 @@ def test_sampler():
     x = np.arange(100, dtype=np.float32).reshape(100, 1)
     out = Sampler(10, seed=0).apply_batch(ArrayDataset(x))
     assert len(out) == 10
+
+
+def test_cosine_random_features_matches_numpy():
+    """cos(xWᵀ + b) vs numpy golden values
+    (reference: nodes/stats/CosineRandomFeaturesSuite)."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 5)).astype(np.float32)
+    w = rng.normal(size=(7, 5))
+    b = rng.uniform(0, 2 * np.pi, size=7)
+    out = CosineRandomFeatures(w, b).apply_batch(ArrayDataset(x))
+    expected = np.cos(x @ w.T.astype(np.float32) + b.astype(np.float32))
+    np.testing.assert_allclose(np.asarray(out.data), expected, atol=1e-5)
+
+
+def test_cosine_random_features_create_shapes_and_dists():
+    t = CosineRandomFeatures.create(5, 16, gamma=0.5, dist="gaussian", seed=1)
+    assert t.w.shape == (16, 5) and t.b.shape == (16,)
+    c = CosineRandomFeatures.create(5, 16, gamma=0.5, dist="cauchy", seed=1)
+    assert c.w.shape == (16, 5)
+    # Cauchy tails are heavier: max |w| should exceed the gaussian's
+    assert float(abs(np.asarray(c.w)).max()) > float(abs(np.asarray(t.w)).max())
+    with pytest.raises(ValueError):
+        CosineRandomFeatures.create(5, 16, 0.5, dist="laplace")
+
+
+def test_cosine_random_features_mismatched_b():
+    with pytest.raises(ValueError):
+        CosineRandomFeatures(np.ones((4, 3)), np.ones(5))
