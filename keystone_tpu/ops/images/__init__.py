@@ -18,8 +18,19 @@ from .core import (
     Windower,
     pack_filters,
 )
+from .daisy import DaisyExtractor
+from .fisher import FisherVector, GMMFisherVectorEstimator
+from .hog import HogExtractor
+from .lcs import LCSExtractor
+from .sift import SIFTExtractor
 
 __all__ = [
+    "DaisyExtractor",
+    "FisherVector",
+    "GMMFisherVectorEstimator",
+    "HogExtractor",
+    "LCSExtractor",
+    "SIFTExtractor",
     "CenterCornerPatcher",
     "Convolver",
     "Cropper",
