@@ -20,6 +20,7 @@ in an ``ObjectDataset``.
 from __future__ import annotations
 
 import glob
+import itertools
 import os
 import tarfile
 from concurrent.futures import ThreadPoolExecutor
@@ -113,9 +114,18 @@ def load_image_archives(
 
     records: List[Dict[str, Any]] = []
     archives = [p for p in list_archives(data_path) if tarfile.is_tarfile(p)]
+    # Chunked submission keeps only ~2 decode-rounds of raw bytes in
+    # flight — pool.map over the raw generator would drain the whole tar
+    # into queued futures before the first decode finishes.
+    chunk = max(1, 2 * num_workers)
     with ThreadPoolExecutor(max_workers=num_workers) as pool:
         for archive in archives:
-            for rec in pool.map(decode, iter_tar_entries(archive, name_prefix)):
-                if rec is not None:
-                    records.append(rec)
+            entries = iter_tar_entries(archive, name_prefix)
+            while True:
+                batch = list(itertools.islice(entries, chunk))
+                if not batch:
+                    break
+                for rec in pool.map(decode, batch):
+                    if rec is not None:
+                        records.append(rec)
     return ObjectDataset(records, num_shards=max(1, len(archives)))

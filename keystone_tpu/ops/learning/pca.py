@@ -58,22 +58,27 @@ class PCATransformer(BatchTransformer):
 
 
 class BatchPCATransformer(Transformer):
-    """Project per-item (d, nᵢ) descriptor matrices: Pᵀ · M → (k, nᵢ)
-    (reference: PCA.scala BatchPCATransformer)."""
+    """Project per-item (nᵢ, d) descriptor matrices: M · P → (nᵢ, k)
+    (reference: PCA.scala BatchPCATransformer — the reference holds
+    descriptors as columns of (d, nᵢ) matrices; this framework's extractors
+    emit descriptor-rows with the feature dim last, the TPU-friendly
+    layout, so the projection is a plain right-multiply)."""
 
     def __init__(self, components: jnp.ndarray):
         self.components = jnp.asarray(components)
 
     def apply(self, mat):
-        return np.asarray(self.components).T @ np.asarray(mat)
+        return np.asarray(mat) @ np.asarray(self.components)
 
     def apply_batch(self, dataset: Dataset) -> Dataset:
         if isinstance(dataset, ArrayDataset):
-            # uniform (n, d, cols) stack: one batched einsum on the MXU
-            out = jnp.einsum(
-                "dk,ndc->nkc", self.components, jnp.asarray(dataset.data),
-                precision=linalg.PRECISION,
-            )
+            x = jnp.asarray(dataset.data)
+            if x.ndim == 2:  # flat (n, d) descriptor rows
+                out = linalg.mm(x, self.components)
+            else:  # uniform (n, cols, d) stack: one batched einsum on the MXU
+                out = jnp.einsum(
+                    "ncd,dk->nck", x, self.components, precision=linalg.PRECISION
+                )
             return ArrayDataset(out, dataset.num_examples)
         return dataset.map(self.apply)
 
@@ -199,8 +204,9 @@ def _approx_pca_jit(x, key, l, q):
 
 
 class LocalColumnPCAEstimator(Estimator, CostModel):
-    """PCA over the columns of per-item (d, nᵢ) matrices, local SVD
-    (reference: PCA.scala:51-73)."""
+    """PCA over the descriptors of per-item (nᵢ, d) matrices, local SVD
+    (reference: PCA.scala:51-73 — the reference's matrices are (d, nᵢ)
+    column-major; this framework holds descriptor rows)."""
 
     def __init__(self, dims: int):
         self.dims = dims
@@ -216,7 +222,8 @@ class LocalColumnPCAEstimator(Estimator, CostModel):
 
 
 class DistributedColumnPCAEstimator(Estimator, CostModel):
-    """Column PCA via distributed TSQR (reference: PCA.scala:75-103)."""
+    """Descriptor PCA over per-item (nᵢ, d) matrices via distributed TSQR
+    (reference: PCA.scala:75-103)."""
 
     def __init__(self, dims: int):
         self.dims = dims
@@ -252,8 +259,8 @@ class ColumnPCAEstimator(Estimator, Optimizable, CostModel):
         items = sample.take(8)
         if not items:
             return self.distributed
-        cols = float(np.mean([np.asarray(m).shape[1] for m in items]))
-        d = int(np.asarray(items[0]).shape[0])
+        cols = float(np.mean([np.asarray(m).shape[0] for m in items]))
+        d = int(np.asarray(items[0]).shape[1])
         n = int(cols * stats.n_total)
         machines = self.num_machines or num_devices()
         lc = self.local.cost(n, d, self.dims, 1.0, machines, self.weights)
@@ -262,13 +269,14 @@ class ColumnPCAEstimator(Estimator, Optimizable, CostModel):
 
 
 def _columns_to_vectors(data: Dataset) -> ArrayDataset:
-    """Flatten per-item (d, nᵢ) matrices into one (Σnᵢ, d) vector dataset."""
+    """Flatten per-item (nᵢ, d) descriptor matrices into one (Σnᵢ, d)
+    vector dataset."""
     if isinstance(data, ArrayDataset):
         x = jnp.asarray(data.data)
         if x.ndim == 2:
             return ArrayDataset(x, data.num_examples)
-        # (n, d, c) → (n·c, d)
-        n, d, c = x.shape
-        return ArrayDataset(jnp.transpose(x, (0, 2, 1)).reshape(n * c, d))
+        # (n, c, d) → (n·c, d)
+        n, c, d = x.shape
+        return ArrayDataset(x.reshape(n * c, d))
     mats = [np.asarray(m) for m in data.collect()]
-    return ArrayDataset(np.concatenate([m.T for m in mats], axis=0))
+    return ArrayDataset(np.concatenate(mats, axis=0))

@@ -63,8 +63,6 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         class_idx = np.argmax(y, axis=1)
         counts = np.bincount(class_idx, minlength=num_classes).astype(np.int64)
-        if (counts == 0).any():
-            raise ValueError("every class needs at least one example")
         order = np.argsort(class_idx, kind="stable")
         offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
         m = int(counts.max())
@@ -94,6 +92,10 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
 
         mw = self.mixture_weight
         jlm = 2 * mw + 2 * (1 - mw) * counts / n - 1  # (C,)
+        # Absent classes have an all -1 target column; the least-squares-
+        # consistent constant score is -1, not 2·mw − 1 (which would let a
+        # phantom class outrank trained negatives in top-k predictions).
+        jlm = np.where(counts > 0, jlm, -1.0)
         # b_c = jlm_c − Σ_d jointMean[c, d]·W[d, c]
         b = jnp.asarray(jlm, jnp.float32) - jnp.einsum(
             "cd,dc->c", joint_means, w, precision=linalg.PRECISION
@@ -121,6 +123,10 @@ def _weighted_bcd(x, xs, y, onehot, offsets, counts, reg, mw,
         def step(carry, c):
             off = offsets[c]
             n_c = counts[c]
+            # Classes absent from the data get no weight update (the
+            # reference only ever iterates over observed class groups).
+            present = (n_c > 0).astype(x.dtype)
+            n_c_safe = jnp.maximum(n_c, 1.0)
             win = jax.lax.dynamic_slice(block_xs, (off, 0), (m, bs))
             valid = (row_win < n_c).astype(x.dtype)[:, None]
             win = win * valid
@@ -128,9 +134,9 @@ def _weighted_bcd(x, xs, y, onehot, offsets, counts, reg, mw,
             r_c = jax.lax.dynamic_index_in_dim(r_win, c, axis=1, keepdims=False)
             r_c = r_c * valid[:, 0]
 
-            class_mean = jnp.sum(win, axis=0) / n_c
-            class_cov = linalg.mm(win.T, win) / n_c - jnp.outer(class_mean, class_mean)
-            class_xtr = linalg.mm(win.T, r_c[:, None])[:, 0] / n_c
+            class_mean = jnp.sum(win, axis=0) / n_c_safe
+            class_cov = linalg.mm(win.T, win) / n_c_safe - jnp.outer(class_mean, class_mean)
+            class_xtr = linalg.mm(win.T, r_c[:, None])[:, 0] / n_c_safe
 
             delta = class_mean - pop_mean
             joint_mean = mw * class_mean + (1 - mw) * pop_mean
@@ -138,14 +144,14 @@ def _weighted_bcd(x, xs, y, onehot, offsets, counts, reg, mw,
                 (1 - mw) * pop_cov + mw * class_cov
                 + mw * (1 - mw) * jnp.outer(delta, delta)
             )
-            mean_mix = (1 - mw) * res_mean[c] + mw * jnp.sum(r_c) / n_c
+            mean_mix = (1 - mw) * res_mean[c] + mw * jnp.sum(r_c) / n_c_safe
             pop_xtr_c = jax.lax.dynamic_index_in_dim(pop_xtr, c, axis=1, keepdims=False)
             joint_xtr = (1 - mw) * pop_xtr_c + mw * class_xtr - joint_mean * mean_mix
 
             w_old_c = jax.lax.dynamic_index_in_dim(w_old_b, c, axis=1, keepdims=False)
             factor = jax.scipy.linalg.cho_factor(joint_xtx + reg * eye, lower=True)
             dw = jax.scipy.linalg.cho_solve(factor, joint_xtr - reg * w_old_c)
-            return carry, (dw, joint_mean)
+            return carry, (dw * present, joint_mean)
 
         _, (dws, joint_means) = jax.lax.scan(
             step, 0, jnp.arange(num_classes)

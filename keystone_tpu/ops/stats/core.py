@@ -200,9 +200,12 @@ class Sampler(Transformer):
 
 
 class ColumnSampler(Transformer):
-    """Sample columns from per-item (d, n_i) descriptor matrices and emit a
-    flat (num_samples_total, d) dataset
-    (reference: nodes/stats/ColumnSampler used by the ImageNet pipeline)."""
+    """Sample descriptors from per-item (n_i, d) descriptor matrices and
+    emit a flat (num_samples_total, d) dataset
+    (reference: nodes/stats/ColumnSampler used by the ImageNet/VOC
+    pipelines — the reference's matrices are (d, nᵢ) column-major; this
+    framework's extractors emit descriptor rows, so "columns" here are the
+    descriptor axis)."""
 
     def __init__(self, num_samples_per_item: int, seed: int = 42):
         self.num_samples_per_item = num_samples_per_item
@@ -210,17 +213,27 @@ class ColumnSampler(Transformer):
 
     def _sample(self, datum, rng) -> np.ndarray:
         mat = np.asarray(datum)
-        n_cols = mat.shape[1]
-        take = min(self.num_samples_per_item, n_cols)
-        idx = rng.choice(n_cols, size=take, replace=False)
-        return mat[:, idx].T  # (take, d)
+        n_desc = mat.shape[0]
+        take = min(self.num_samples_per_item, n_desc)
+        idx = rng.choice(n_desc, size=take, replace=False)
+        return mat[idx]  # (take, d)
 
     def apply(self, datum):
         return self._sample(datum, np.random.default_rng(self.seed))
 
     def apply_batch(self, dataset: Dataset) -> ArrayDataset:
+        if isinstance(dataset, ArrayDataset):
+            # (N, c, d) uniform batch: one vectorized gather per batch.
+            x = np.asarray(dataset.data)[: dataset.num_examples]
+            n, c, _ = x.shape
+            take = min(self.num_samples_per_item, c)
+            rng = np.random.default_rng(self.seed)
+            # per-row sample-without-replacement in one shot: argsort of a
+            # random matrix (per-row choice() would be O(n) host calls)
+            idx = np.argsort(rng.random((n, c)), axis=1)[:, :take]
+            return ArrayDataset(x[np.arange(n)[:, None], idx].reshape(n * take, -1))
         # One rng threaded across items — re-seeding per item would sample
-        # identical column positions from every matrix.
+        # identical descriptor positions from every matrix.
         rng = np.random.default_rng(self.seed)
         rows = [self._sample(item, rng) for item in dataset.collect()]
         return ArrayDataset(np.concatenate(rows, axis=0))

@@ -1,0 +1,18 @@
+"""End-to-end workloads (reference: src/main/scala/keystoneml/pipelines/).
+
+Each module exposes a config dataclass, ``build_pipeline`` builders, and a
+``run(config)`` driver returning a results dict — the analog of the
+reference's scopt-parsed ``object ... { def run(sc, config) }`` programs.
+"""
+
+from . import cifar, imagenet, mnist_random_fft, stupid_backoff, text, timit, voc
+
+__all__ = [
+    "cifar",
+    "imagenet",
+    "mnist_random_fft",
+    "stupid_backoff",
+    "text",
+    "timit",
+    "voc",
+]

@@ -68,11 +68,11 @@ def test_pca_transformer_projects(x):
 
 def test_column_pca_on_descriptor_matrices():
     rng = np.random.default_rng(1)
-    mats = [rng.normal(size=(6, 20)).astype(np.float32) for _ in range(10)]
+    mats = [rng.normal(size=(20, 6)).astype(np.float32) for _ in range(10)]
     est = ColumnPCAEstimator(dims=2)
     model = est.fit(ObjectDataset(mats))
     out = model.apply(mats[0])
-    assert out.shape == (2, 20)
+    assert out.shape == (20, 2)
 
 
 def test_zca_whitens_covariance():

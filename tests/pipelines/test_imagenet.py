@@ -1,0 +1,73 @@
+"""End-to-end ImageNet SIFT+LCS+FV flagship pipeline test on a generated
+tiny tar (reference test model: ImageNetLoaderSuite resource tars + the
+ImageNetSiftLcsFV driver)."""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.pipelines.imagenet import (
+    ImageNetSiftLcsFVConfig,
+    run,
+    top_k_err_percent,
+)
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image as PILImage  # noqa: E402
+
+
+def _class_jpeg(rng, mean_rgb, size=(72, 72)):
+    base = rng.integers(0, 80, size=(size[1], size[0], 3))
+    arr = np.clip(base + np.asarray(mean_rgb), 0, 255).astype(np.uint8)
+    img = PILImage.fromarray(arr, "RGB")
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=92)
+    return buf.getvalue()
+
+
+@pytest.fixture
+def imagenet_fixture(tmp_path):
+    rng = np.random.default_rng(0)
+    class_colors = {"n01": (180, 30, 30), "n02": (30, 30, 180)}
+    tar_path = tmp_path / "train.tar"
+    with tarfile.open(tar_path, "w") as tar:
+        for cls, color in class_colors.items():
+            for i in range(4):
+                payload = _class_jpeg(rng, color)
+                info = tarfile.TarInfo(f"{cls}/img{i}.jpg")
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+    labels_path = tmp_path / "labels.txt"
+    labels_path.write_text("n01 0\nn02 1\n")
+    return str(tar_path), str(labels_path)
+
+
+def test_top_k_err_percent():
+    pred = np.array([[0, 1], [2, 3], [4, 5]])
+    actual = np.array([1, 0, 4])
+    assert top_k_err_percent(pred, actual) == pytest.approx(100.0 / 3.0)
+
+
+def test_imagenet_sift_lcs_fv_end_to_end(imagenet_fixture):
+    tar_path, labels_path = imagenet_fixture
+    config = ImageNetSiftLcsFVConfig(
+        train_location=tar_path,
+        test_location=tar_path,
+        label_path=labels_path,
+        desc_dim=8,
+        vocab_size=2,
+        num_pca_samples=400,
+        num_gmm_samples=400,
+        num_classes=10,
+        image_size=(64, 64),
+        solver_block_size=32,
+        lcs_border=16,
+        reg=1e-3,
+    )
+    results = run(config)
+    # train == test: the two color classes must separate in the top-5
+    assert results["test_error_percent"] <= 50.0
+    pipeline = results["pipeline"]
+    assert pipeline is not None
