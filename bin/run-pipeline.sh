@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Workload launcher (the analog of reference: bin/run-pipeline.sh).
+#
+# The reference picks local JVM vs spark-submit and pins OMP_NUM_THREADS
+# because OpenBLAS misbehaves at high thread counts
+# (reference: bin/run-pipeline.sh:9-55). Here the accelerator runtime is
+# JAX/XLA: the script caps OpenMP threads for the native host kernels the
+# same way and forwards everything else to the Python CLI.
+#
+# Usage: bin/run-pipeline.sh <workload> [--flag value ...]
+#        KEYSTONE_PLATFORM=cpu KEYSTONE_DEVICES=8 bin/run-pipeline.sh ...
+set -euo pipefail
+
+here="$(cd "$(dirname "$0")/.." && pwd)"
+# The package is run from source (no install step); make it importable
+# from any working directory.
+export PYTHONPATH="$here${PYTHONPATH:+:$PYTHONPATH}"
+
+# Same policy as the reference: min(32, physical cores / 2), because the
+# OpenMP host kernels (SIFT/GMM/ingest) oversubscribe past that.
+if [[ -z "${OMP_NUM_THREADS:-}" ]]; then
+  cores=$(nproc 2>/dev/null || echo 8)
+  half=$(( cores / 2 ))
+  [[ $half -lt 1 ]] && half=1
+  [[ $half -gt 32 ]] && half=32
+  export OMP_NUM_THREADS=$half
+fi
+
+extra=()
+[[ -n "${KEYSTONE_PLATFORM:-}" ]] && extra+=(--platform "$KEYSTONE_PLATFORM")
+[[ -n "${KEYSTONE_DEVICES:-}" ]] && extra+=(--device-count "$KEYSTONE_DEVICES")
+
+exec python -m keystone_tpu "${extra[@]}" "$@"

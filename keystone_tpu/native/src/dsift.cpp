@@ -37,6 +37,15 @@ struct ScaleGeom {
   int nx, ny; // descriptor grid dims (0 if scale inactive)
 };
 
+// Floor division (C++ '/' truncates toward zero; the XLA grid math uses
+// Python floor division, and a negative numerator must stay negative here
+// or an almost-fitting scale gains a phantom grid row reading off the end
+// of the binned planes).
+inline int floordiv(int a, int b) {
+  int q = a / b, r = a % b;
+  return (r != 0 && ((r < 0) != (b < 0))) ? q - 1 : q;
+}
+
 ScaleGeom scale_geom(int xd, int yd, int s, int step_size, int bin_size,
                      int scales, int scale_step) {
   ScaleGeom g;
@@ -44,8 +53,8 @@ ScaleGeom scale_geom(int xd, int yd, int s, int step_size, int bin_size,
   g.step = step_size + s * scale_step;
   g.off = std::max(0, (1 + 2 * scales) - 3 * s);
   int span = (kSpatialBins - 1) * g.b;
-  g.nx = (xd - 1 - g.off - span) / g.step + 1;
-  g.ny = (yd - 1 - g.off - span) / g.step + 1;
+  g.nx = floordiv(xd - 1 - g.off - span, g.step) + 1;
+  g.ny = floordiv(yd - 1 - g.off - span, g.step) + 1;
   if (g.nx <= 0 || g.ny <= 0) g.nx = g.ny = 0;
   return g;
 }

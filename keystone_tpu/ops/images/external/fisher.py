@@ -100,7 +100,8 @@ class NativeGMMFisherVectorEstimator(Estimator):
 
     def fit(self, data: Dataset) -> NativeFisherVector:
         arrays = data if isinstance(data, ArrayDataset) else data.to_arrays()
-        x = np.asarray(arrays.data, dtype=np.float32)
+        # slice off mesh zero-padding before fitting, like the XLA estimator
+        x = np.asarray(arrays.data, dtype=np.float32)[: arrays.num_examples]
         if x.ndim == 3:
             x = x.reshape(-1, x.shape[-1])
         gmm = native_gmm_fit(x, self.k, seed=self.seed)

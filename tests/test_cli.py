@@ -1,0 +1,51 @@
+"""CLI tests (the scopt-parse analog of each workload's config parsing,
+reference: e.g. RandomPatchCifar.scala:101-114)."""
+
+import json
+
+import pytest
+
+from keystone_tpu.cli import add_config_arguments, build_config, main
+
+
+def test_list_workloads(capsys):
+    assert main(["--list"]) == 0
+    out = capsys.readouterr().out
+    for name in (
+        "mnist-random-fft",
+        "timit",
+        "voc-sift-fisher",
+        "imagenet-sift-lcs-fv",
+        "cifar-random-patch",
+        "amazon-reviews",
+        "newsgroups",
+        "stupid-backoff",
+    ):
+        assert name in out
+
+
+def test_dataclass_flag_generation():
+    import argparse
+
+    from keystone_tpu.pipelines.voc import SIFTFisherConfig
+
+    parser = argparse.ArgumentParser()
+    add_config_arguments(parser, SIFTFisherConfig)
+    args = parser.parse_args(
+        ["--desc-dim", "16", "--reg", "0.25", "--image-size", "64,48"]
+    )
+    config = build_config(SIFTFisherConfig, args)
+    assert config.desc_dim == 16
+    assert config.reg == 0.25
+    assert config.image_size == (64, 48)
+    assert config.vocab_size == 256  # untouched default
+
+
+def test_run_mnist_synthetic_through_cli(capsys):
+    # no train CSV → the workload generates synthetic data
+    rc = main(["mnist-random-fft", "--num-ffts", "2", "--block-size", "512"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    payload = json.loads(line)
+    assert payload["workload"] == "mnist-random-fft"
+    assert 0.0 <= payload["train_error"] <= 1.0
