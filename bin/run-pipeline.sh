@@ -30,4 +30,5 @@ extra=()
 [[ -n "${KEYSTONE_PLATFORM:-}" ]] && extra+=(--platform "$KEYSTONE_PLATFORM")
 [[ -n "${KEYSTONE_DEVICES:-}" ]] && extra+=(--device-count "$KEYSTONE_DEVICES")
 
-exec python -m keystone_tpu "${extra[@]}" "$@"
+# ${extra[@]+...} guard: empty-array expansion under set -u aborts on bash < 4.4
+exec python -m keystone_tpu ${extra[@]+"${extra[@]}"} "$@"

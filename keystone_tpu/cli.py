@@ -116,7 +116,10 @@ WORKLOADS: Dict[str, Tuple[str, str, str, Dict[str, Any], str]] = {
             "cifar", "RandomCifarConfig", "run", {"variant": v},
             f"CIFAR-10 {v} workload",
         )
-        for v in ("linear_pixels", "random", "random_patch", "random_patch_kernel")
+        for v in (
+            "linear_pixels", "random", "random_patch", "random_patch_kernel",
+            "random_patch_augmented", "random_patch_kernel_augmented",
+        )
     },
 }
 

@@ -55,7 +55,16 @@ from ..stats.core import _as_array_dataset
 
 
 def gaussian_kernel_block(xa, xb, gamma):
-    """exp(−γ‖a−b‖²) panel via one MXU matmul."""
+    """exp(−γ‖a−b‖²) panel via one MXU matmul. On TPU the panel goes
+    through the fused Pallas kernel (ops/pallas/gaussian.py) — tile-wise
+    MXU + VPU epilogue in VMEM, no HBM squared-distance intermediate."""
+    from ..pallas.gaussian import gaussian_kernel_block_pallas, pallas_supported
+
+    # The Pallas kernel takes gamma statically; inside jit/shard_map gamma
+    # is a tracer, so those call sites stay on the XLA path.
+    is_concrete = isinstance(gamma, (int, float, np.floating, np.integer))
+    if is_concrete and pallas_supported(int(xa.shape[1])):
+        return gaussian_kernel_block_pallas(xa, xb, float(gamma))
     an = jnp.sum(xa * xa, axis=1, keepdims=True)
     bn = jnp.sum(xb * xb, axis=1)
     sq = an - 2.0 * linalg.mm(xa, xb.T) + bn

@@ -1,5 +1,6 @@
 """Text / NLP operators (reference: nodes/nlp/)."""
 
+from .corenlp import CoreNLPFeatureExtractor, lemmatize
 from .indexers import NaiveBitPackIndexer, NGramIndexer
 from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
 from .text import (
@@ -16,6 +17,8 @@ from .text import (
 )
 
 __all__ = [
+    "CoreNLPFeatureExtractor",
+    "lemmatize",
     "HashingTF",
     "LowerCase",
     "NGramsCounts",

@@ -1,0 +1,117 @@
+"""Lemmatized, entity-normalized n-gram extraction.
+
+Capability equivalent of reference:
+nodes/nlp/CoreNLPFeatureExtractor.scala:18-45, which drives the CoreNLP
+wrapper (sista FastNLPProcessor) to tokenize → lemmatize → replace named
+entities with their type → emit per-sentence n-grams. That JVM/CoreNLP
+dependency has no place in a TPU framework's host path, so this is a
+self-contained re-implementation of the same contract:
+
+- sentences split on terminal punctuation;
+- tokens lemmatized by an English rule lemmatizer (irregular-form table +
+  ordered suffix rules, the morphy-style algorithm);
+- capitalized tokens that look like proper nouns (mid-sentence
+  capitalization, not sentence-initial) are replaced by the ``"ENTITY"``
+  tag — the structural analog of CoreNLP's NER-type substitution;
+- n-grams of the requested orders are emitted per sentence, joined by
+  spaces, sentence boundaries respected.
+
+Outputs differ from CoreNLP token-for-token (different lemmatizer, no
+statistical NER) exactly as any two NLP toolkits differ; the pipeline
+contract — ``str -> Seq[str]`` of normalized n-grams — is preserved.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Sequence
+
+from ...workflow.pipeline import Transformer
+
+# Irregular forms (the exceptions list every rule lemmatizer carries).
+_IRREGULAR = {
+    "is": "be", "are": "be", "was": "be", "were": "be", "been": "be",
+    "am": "be", "has": "have", "had": "have", "does": "do", "did": "do",
+    "done": "do", "goes": "go", "went": "go", "gone": "go",
+    "said": "say", "says": "say", "made": "make", "took": "take",
+    "taken": "take", "came": "come", "saw": "see", "seen": "see",
+    "got": "get", "gotten": "get", "gave": "give", "given": "give",
+    "knew": "know", "known": "know", "thought": "think", "found": "find",
+    "told": "tell", "became": "become", "left": "leave", "felt": "feel",
+    "brought": "bring", "held": "hold", "wrote": "write", "written": "write",
+    "stood": "stand", "lost": "lose", "paid": "pay", "met": "meet",
+    "ran": "run", "kept": "keep", "children": "child", "men": "man",
+    "women": "woman", "people": "person", "feet": "foot", "teeth": "tooth",
+    "mice": "mouse", "geese": "goose", "better": "good", "best": "good",
+    "worse": "bad", "worst": "bad",
+}
+
+# Ordered inflectional suffix rules (first match wins):
+# (suffix, replacement, min stem). Derivational suffixes (-er/-est/-ly)
+# are NOT stripped — a lemmatizer maps inflections only, and stripping
+# them mangles common words ("other", "really").
+_SUFFIX_RULES = [
+    ("sses", "ss", 1), ("ies", "y", 2), ("ying", "ie", 2), ("ing", "", 3),
+    ("tted", "t", 2), ("ed", "", 3), ("es", "e", 2), ("s", "", 3),
+]
+
+# Words ending in these are not plural-stripped ("this", "thus", "bus",
+# "glass" — already handled by sses — "analysis").
+_S_PROTECT = ("ss", "us", "is")
+
+_SENTENCE_SPLIT = re.compile(r"(?<=[.!?])\s+")
+_TOKEN = re.compile(r"[A-Za-z0-9']+")
+# Quirk preserved from the reference: '+' sits inside the character class
+# (literal plus survives normalization), reference:
+# CoreNLPFeatureExtractor.scala:42 uses the identical pattern.
+_NORMALIZE = re.compile(r"[^a-zA-Z0-9\s+]")
+
+ENTITY_TAG = "ENTITY"
+
+
+def lemmatize(word: str) -> str:
+    """Rule lemmatization of a lowercase word."""
+    if word in _IRREGULAR:
+        return _IRREGULAR[word]
+    for suffix, repl, min_stem in _SUFFIX_RULES:
+        if suffix == "s" and word.endswith(_S_PROTECT):
+            continue
+        if word.endswith(suffix) and len(word) - len(suffix) >= min_stem:
+            stem = word[: -len(suffix)] + repl
+            # doubling un-done: "running" -> "runn" -> "run"
+            if len(stem) >= 3 and stem[-1] == stem[-2] and stem[-1] not in "aeiouls":
+                stem = stem[:-1]
+            return stem
+    return word
+
+
+class CoreNLPFeatureExtractor(Transformer):
+    """str → list of lemmatized / entity-normalized n-gram strings
+    (reference: nodes/nlp/CoreNLPFeatureExtractor.scala:18-45)."""
+
+    def __init__(self, orders: Sequence[int]):
+        self.orders = list(orders)
+
+    def apply(self, text: str) -> List[str]:
+        sentences = []
+        for sent in _SENTENCE_SPLIT.split(text):
+            raw_tokens = _TOKEN.findall(sent)
+            tokens = []
+            for i, tok in enumerate(raw_tokens):
+                if i > 0 and tok[:1].isupper() and tok[1:].islower():
+                    # mid-sentence capitalization → proper-noun analog of
+                    # the reference's entity-type substitution
+                    tokens.append(ENTITY_TAG)
+                else:
+                    norm = _NORMALIZE.sub("", tok).lower()
+                    if norm:
+                        tokens.append(lemmatize(norm))
+            if tokens:
+                sentences.append(tokens)
+
+        out: List[str] = []
+        for n in self.orders:
+            for tokens in sentences:
+                for i in range(len(tokens) - n + 1):
+                    out.append(" ".join(tokens[i : i + n]))
+        return out

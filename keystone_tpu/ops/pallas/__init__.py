@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Each kernel has a pure-XLA sibling; Pallas versions are used on TPU where
+explicit VMEM tiling beats the XLA default schedule, and fall back
+elsewhere (interpret mode covers CPU testing).
+"""
+
+from .gaussian import gaussian_kernel_block_pallas, pallas_supported
+
+__all__ = ["gaussian_kernel_block_pallas", "pallas_supported"]

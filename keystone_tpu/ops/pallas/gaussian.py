@@ -1,0 +1,95 @@
+"""Fused Gaussian kernel panel: K = exp(−γ‖a−b‖²) in one Pallas kernel.
+
+This is the framework's hottest quadratic op — kernel ridge regression
+builds every K(X, X_block) panel from it (reference:
+nodes/learning/KernelGenerator.scala:90-206 computes the same panels via
+Breeze rank updates per partition). The XLA sibling
+(``ops.learning.kernel.gaussian_kernel_block``) materializes the (m, n)
+squared-distance intermediate in HBM before the exp; here each (TM, TN)
+tile goes MXU → VPU epilogue inside VMEM, so HBM sees only the final
+panel — one write instead of write+read+write at m·n·4 bytes each.
+
+Row norms are recomputed per tile from the operand tiles already resident
+in VMEM: d extra FLOPs per element against an HBM round-trip saved.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+TILE_M = 256
+TILE_N = 256
+# VMEM budget: a (TILE, d) fp32 operand tile must fit comfortably;
+# 256 × 8192 × 4 B = 8 MB is the practical ceiling of the ~16 MB budget.
+MAX_FUSED_DIM = 8192
+
+
+def pallas_supported(d: int) -> bool:
+    """Whether the Pallas path should be dispatched to.
+
+    Opt-in via ``KEYSTONE_PALLAS_GAUSSIAN=1``: measured on a single v5p
+    chip (m=8192, n=4096, d=1024), XLA's matmul emitter + fused exp
+    epilogue ran ~5x faster than this kernel, so XLA stays the default.
+    The kernel remains for hosts/shapes where explicit VMEM tiling wins
+    and as the base for the fused ring-rotation variant.
+    """
+    import os
+
+    if os.environ.get("KEYSTONE_PALLAS_GAUSSIAN", "0") != "1":
+        return False
+    if d > MAX_FUSED_DIM:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(a_ref, b_ref, o_ref, *, gamma: float):
+    a = a_ref[:]  # (TILE_M, d)
+    b = b_ref[:]  # (TILE_N, d)
+    ab = jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    an = jnp.sum(a * a, axis=1, keepdims=True)
+    bn = jnp.sum(b * b, axis=1)[None, :]
+    sq = jnp.maximum(an - 2.0 * ab + bn, 0.0)
+    o_ref[:] = jnp.exp(-gamma * sq)
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "interpret"))
+def gaussian_kernel_block_pallas(xa, xb, gamma: float, interpret: bool = False):
+    """exp(−γ‖a−b‖²) panel, tiled MXU matmul with fused VPU epilogue.
+
+    xa: (m, d), xb: (n, d) — padded internally to tile multiples; the
+    returned panel is sliced back to (m, n). Zero-padded rows produce
+    harmless exp(−γ·‖real−0‖²) values that the slice discards.
+    """
+    xa = jnp.asarray(xa, jnp.float32)
+    xb = jnp.asarray(xb, jnp.float32)
+    m, d = xa.shape
+    n = xb.shape[0]
+    mp = -(-m // TILE_M) * TILE_M
+    np_ = -(-n // TILE_N) * TILE_N
+    if mp != m:
+        xa = jnp.pad(xa, ((0, mp - m), (0, 0)))
+    if np_ != n:
+        xb = jnp.pad(xb, ((0, np_ - n), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, gamma=float(gamma)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        grid=(mp // TILE_M, np_ // TILE_N),
+        in_specs=[
+            pl.BlockSpec((TILE_M, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_M, TILE_N), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(xa, xb)
+    return out[:m, :n]

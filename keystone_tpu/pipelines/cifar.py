@@ -68,6 +68,10 @@ class RandomCifarConfig:
     gamma: float = 2e-4
     kernel_block_size: int = 2048
     num_epochs: int = 1
+    # augmented variants (reference: RandomPatchCifarAugmented.scala):
+    num_random_images_augment: int = 10
+    augment_img_size: int = 24
+    flip_chance: float = 0.5
     seed: int = 12334
 
 
@@ -155,6 +159,7 @@ def build_random_patch(
     filters: Optional[np.ndarray] = None,
     whitener: Optional[ZCAWhitener] = None,
     solver: str = "block",
+    with_classifier: bool = True,
 ) -> Pipeline:
     """The conv → rectify → pool → solve pipeline shared by RandomCifar
     (random filters), RandomPatchCifar (learned filters, block solver) and
@@ -200,11 +205,70 @@ def build_random_patch(
         fitted = scaled.then_label_estimator(LinearMapEstimator(config.reg), train_images, train_labels)
     else:
         raise ValueError(f"unknown solver {solver!r}")
-    return fitted >> MaxClassifier()
+    return fitted >> MaxClassifier() if with_classifier else fitted
+
+
+def run_augmented(config: RandomCifarConfig, solver: str = "block") -> dict:
+    """Augmented random-patch workload
+    (reference: RandomPatchCifarAugmented.scala:33-105,
+    RandomPatchCifarAugmentedKernel.scala): train on random
+    ``augment_img_size`` crops with coin-flip horizontal flips and
+    replicated labels; test on 10 deterministic views per image (center +
+    four corners, each flipped) scored by the augmented-examples evaluator
+    grouped per source image."""
+    from ..evaluation.augmented import AugmentedExamplesEvaluator
+    from ..ops.images import CenterCornerPatcher, RandomImageTransformer, RandomPatcher
+    from ..utils.image import flip_horizontal
+
+    start = time.time()
+    train = _load(config.train_location, config.sample_frac, config.seed)
+    train_images = ArrayDataset(train.data["image"], train.num_examples)
+    filters, whitener = learn_random_patch_filters(train_images, config)
+
+    size = config.augment_img_size
+    mult = config.num_random_images_augment
+    augmented_images = RandomImageTransformer(
+        config.flip_chance, flip_horizontal, seed=config.seed
+    ).apply_batch(
+        RandomPatcher(mult, size, size, seed=config.seed).apply_batch(train_images)
+    )
+    augmented_train = ArrayDataset(
+        {"image": augmented_images.data, "label": np.repeat(
+            np.asarray(train.data["label"])[: train.num_examples], mult)},
+        len(augmented_images),
+    )
+    pipeline = build_random_patch(
+        augmented_train, config, filters, whitener, solver=solver,
+        with_classifier=False,  # the augmented evaluator needs raw scores
+    )
+
+    results = {"pipeline": pipeline, "num_augmented_train": len(augmented_images)}
+    if config.test_location:
+        test = load_cifar(config.test_location)
+        test_images = ArrayDataset(test.data["image"], test.num_examples)
+        test_views = CenterCornerPatcher(size, size, horizontal_flips=True).apply_batch(
+            test_images
+        )
+        num_views = 10  # center + 4 corners, each with a flip
+        n_test = test.num_examples
+        ids = np.repeat(np.arange(n_test), num_views)
+        view_labels = np.repeat(np.asarray(test.data["label"])[:n_test], num_views)
+        predictions = pipeline(test_views)
+        # score on raw per-view scores: drop the trailing MaxClassifier
+        scores = predictions.get() if hasattr(predictions, "get") else predictions
+        evaluator = AugmentedExamplesEvaluator(ids, NUM_CLASSES)
+        test_eval = evaluator.evaluate(scores, view_labels)
+        logger.info("Test error is: %s", test_eval.total_error)
+        results["test_error"] = test_eval.total_error
+    results["seconds"] = time.time() - start
+    return results
 
 
 def run(config: RandomCifarConfig, variant: str = "random_patch") -> dict:
     """Run a CIFAR workload end to end; returns train/test error."""
+    if variant in ("random_patch_augmented", "random_patch_kernel_augmented"):
+        return run_augmented(config, solver="kernel" if "kernel" in variant else "block")
+
     start = time.time()
     train = _load(config.train_location, config.sample_frac, config.seed)
     train_images = ArrayDataset(train.data["image"], train.num_examples)

@@ -131,3 +131,39 @@ def test_all_sparse_features_order():
     docs = ObjectDataset([[("x", 1.0)], [("y", 1.0), ("x", 1.0)], [("z", 1.0)]])
     vec = AllSparseFeatures().fit(docs)
     assert vec.feature_space == {"x": 0, "y": 1, "z": 2}
+
+
+# ------------------------------------------------------- CoreNLP analog
+
+
+def test_corenlp_extractor_lemmatized_ngrams():
+    from keystone_tpu.ops.nlp.corenlp import CoreNLPFeatureExtractor
+
+    ext = CoreNLPFeatureExtractor(orders=[1, 2])
+    out = ext.apply("The cats were running. Dogs barked loudly!")
+    # lemmatization: cats->cat, were->be, running->run, dogs->dog,
+    # barked->bark; sentence boundary respected (no "run dog" bigram)
+    assert "cat" in out and "be" in out and "run" in out
+    assert "dog" in out and "bark" in out
+    assert "run dog" not in out and "run. dog" not in out
+    assert "the cat" in out  # bigram within sentence 1
+
+
+def test_corenlp_extractor_entity_tagging():
+    from keystone_tpu.ops.nlp.corenlp import ENTITY_TAG, CoreNLPFeatureExtractor
+
+    ext = CoreNLPFeatureExtractor(orders=[1])
+    out = ext.apply("Yesterday we visited Paris together.")
+    assert ENTITY_TAG in out          # mid-sentence proper noun replaced
+    assert "paris" not in out
+    assert "yesterday" in out         # sentence-initial word kept
+
+
+def test_lemmatize_rules():
+    from keystone_tpu.ops.nlp.corenlp import lemmatize
+
+    assert lemmatize("studies") == "study"
+    assert lemmatize("running") == "run"
+    assert lemmatize("children") == "child"
+    assert lemmatize("walked") == "walk"
+    assert lemmatize("glasses") == "glass"

@@ -1,0 +1,42 @@
+"""Pallas kernel tests, run in interpret mode on CPU (on-TPU execution is
+covered by bench/driver runs)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.pallas.gaussian import (
+    TILE_M,
+    TILE_N,
+    gaussian_kernel_block_pallas,
+)
+
+
+def _reference(xa, xb, gamma):
+    an = np.sum(xa * xa, axis=1, keepdims=True)
+    bn = np.sum(xb * xb, axis=1)
+    sq = np.maximum(an - 2.0 * xa @ xb.T + bn, 0.0)
+    return np.exp(-gamma * sq)
+
+
+@pytest.mark.parametrize(
+    "m,n",
+    [
+        (TILE_M, TILE_N),          # exact tiles
+        (TILE_M + 37, TILE_N - 3),  # padding both ways
+        (50, 70),                  # single partial tile
+    ],
+)
+def test_pallas_gaussian_panel_matches_reference(m, n):
+    rng = np.random.default_rng(0)
+    d, gamma = 24, 0.135
+    xa = rng.standard_normal((m, d)).astype(np.float32)
+    xb = rng.standard_normal((n, d)).astype(np.float32)
+    out = np.asarray(gaussian_kernel_block_pallas(xa, xb, gamma, interpret=True))
+    np.testing.assert_allclose(out, _reference(xa, xb, gamma), rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_gaussian_self_panel_diag_is_one():
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((40, 8)).astype(np.float32)
+    out = np.asarray(gaussian_kernel_block_pallas(x, x, 0.5, interpret=True))
+    np.testing.assert_allclose(np.diag(out), 1.0, atol=1e-5)

@@ -69,3 +69,32 @@ def test_random_patch_cifar_learns(solver):
 
     ev = MulticlassClassifierEvaluator(10).evaluate(pipeline(images), train.data["label"])
     assert ev.total_error < 0.2
+
+
+def _write_cifar_binary(path, ds):
+    """Encode a synthetic ArrayDataset back to CIFAR binary records."""
+    images = np.asarray(ds.data["image"]).astype(np.uint8)  # (n, 32, 32, 3)
+    labels = np.asarray(ds.data["label"]).astype(np.uint8)
+    planes = images.transpose(0, 3, 1, 2).reshape(len(labels), -1)  # (n, 3072)
+    records = np.concatenate([labels[:, None], planes], axis=1).astype(np.uint8)
+    records.tofile(path)
+
+
+def test_random_patch_cifar_augmented_learns(tmp_path):
+    train = make_synthetic_cifar(96, seed=2)
+    path = tmp_path / "cifar_train.bin"
+    _write_cifar_binary(str(path), train)
+    config = cifar.RandomCifarConfig(
+        train_location=str(path),
+        test_location=str(path),
+        num_filters=24,
+        patch_steps=4,
+        reg=1.0,
+        num_random_images_augment=3,
+        seed=3,
+    )
+    results = cifar.run(config, variant="random_patch_augmented")
+    assert results["num_augmented_train"] == 96 * 3
+    # train == test and the classes are linearly separable prototypes:
+    # augmented voting should beat chance (0.9 error) comfortably
+    assert results["test_error"] < 0.5
