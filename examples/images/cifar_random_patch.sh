@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# CIFAR-10 random-patch workload (reference:
+# examples/images/cifar_random_patch.sh — same hyperparameters).
+set -euo pipefail
+
+KEYSTONE_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"/../..
+: "${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}"
+mkdir -p "$EXAMPLE_DATA_DIR"
+
+if [[ ! ( -f $EXAMPLE_DATA_DIR/cifar_train.bin && -f $EXAMPLE_DATA_DIR/cifar_test.bin ) ]]; then
+    tmp="${TMPDIR:-/tmp}"
+    wget -O "$tmp/cifar-10-binary.tar.gz" http://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz
+    tar zxvf "$tmp/cifar-10-binary.tar.gz" -C "$tmp"
+    cat "$tmp"/cifar-10-batches-bin/data_batch*.bin > "$EXAMPLE_DATA_DIR/cifar_train.bin"
+    mv "$tmp/cifar-10-batches-bin/test_batch.bin" "$EXAMPLE_DATA_DIR/cifar_test.bin"
+    rm -rf "$tmp/cifar-10-batches-bin" "$tmp/cifar-10-binary.tar.gz"
+fi
+
+"$KEYSTONE_DIR/bin/run-pipeline.sh" cifar-random-patch \
+  --train-location "$EXAMPLE_DATA_DIR/cifar_train.bin" \
+  --test-location "$EXAMPLE_DATA_DIR/cifar_test.bin" \
+  --num-filters 10000 \
+  --reg 3000 \
+  --whitening-epsilon 1e-5

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# MNIST random-FFT workload (reference: examples/images/mnist_random_fft.sh,
+# README.md:14-28 — numFFTs=4, blockSize=2048). With no data present the
+# workload runs on synthetic data.
+set -euo pipefail
+
+KEYSTONE_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"/../..
+: "${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}"
+
+train=""
+test=""
+[[ -f $EXAMPLE_DATA_DIR/train-mnist-dense-with-labels.data ]] \
+  && train="--train-location $EXAMPLE_DATA_DIR/train-mnist-dense-with-labels.data"
+[[ -f $EXAMPLE_DATA_DIR/test-mnist-dense-with-labels.data ]] \
+  && test="--test-location $EXAMPLE_DATA_DIR/test-mnist-dense-with-labels.data"
+
+# shellcheck disable=SC2086
+"$KEYSTONE_DIR/bin/run-pipeline.sh" mnist-random-fft \
+  $train $test \
+  --num-ffts 4 \
+  --block-size 2048

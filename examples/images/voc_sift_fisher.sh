@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# VOC 2007 SIFT + Fisher Vector workload (reference:
+# examples/images/voc_sift_fisher.sh — same hyperparameters).
+set -euo pipefail
+
+KEYSTONE_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"/../..
+: "${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}"
+
+"$KEYSTONE_DIR/bin/run-pipeline.sh" voc-sift-fisher \
+  --train-location "$EXAMPLE_DATA_DIR/VOCtrainval_06-Nov-2007.tar" \
+  --test-location "$EXAMPLE_DATA_DIR/VOCtest_06-Nov-2007.tar" \
+  --label-path "$EXAMPLE_DATA_DIR/voc_labels.csv" \
+  --desc-dim 80 \
+  --vocab-size 256 \
+  --reg 0.5

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# 20 Newsgroups n-gram workload (reference:
+# examples/text/newsgroups_ngrams_tfidf.sh).
+set -euo pipefail
+
+KEYSTONE_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"/../..
+: "${EXAMPLE_DATA_DIR:=$KEYSTONE_DIR/example_data}"
+
+"$KEYSTONE_DIR/bin/run-pipeline.sh" newsgroups \
+  --train-location "$EXAMPLE_DATA_DIR/20news-bydate-train" \
+  --test-location "$EXAMPLE_DATA_DIR/20news-bydate-test"

@@ -76,6 +76,11 @@ class RandomCifarConfig:
 
 
 def _load(config_location: str, sample_frac: Optional[float], seed: int) -> ArrayDataset:
+    if not config_location:
+        raise ValueError(
+            "CIFAR workloads need --train-location pointing at a CIFAR-10 "
+            "binary file (see examples/images/cifar_random_patch.sh)"
+        )
     data = load_cifar(config_location)
     if sample_frac is not None:
         rng = np.random.default_rng(seed)

@@ -36,6 +36,7 @@ from .rules import (
     default_optimizer,
 )
 from .optimize import DataStats, NodeOptimizationRule, Optimizable
+from .tracing import PipelineTrace, current_trace, trace
 
 __all__ = [
     "Graph", "NodeId", "SinkId", "SourceId",
@@ -49,4 +50,5 @@ __all__ = [
     "Rule", "Batch", "RuleExecutor", "EquivalentNodeMergeRule",
     "UnusedBranchRemovalRule", "default_optimizer", "auto_caching_optimizer",
     "DataStats", "NodeOptimizationRule", "Optimizable",
+    "PipelineTrace", "current_trace", "trace",
 ]

@@ -20,6 +20,7 @@ from typing import Dict, Optional
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
 from .operators import Expression
 from .prefix import Prefix, find_prefix
+from .tracing import timed_execute
 
 
 class PipelineEnv:
@@ -99,7 +100,7 @@ class GraphExecutor:
 
         deps = [self.execute(d) for d in graph.get_dependencies(graph_id)]
         op = graph.get_operator(graph_id)
-        expression = op.execute(deps)
+        expression = timed_execute(op, deps)
 
         # Prefix write-back: make this node's result reusable by later
         # pipelines (reference: GraphExecutor.scala:65-71).

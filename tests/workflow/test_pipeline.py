@@ -136,3 +136,41 @@ def test_fitted_pipeline_composes():
     fitted = (Plus(1) >> est.with_data(ObjectDataset([0.0]))).fit()
     pipe2 = fitted >> Plus(100)
     assert pipe2(0.0).get() == 101.0
+
+
+def test_pipeline_tracing_records_per_op_timings():
+    import numpy as np
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.stats.core import LinearRectifier, NormalizeRows
+    from keystone_tpu.workflow.tracing import trace
+
+    ds = ArrayDataset(np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32))
+    pipeline = LinearRectifier(0.0).to_pipeline() >> NormalizeRows()
+    with trace() as t:
+        pipeline(ds).get()
+    labels = [x.label for x in t.timings]
+    assert any("LinearRectifier" in l for l in labels)
+    assert any("NormalizeRows" in l for l in labels)
+    assert t.total_seconds > 0
+    assert "TOTAL" in t.report()
+
+
+def test_tracing_off_by_default_keeps_laziness():
+    from keystone_tpu.data.dataset import ObjectDataset
+    from keystone_tpu.workflow.pipeline import Transformer
+    from keystone_tpu.workflow.tracing import current_trace
+
+    assert current_trace() is None
+
+    calls = []
+
+    class Probe(Transformer):
+        def apply(self, x):
+            calls.append(x)
+            return x + 1
+
+    result = Probe().to_pipeline()(ObjectDataset([1, 2]))
+    assert calls == []  # untraced application stays lazy until forced
+    assert result.get().collect() == [2, 3]
+    assert calls == [1, 2]
