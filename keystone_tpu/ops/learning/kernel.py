@@ -46,7 +46,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ...data.dataset import ArrayDataset, Dataset
 from ...parallel import linalg
 from ...parallel.collectives import shard_map
-from ...parallel.mesh import DATA_AXIS, get_mesh
+from ...parallel.mesh import DATA_AXIS, REPLICA_AXIS, get_mesh, row_axes, row_shard_count
 from ...workflow.pipeline import BatchTransformer, Estimator, LabelEstimator, Transformer
 from ..stats.core import _as_array_dataset
 
@@ -161,8 +161,8 @@ class KernelRidgeRegression(LabelEstimator):
         gamma = self.kernel_generator.gamma
 
         bs = min(self.block_size, n)
-        ndev = mesh.shape[DATA_AXIS]
-        # pad rows to lcm-ish: multiple of both block size and device count
+        ndev = row_shard_count(mesh)
+        # pad rows to lcm-ish: multiple of both block size and shard count
         n_pad = _round_up_multiple(n, bs, ndev)
 
         x = jnp.asarray(features.data, jnp.float32)
@@ -190,13 +190,14 @@ class KernelRidgeRegression(LabelEstimator):
 
 @functools.lru_cache(maxsize=None)
 def _krr_fit(mesh: Mesh, bs: int):
-    ndev = mesh.shape[DATA_AXIS]
+    axes = row_axes(mesh)
+    ndev = row_shard_count(mesh)
 
     def per_device(x_local, y_local, starts, gamma, lam, n):
         n_local, d = x_local.shape
         k = y_local.shape[1]
         n_pad = n_local * ndev
-        dev = lax.axis_index(DATA_AXIS)
+        dev = _linear_shard_index(mesh, axes)
         global_rows = dev * n_local + jnp.arange(n_local)
         row_valid = (global_rows < n).astype(x_local.dtype)
         eye = jnp.eye(bs, dtype=x_local.dtype)
@@ -208,7 +209,7 @@ def _krr_fit(mesh: Mesh, bs: int):
             idx = jnp.where(inside, pos, bs)  # bs row = dropped
             out = jnp.zeros((bs + 1, mat.shape[1]), mat.dtype)
             out = out.at[idx].add(mat * inside[:, None].astype(mat.dtype))
-            return lax.psum(out[:bs], DATA_AXIS)
+            return lax.psum(out[:bs], axes)
 
         def step(w, s):
             xb = gather_block(x_local, s)                     # (bs, d) replicated
@@ -216,7 +217,7 @@ def _krr_fit(mesh: Mesh, bs: int):
             k_panel = gaussian_kernel_block(x_local, xb, gamma)
             k_panel = k_panel * row_valid[:, None] * col_valid[None, :]
             w_rows = lax.dynamic_slice(w, (dev * n_local, 0), (n_local, k))
-            resid = lax.psum(linalg.mm(k_panel.T, w_rows), DATA_AXIS)  # (bs, k)
+            resid = lax.psum(linalg.mm(k_panel.T, w_rows), axes)  # (bs, k)
             kbb = gaussian_kernel_block(xb, xb, gamma)
             kbb = kbb * col_valid[:, None] * col_valid[None, :]
             w_b_old = lax.dynamic_slice(w, (s, 0), (bs, k))
@@ -234,7 +235,7 @@ def _krr_fit(mesh: Mesh, bs: int):
     fn = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(), P(), P(), P()),
+        in_specs=(P(axes, None), P(axes, None), P(), P(), P(), P()),
         out_specs=P(),
     )
     return jax.jit(fn)
@@ -259,7 +260,7 @@ class KernelBlockLinearMapper(BatchTransformer):
 
     def apply_arrays(self, x):
         mesh = get_mesh()
-        ndev = mesh.shape[DATA_AXIS]
+        ndev = row_shard_count(mesh)
         m = x.shape[0]
         m_pad = _round_up_multiple(m, ndev)
         xt = linalg.prepare_row_sharded(_pad_rows_to(jnp.asarray(x, jnp.float32), m_pad), mesh)
@@ -273,29 +274,52 @@ class KernelBlockLinearMapper(BatchTransformer):
 
 @functools.lru_cache(maxsize=None)
 def _ring_kernel_apply(mesh: Mesh):
-    ndev = mesh.shape[DATA_AXIS]
+    axes = row_axes(mesh)
+    nd = mesh.shape[DATA_AXIS]
+    nr = mesh.shape.get(REPLICA_AXIS, 1)
+    nshards = nd * nr
 
     def per_device(xt_local, xs, ws, gamma):
+        data_perm = [(j, (j + 1) % nd) for j in range(nd)]
+        replica_perm = [(j, (j + 1) % nr) for j in range(nr)]
+
+        def hop_replica(val):
+            return lax.ppermute(val, REPLICA_AXIS, replica_perm)
+
         def ring_step(i, carry):
             acc, xs, ws = carry
             panel = gaussian_kernel_block(xt_local, xs, gamma)
             acc = acc + linalg.mm(panel, ws)
-            perm = [(j, (j + 1) % ndev) for j in range(ndev)]
-            xs = lax.ppermute(xs, DATA_AXIS, perm)
-            ws = lax.ppermute(ws, DATA_AXIS, perm)
+            # inner ICI ring every step; after each full data cycle the
+            # shards hop once across the DCN replica ring, so nd*nr steps
+            # visit every (replica, data) shard exactly once.
+            xs = lax.ppermute(xs, DATA_AXIS, data_perm)
+            ws = lax.ppermute(ws, DATA_AXIS, data_perm)
+            if nr > 1:
+                do_hop = (i + 1) % nd == 0
+                xs = lax.cond(do_hop, hop_replica, lambda v: v, xs)
+                ws = lax.cond(do_hop, hop_replica, lambda v: v, ws)
             return acc, xs, ws
 
         acc0 = jnp.zeros((xt_local.shape[0], ws.shape[1]), xt_local.dtype)
-        acc, _, _ = lax.fori_loop(0, ndev, ring_step, (acc0, xs, ws))
+        acc, _, _ = lax.fori_loop(0, nshards, ring_step, (acc0, xs, ws))
         return acc
 
     fn = shard_map(
         per_device,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
-        out_specs=P(DATA_AXIS, None),
+        in_specs=(P(axes, None), P(axes, None), P(axes, None), P()),
+        out_specs=P(axes, None),
     )
     return jax.jit(fn)
+
+
+def _linear_shard_index(mesh: Mesh, axes):
+    """Row-major linear index of this device's shard over ``axes``."""
+    idx = jnp.int32(0)
+    for axis in axes:
+        idx = idx * mesh.shape[axis] + lax.axis_index(axis)
+    return idx
 
 
 # -------------------------------------------------------------------- utils

@@ -28,7 +28,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .collectives import shard_map
-from .mesh import DATA_AXIS, get_mesh
+from .mesh import DATA_AXIS, get_mesh, row_axes, row_shard_count
 
 
 # Solver matmuls run at full fp32 on the MXU: linear systems are far more
@@ -43,7 +43,7 @@ def mm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def _row_sharded(mesh: Mesh, a: jnp.ndarray) -> jnp.ndarray:
-    spec = P(DATA_AXIS, *([None] * (a.ndim - 1)))
+    spec = P(row_axes(mesh), *([None] * (a.ndim - 1)))
     target = NamedSharding(mesh, spec)
     current = getattr(a, "sharding", None)
     # Skip the placement when the array is already laid out correctly —
@@ -68,8 +68,7 @@ def _pad_rows(a: np.ndarray, multiple: int) -> jnp.ndarray:
 def prepare_row_sharded(a, mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """Zero-pad rows to the mesh data-axis size and place sharded."""
     mesh = mesh or get_mesh()
-    ndev = mesh.shape[DATA_AXIS]
-    return _row_sharded(mesh, _pad_rows(jnp.asarray(a), ndev))
+    return _row_sharded(mesh, _pad_rows(jnp.asarray(a), row_shard_count(mesh)))
 
 
 # ------------------------------------------------------------------ gram/solve
@@ -82,24 +81,28 @@ def prepare_row_sharded(a, mesh: Optional[Mesh] = None) -> jnp.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _gram_fn(mesh: Mesh):
-    def f(a_local):
-        return lax.psum(mm(a_local.T, a_local), DATA_AXIS)
+    axes = row_axes(mesh)
 
-    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS, None), out_specs=P()))
+    def f(a_local):
+        return lax.psum(mm(a_local.T, a_local), axes)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axes, None), out_specs=P()))
 
 
 @functools.lru_cache(maxsize=None)
 def _gram2_fn(mesh: Mesh):
+    axes = row_axes(mesh)
+
     def f2(a_local, b_local):
-        ata = lax.psum(mm(a_local.T, a_local), DATA_AXIS)
-        atb = lax.psum(mm(a_local.T, b_local), DATA_AXIS)
+        ata = lax.psum(mm(a_local.T, a_local), axes)
+        atb = lax.psum(mm(a_local.T, b_local), axes)
         return ata, atb
 
     return jax.jit(
         shard_map(
             f2,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+            in_specs=(P(axes, None), P(axes, None)),
             out_specs=(P(), P()),
         )
     )
@@ -107,18 +110,20 @@ def _gram2_fn(mesh: Mesh):
 
 @functools.lru_cache(maxsize=None)
 def _gram_with_sums_fn(mesh: Mesh):
+    axes = row_axes(mesh)
+
     def f(a_local, b_local):
-        ata = lax.psum(mm(a_local.T, a_local), DATA_AXIS)
-        atb = lax.psum(mm(a_local.T, b_local), DATA_AXIS)
-        sa = lax.psum(jnp.sum(a_local, axis=0), DATA_AXIS)
-        sb = lax.psum(jnp.sum(b_local, axis=0), DATA_AXIS)
+        ata = lax.psum(mm(a_local.T, a_local), axes)
+        atb = lax.psum(mm(a_local.T, b_local), axes)
+        sa = lax.psum(jnp.sum(a_local, axis=0), axes)
+        sb = lax.psum(jnp.sum(b_local, axis=0), axes)
         return ata, atb, sa, sb
 
     return jax.jit(
         shard_map(
             f,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None)),
+            in_specs=(P(axes, None), P(axes, None)),
             out_specs=(P(), P(), P(), P()),
         )
     )
@@ -199,13 +204,15 @@ def tsqr_r(a: jnp.ndarray, mesh: Optional[Mesh] = None) -> jnp.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _tsqr_fn(mesh: Mesh):
+    axes = row_axes(mesh)
+
     def f(a_local):
         d = a_local.shape[1]
         r_local = jnp.linalg.qr(a_local, mode="r")
-        stacked = lax.all_gather(r_local, DATA_AXIS)  # (ndev, min(n_local,d), d)
+        stacked = lax.all_gather(r_local, axes)  # (n_shards, min(n_local,d), d)
         return jnp.linalg.qr(stacked.reshape(-1, d), mode="r")
 
-    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(DATA_AXIS, None), out_specs=P()))
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axes, None), out_specs=P()))
 
 
 @jax.jit
@@ -259,6 +266,8 @@ def block_coordinate_descent(
 
 @functools.lru_cache(maxsize=None)
 def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
+    axes = row_axes(mesh)
+
     def per_device(a_local, y_local, reg):
         d = a_local.shape[1]
         k = y_local.shape[1]
@@ -273,8 +282,8 @@ def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
             a_b = lax.dynamic_slice(a_local, (0, start), (a_local.shape[0], block_size))
             w_b = lax.dynamic_slice(w, (start, 0), (block_size, k))
             r_local = y_local - p_local + mm(a_b, w_b)
-            g = lax.psum(mm(a_b.T, a_b), DATA_AXIS)
-            c = lax.psum(mm(a_b.T, r_local), DATA_AXIS)
+            g = lax.psum(mm(a_b.T, a_b), axes)
+            c = lax.psum(mm(a_b.T, r_local), axes)
             factor = jax.scipy.linalg.cho_factor(g + reg * eye, lower=True)
             w_b_new = jax.scipy.linalg.cho_solve(factor, c)
             p_local = p_local + mm(a_b, w_b_new - w_b)
@@ -289,7 +298,7 @@ def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
         shard_map(
             per_device,
             mesh=mesh,
-            in_specs=(P(DATA_AXIS, None), P(DATA_AXIS, None), P()),
+            in_specs=(P(axes, None), P(axes, None), P()),
             out_specs=P(),
         )
     )

@@ -1,0 +1,155 @@
+"""Utility operator tests: gather/combine, splitters, cache/shuffle,
+sparse feature spaces, format conversions.
+
+Mirrors the reference's per-node suites (reference:
+nodes/util/*Suite.scala — VectorSplitterSuite, ClassLabelIndicatorsSuite,
+TopKClassifierSuite, SparseFeatureVectorizerSuite etc.).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset, ObjectDataset
+from keystone_tpu.ops.stats.core import Sampler
+from keystone_tpu.ops.util.labels import (
+    ClassLabelIndicators,
+    MaxClassifier,
+    MultiLabelIndicators,
+    TopKClassifier,
+)
+from keystone_tpu.ops.util.misc import CacherOperator, ShufflerOperator
+from keystone_tpu.ops.util.sparse import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+)
+from keystone_tpu.ops.util.vectors import (
+    Densify,
+    MatrixVectorizer,
+    Sparsify,
+    VectorCombiner,
+    VectorSplitter,
+)
+from keystone_tpu.workflow.pipeline import Pipeline, Transformer
+
+
+# ------------------------------------------------------------------ labels
+
+
+def test_class_label_indicators_pm_one():
+    out = np.asarray(
+        ClassLabelIndicators(4).apply_arrays(np.array([0, 2, 3]))
+    )
+    expected = np.full((3, 4), -1.0)
+    expected[0, 0] = expected[1, 2] = expected[2, 3] = 1.0
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_multi_label_indicators():
+    out = np.asarray(MultiLabelIndicators(5).apply([1, 3]))
+    expected = np.full(5, -1.0)
+    expected[[1, 3]] = 1.0
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_top_k_classifier_ordering():
+    scores = np.array([[0.1, 0.9, 0.5, 0.3]])
+    out = np.asarray(TopKClassifier(3).apply_arrays(scores))
+    np.testing.assert_array_equal(out[0], [1, 2, 3])
+    assert np.asarray(MaxClassifier().apply_arrays(scores))[0] == 1
+
+
+# ------------------------------------------------------------- split/combine
+
+
+def test_vector_splitter_blocks_and_roundtrip():
+    x = np.arange(24, dtype=np.float32).reshape(4, 6)
+    blocks = VectorSplitter(4).split(ArrayDataset(x))
+    assert [b.data.shape[1] for b in blocks] == [4, 2]
+    recombined = np.asarray(
+        VectorCombiner().apply_arrays(tuple(b.data for b in blocks))
+    )
+    np.testing.assert_array_equal(recombined, x)
+
+
+def test_vector_combiner_single_datum():
+    out = VectorCombiner().apply([np.array([1.0, 2.0]), np.array([3.0])])
+    np.testing.assert_array_equal(out, [1.0, 2.0, 3.0])
+
+
+def test_matrix_vectorizer_flattens():
+    x = np.arange(12).reshape(2, 3, 2)
+    assert MatrixVectorizer().apply_arrays(x).shape == (2, 6)
+
+
+# ---------------------------------------------------------------- gather
+
+
+def test_pipeline_gather_merges_branches():
+    doubler = Transformer.from_fn(lambda v: v * 2.0, name="double")
+    negator = Transformer.from_fn(lambda v: -v, name="neg")
+    gathered = Pipeline.gather([doubler, negator]) >> Transformer.from_fn(
+        lambda pair: pair[0] + pair[1], name="sum"
+    )
+    out = gathered(ObjectDataset([1.0, 2.0])).get().collect()
+    assert out == [1.0, 2.0]  # 2v + (−v) = v
+
+
+# --------------------------------------------------------------- cache/shuffle
+
+
+def test_cacher_is_identity_and_forces():
+    ds = ObjectDataset([1, 2, 3])
+    out = CacherOperator().batch_transform([ds])
+    assert out.collect() == [1, 2, 3]
+
+
+def test_shuffler_preserves_multiset():
+    ds = ObjectDataset(list(range(20)))
+    out = ShufflerOperator(seed=1).batch_transform([ds])
+    assert sorted(out.collect()) == list(range(20))
+    assert out.collect() != list(range(20))  # actually shuffled at n=20
+
+
+def test_sampler_subsamples_without_replacement():
+    ds = ObjectDataset(list(range(100)))
+    out = Sampler(10, seed=0).apply_batch(ds).collect()
+    assert len(out) == 10 == len(set(out))
+
+
+# ------------------------------------------------------------------- sparse
+
+
+def _docs():
+    return ObjectDataset(
+        [
+            [("a", 1.0), ("b", 2.0)],
+            [("a", 1.0), ("c", 3.0)],
+            [("a", 2.0), ("b", 1.0), ("d", 4.0)],
+        ]
+    )
+
+
+def test_common_sparse_features_top_k():
+    # "a" appears 3x, "b" 2x; top-2 space is {a, b}
+    vec = CommonSparseFeatures(2).fit(_docs())
+    mat = vec.apply_batch(_docs())
+    dense = np.asarray(Densify()(mat).get().data)
+    assert dense.shape == (3, 2)
+    # doc 1 has only "a" from the kept space
+    assert (dense != 0).sum(axis=1).tolist() == [2, 1, 2]
+
+
+def test_all_sparse_features_full_space():
+    vec = AllSparseFeatures().fit(_docs())
+    mat = vec.apply_batch(_docs())
+    dense = np.asarray(Densify()(mat).get().data)
+    assert dense.shape == (3, 4)
+
+
+def test_sparsify_densify_roundtrip():
+    x = np.zeros((3, 5), np.float32)
+    x[0, 1] = 2.0
+    x[2, 4] = -1.0
+    sparse = Sparsify()(ArrayDataset(x))
+    dense = np.asarray(Densify()(sparse.get() if hasattr(sparse, 'get') else sparse).get().data)
+    np.testing.assert_array_equal(dense, x)

@@ -119,3 +119,72 @@ def test_bcd_single_block_equals_exact(mesh):
             block_size=6,
         )
     np.testing.assert_allclose(np.asarray(w), expected, rtol=1e-3, atol=1e-3)
+
+
+# --------------------------------------------------------- hybrid (DCN) mesh
+
+
+def test_hybrid_mesh_hierarchical_gram():
+    """A (replica, data) mesh reduces over both tiers — the multi-slice
+    (ICI + DCN) layout of SURVEY §2.10 on virtual devices."""
+    import jax
+    import numpy as np
+
+    from keystone_tpu.parallel import linalg
+    from keystone_tpu.parallel.mesh import (
+        REPLICA_AXIS,
+        make_hybrid_mesh,
+        row_axes,
+        row_shard_count,
+    )
+
+    mesh = make_hybrid_mesh(num_replicas=2, devices=jax.devices()[:8])
+    assert mesh.shape[REPLICA_AXIS] == 2
+    assert row_axes(mesh) == (REPLICA_AXIS, "data")
+    assert row_shard_count(mesh) == 8
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((64, 12)).astype(np.float32)
+    b = rng.standard_normal((64, 3)).astype(np.float32)
+    asd = linalg.prepare_row_sharded(a, mesh)
+    bsd = linalg.prepare_row_sharded(b, mesh)
+    ata, atb = linalg.gram(asd, bsd, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(ata), a.T @ a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(atb), a.T @ b, rtol=1e-4, atol=1e-4)
+
+
+def test_hybrid_mesh_bcd_matches_closed_form():
+    import jax
+    import numpy as np
+
+    from keystone_tpu.parallel import linalg
+    from keystone_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(num_replicas=2, devices=jax.devices()[:8])
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((64, 8)).astype(np.float32)
+    y = rng.standard_normal((64, 2)).astype(np.float32)
+    asd = linalg.prepare_row_sharded(a, mesh)
+    ysd = linalg.prepare_row_sharded(y, mesh)
+    w = np.asarray(
+        linalg.block_coordinate_descent(
+            asd, ysd, reg=0.1, num_epochs=30, block_size=4, mesh=mesh
+        )
+    )
+    want = np.linalg.solve(a.T @ a + 0.1 * np.eye(8), a.T @ y)
+    np.testing.assert_allclose(w, want, rtol=1e-3, atol=1e-3)
+
+
+def test_hybrid_mesh_tsqr():
+    import jax
+    import numpy as np
+
+    from keystone_tpu.parallel import linalg
+    from keystone_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(num_replicas=2, devices=jax.devices()[:8])
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((64, 6)).astype(np.float32)
+    r = np.asarray(linalg.tsqr_r(linalg.prepare_row_sharded(a, mesh), mesh=mesh))
+    # RᵀR == AᵀA exactly (QR sign ambiguity cancels in the product)
+    np.testing.assert_allclose(r.T @ r, a.T @ a, rtol=1e-3, atol=1e-3)
