@@ -41,6 +41,28 @@ from ..stats.core import _as_array_dataset
 from .block import BlockLinearMapper, _round_up
 
 
+def joint_label_means(counts, n, mixture_weight):
+    """jlm_c = 2·mw + 2(1−mw)·n_c/n − 1, with the absent-class fallback:
+    an all −1 target column's least-squares-consistent constant is −1
+    (2·mw−1 would let a phantom class outrank trained negatives in top-k).
+    Shared by both weighted estimators
+    (reference: BlockWeightedLeastSquares.scala:149,318,
+    PerClassWeightedLeastSquares.scala:190-196 computeJointLabelMean)."""
+    counts = jnp.asarray(counts, jnp.float32)
+    mw = mixture_weight
+    jlm = 2.0 * mw + 2.0 * (1.0 - mw) * counts / jnp.float32(n) - 1.0
+    return jnp.where(counts > 0, jlm, -1.0)
+
+
+def weighted_intercept(jlm, joint_means, w):
+    """b_c = jlm_c − Σ_d jointMean[c, d]·W[d, c]
+    (reference: BlockWeightedLeastSquares.scala:318,
+    PerClassWeightedLeastSquares.scala:122 finalB)."""
+    return jnp.asarray(jlm, jnp.float32) - jnp.einsum(
+        "cd,dc->c", joint_means, w, precision=linalg.PRECISION
+    )
+
+
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def __init__(self, block_size: int, num_iter: int, reg: float,
                  mixture_weight: float):
@@ -90,16 +112,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             num_blocks, bs, m, self.num_iter,
         )
 
-        mw = self.mixture_weight
-        jlm = 2 * mw + 2 * (1 - mw) * counts / n - 1  # (C,)
-        # Absent classes have an all -1 target column; the least-squares-
-        # consistent constant score is -1, not 2·mw − 1 (which would let a
-        # phantom class outrank trained negatives in top-k predictions).
-        jlm = np.where(counts > 0, jlm, -1.0)
-        # b_c = jlm_c − Σ_d jointMean[c, d]·W[d, c]
-        b = jnp.asarray(jlm, jnp.float32) - jnp.einsum(
-            "cd,dc->c", joint_means, w, precision=linalg.PRECISION
-        )
+        jlm = joint_label_means(counts, n, self.mixture_weight)
+        b = weighted_intercept(jlm, joint_means, w)
         return BlockLinearMapper(w, block_size=bs, intercept=b)
 
 
@@ -109,7 +123,7 @@ def _weighted_bcd(x, xs, y, onehot, offsets, counts, reg, mw,
     n, d_pad = x.shape
     num_classes = y.shape[1]
     nf = jnp.float32(n)
-    jlm = 2 * mw + 2 * (1 - mw) * counts / nf - 1
+    jlm = joint_label_means(counts, n, mw)
     residual0 = y - jlm  # (n, C)
     eye = jnp.eye(bs, dtype=x.dtype)
     row_win = jnp.arange(m)
@@ -198,3 +212,116 @@ def _weighted_bcd(x, xs, y, onehot, offsets, counts, reg, mw,
     blocks = jnp.tile(jnp.arange(num_blocks), num_iter)
     (w, _, joint_means), _ = jax.lax.scan(one_block, (w0, residual0, jm0), blocks)
     return w, joint_means
+
+
+# --------------------------------------------- per-class re-weighted variant
+
+
+class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
+    """Per-class example-weighted least squares.
+
+    TPU-native re-design of
+    reference: nodes/learning/PerClassWeightedLeastSquares.scala:31-223 +
+    internal/ReWeightedLeastSquares.scala:18-142. Where
+    :class:`BlockWeightedLeastSquaresEstimator` mixes per-class second
+    moments, this variant solves one weighted problem per class c with
+    scalar example weights
+
+        b_i(c) = (1−mw)/n + 1[class_i = c]·mw/n_c
+
+    features centered by the class's joint mean jfm_c = mw·classMean_c +
+    (1−mw)·popMean, labels centered by jlm_c, via weighted BCD
+
+        W_b = (X̃_bᵀ diag(b) X̃_b + λI) \\ X̃_bᵀ(b ∘ ỹ − r + b ∘ X̃_b W_b)
+
+    The reference runs C sequential Spark solves with treeReduce per
+    block; here the class loop, pass loop and block loop are one compiled
+    ``lax.scan`` nest with the per-shard products on the MXU.
+    """
+
+    def __init__(self, block_size: int, num_iter: int, reg: float,
+                 mixture_weight: float):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.reg = reg
+        self.mixture_weight = mixture_weight
+
+    @property
+    def weight(self) -> int:
+        return 3 * self.num_iter + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        x = np.asarray(jax.device_get(features.data), np.float32)[: features.num_examples]
+        y = np.asarray(jax.device_get(targets.data), np.float32)[: targets.num_examples]
+        n, d = x.shape
+        num_classes = y.shape[1]
+
+        class_idx = np.argmax(y, axis=1)
+        counts = np.bincount(class_idx, minlength=num_classes).astype(np.float32)
+        onehot = np.zeros((n, num_classes), np.float32)
+        onehot[np.arange(n), class_idx] = 1.0
+
+        bs = min(self.block_size, d)
+        d_pad = _round_up(d, bs)
+        if d_pad != d:
+            x = np.pad(x, ((0, 0), (0, d_pad - d)))
+
+        w, jfm, jlm = _pcwls_fit(
+            jnp.asarray(x), jnp.asarray(y), jnp.asarray(onehot),
+            jnp.asarray(counts), jnp.float32(self.reg),
+            jnp.float32(self.mixture_weight),
+            d_pad // bs, bs, self.num_iter,
+        )
+        b = weighted_intercept(jlm, jfm, w)
+        return BlockLinearMapper(w, block_size=bs, intercept=b)
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7, 8))
+def _pcwls_fit(x, y, onehot, counts, reg, mw, num_blocks, bs, num_iter):
+    n, d_pad = x.shape
+    num_classes = y.shape[1]
+    nf = jnp.float32(n)
+    counts_safe = jnp.maximum(counts, 1.0)
+    present = (counts > 0).astype(x.dtype)
+
+    pop_mean = jnp.mean(x, axis=0)                                   # (d,)
+    class_mean = linalg.mm(onehot.T, x) / counts_safe[:, None]       # (C, d)
+    jfm = mw * class_mean + (1.0 - mw) * pop_mean[None, :]           # (C, d)
+    jlm = joint_label_means(counts, n, mw)                           # (C,)
+    eye = jnp.eye(bs, dtype=x.dtype)
+
+    def per_class(carry, c):
+        xc = x - jax.lax.dynamic_index_in_dim(jfm, c, keepdims=True)   # (n, d)
+        yc = jax.lax.dynamic_index_in_dim(y, c, axis=1, keepdims=False) \
+            - jax.lax.dynamic_index_in_dim(jlm, c, keepdims=False)
+        oc = jax.lax.dynamic_index_in_dim(onehot, c, axis=1, keepdims=False)
+        n_c = jax.lax.dynamic_index_in_dim(counts_safe, c, keepdims=False)
+        b_wt = (1.0 - mw) / nf + oc * (mw / n_c)                        # (n,)
+        by = b_wt * yc
+
+        def one_block(state, block):
+            w_col, resid = state  # resid = b ∘ (X̃·w) accumulated
+            start = block * bs
+            xb = jax.lax.dynamic_slice(xc, (0, start), (n, bs))
+            w_b = jax.lax.dynamic_slice(w_col, (start, 0), (bs, 1))
+            g = linalg.mm(xb.T, b_wt[:, None] * xb)
+            pred_old = b_wt * linalg.mm(xb, w_b)[:, 0]
+            rhs = linalg.mm(xb.T, (by - (resid - pred_old))[:, None])
+            factor = jax.scipy.linalg.cho_factor(g + reg * eye, lower=True)
+            w_b_new = jax.scipy.linalg.cho_solve(factor, rhs)
+            resid = resid + b_wt * linalg.mm(xb, w_b_new - w_b)[:, 0]
+            w_col = jax.lax.dynamic_update_slice(w_col, w_b_new, (start, 0))
+            return (w_col, resid), None
+
+        blocks = jnp.tile(jnp.arange(num_blocks), num_iter)
+        (w_col, _), _ = jax.lax.scan(
+            one_block, (jnp.zeros((d_pad, 1), x.dtype), jnp.zeros((n,), x.dtype)),
+            blocks,
+        )
+        w_col = w_col * jax.lax.dynamic_index_in_dim(present, c, keepdims=False)
+        return carry, w_col[:, 0]
+
+    _, w_cols = jax.lax.scan(per_class, 0, jnp.arange(num_classes))
+    return w_cols.T, jfm, jlm  # (d_pad, C)

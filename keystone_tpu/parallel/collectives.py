@@ -86,3 +86,20 @@ def replicated(mesh: Optional[Mesh], x: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda a: jax.device_put(a, NamedSharding(mesh, P())), x
     )
+
+
+def all_to_all(
+    x: jnp.ndarray,
+    axis: str = DATA_AXIS,
+    split_axis: int = 0,
+    concat_axis: int = 0,
+    tiled: bool = True,
+) -> jnp.ndarray:
+    """Shard transpose over the mesh axis — the Spark shuffle analog
+    (reference: nodes/util/Shuffler.scala:18, StupidBackoff.scala:25-46
+    repartitioning; SURVEY §2.10). Each device splits its local block
+    along ``split_axis`` and exchanges pieces so device i ends up with
+    everyone's i-th piece concatenated along ``concat_axis``."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )

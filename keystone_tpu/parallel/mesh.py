@@ -126,12 +126,18 @@ def distributed_init() -> None:
     )
     in_cluster = any(v in os.environ for v in cluster_signals)
     try:
+        if jax.distributed.is_initialized():
+            return
+    except AttributeError:
+        pass  # older jax without is_initialized
+    try:
         jax.distributed.initialize()
-    except RuntimeError:
-        pass  # already initialized
     except Exception:
+        # A JaxRuntimeError here subclasses RuntimeError, so no blanket
+        # RuntimeError catch: in a cluster an init failure must propagate —
+        # running degraded as an uncoordinated single host is worse.
         if in_cluster:
-            raise  # real multi-host init failure — do not run degraded
+            raise
         # single host with no cluster env: auto-detect has nothing to find
 
 

@@ -188,3 +188,28 @@ def test_hybrid_mesh_tsqr():
     r = np.asarray(linalg.tsqr_r(linalg.prepare_row_sharded(a, mesh), mesh=mesh))
     # RᵀR == AᵀA exactly (QR sign ambiguity cancels in the product)
     np.testing.assert_allclose(r.T @ r, a.T @ a, rtol=1e-3, atol=1e-3)
+
+
+def test_all_to_all_shard_transpose():
+    """all_to_all = the Spark shuffle analog (SURVEY §2.10)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.parallel.collectives import all_to_all, shard_map
+    from keystone_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(devices=jax.devices()[:4])
+    x = np.arange(16, dtype=np.float32).reshape(16, 1)
+
+    def f(x_local):  # (4, 1) per device
+        return all_to_all(x_local, split_axis=0, concat_axis=0)
+
+    out = jax.jit(
+        shard_map(f, mesh=mesh, in_specs=P("data", None), out_specs=P("data", None))
+    )(x)
+    # device i ends with rows [i, 4+i, 8+i, 12+i] — a (4,4) shard transpose
+    got = np.asarray(out).reshape(4, 4)
+    want = np.arange(16, dtype=np.float32).reshape(4, 4).T
+    np.testing.assert_array_equal(got, want)
