@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Solver comparison sweep + cost-constant refit.
+
+Parity with the reference's benchmarking workflow: the reference shipped
+measured solver comparisons (reference: scripts/solver-comparisons-final.csv
+— Amazon/TIMIT shapes on 16 r3.4xlarge nodes) and an R script fitting the
+cost-model constants from them (reference: scripts/constantEstimator.R).
+This script regenerates both on the current hardware: it times each
+least-squares solver over a shape grid, writes the comparison CSV, then
+least-squares-fits the (cpu, mem, network) weights of the cost model to
+the measurements so `LeastSquaresEstimator`'s auto-selection reflects the
+machine it actually runs on.
+
+Usage:
+    python scripts/solver_comparison.py --out solver-comparisons.csv \
+        [--fit-constants] [--preset quick|full]
+
+Run on TPU for real constants; `--preset quick` is CPU-safe for CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import sys
+import time
+
+import numpy as np
+
+
+QUICK_GRID = [
+    # (n, d, k, sparsity)
+    (20_000, 256, 8, 1.0),
+    (20_000, 512, 8, 1.0),
+    (40_000, 256, 8, 1.0),
+    (20_000, 1024, 8, 0.01),
+]
+
+FULL_GRID = [
+    # TIMIT-like dense column (reference csv rows: n=2.2M, k=138)
+    (500_000, 1024, 138, 1.0),
+    (500_000, 2048, 138, 1.0),
+    (1_000_000, 1024, 138, 1.0),
+    # Amazon-like sparse shapes (reference csv: n=65M, k=2, sparsity=0.005)
+    (1_000_000, 1024, 2, 0.005),
+    (1_000_000, 4096, 2, 0.005),
+]
+
+
+def make_problem(n, d, k, sparsity, seed=0):
+    rng = np.random.default_rng(seed)
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    if sparsity < 1.0:
+        x *= (rng.random((n, d)) < sparsity).astype(np.float32)
+    y = x @ w_true + 0.1 * rng.normal(size=(n, k)).astype(np.float32)
+    return x, y
+
+
+def time_solver(name, fit, x, y):
+    import jax
+
+    from keystone_tpu.data.dataset import ArrayDataset
+
+    xd, yd = ArrayDataset(x), ArrayDataset(y)
+    start = time.perf_counter()
+    model = fit(xd, yd)
+    # force: a scalar fetch guarantees completion on relay-backed devices
+    float(np.asarray(jax.device_get(model.weights)).ravel()[0])
+    seconds = time.perf_counter() - start
+    pred = np.asarray(model.apply_arrays(x[: min(len(x), 65536)]))
+    err = float(np.mean((pred - y[: len(pred)]) ** 2))
+    return seconds * 1000.0, err
+
+
+def solvers(reg=1e-3):
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.learning.lbfgs import DenseLBFGSEstimator
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+
+    return {
+        "exact": lambda xd, yd: LinearMapEstimator(reg).fit(xd, yd),
+        "block": lambda xd, yd: BlockLeastSquaresEstimator(
+            1024, num_iter=3, reg=reg
+        ).fit(xd, yd),
+        "lbfgs": lambda xd, yd: DenseLBFGSEstimator(
+            num_iterations=20, reg=reg
+        ).fit(xd, yd),
+    }
+
+
+def flops_bytes_moved(name, n, d, k, sparsity, num_machines):
+    """Cost-model features per solver (mirrors each solver's cost())."""
+    nnz = n * d * sparsity
+    if name == "exact":
+        flops = nnz * d + d * d * d / 3
+        mem = nnz * 4
+        net = d * d * 4 * np.log2(max(2, num_machines))
+    elif name == "block":
+        iters = 3 * (d // 1024 + 1)
+        flops = iters * (nnz * 1024 + 1024**3 / 3)
+        mem = iters * nnz * 4
+        net = iters * 1024 * k * 4 * np.log2(max(2, num_machines))
+    else:  # lbfgs
+        iters = 20
+        flops = iters * 2 * nnz * k
+        mem = iters * nnz * 4
+        net = iters * d * k * 4 * np.log2(max(2, num_machines))
+    return flops / 1e6, mem / 1e6, net / 1e6  # Mflop, MB, MB
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="solver-comparisons.csv")
+    parser.add_argument("--preset", choices=("quick", "full"), default="quick")
+    parser.add_argument("--fit-constants", action="store_true")
+    parser.add_argument("--reg", type=float, default=1e-3)
+    args = parser.parse_args(argv)
+
+    import jax
+
+    grid = QUICK_GRID if args.preset == "quick" else FULL_GRID
+    num_machines = len(jax.devices())
+    rows = []
+    for n, d, k, sparsity in grid:
+        x, y = make_problem(n, d, k, sparsity)
+        for name, fit in solvers(args.reg).items():
+            ms, err = time_solver(name, fit, x, y)
+            rows.append(
+                {
+                    "solver": name, "n": n, "d": d, "k": k,
+                    "sparsity": sparsity, "ms": round(ms, 2),
+                    "train_mse": round(err, 6),
+                }
+            )
+            print(rows[-1], flush=True)
+
+    with open(args.out, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
+        writer.writeheader()
+        writer.writerows(rows)
+    print(f"wrote {args.out} ({len(rows)} measurements)")
+
+    if args.fit_constants:
+        # Non-negative LS fit of ms ≈ cpu·Mflop + mem·MB + net·MBmoved
+        # (the reference's constantEstimator.R equivalent).
+        feats, times = [], []
+        for r in rows:
+            feats.append(
+                flops_bytes_moved(
+                    r["solver"], r["n"], r["d"], r["k"], r["sparsity"], num_machines
+                )
+            )
+            times.append(r["ms"])
+        A = np.asarray(feats)
+        t = np.asarray(times)
+        w, *_ = np.linalg.lstsq(A, t, rcond=None)
+        w = np.maximum(w, 1e-12)
+        print(
+            "fitted CostWeights(cpu=%.3e, mem=%.3e, network=%.3e)  # ms per Mflop/MB"
+            % tuple(w)
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
