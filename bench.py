@@ -1,87 +1,436 @@
 #!/usr/bin/env python
-"""Headline benchmark: TIMIT-shape exact least-squares fit on one chip.
+"""Headline + flagship benchmarks. Prints exactly ONE JSON line.
 
-Reference baseline (BASELINE.md): the reference's solver-comparison table
-measures the Exact (normal-equations) solver on TIMIT — n=2.2M, d=1024,
-k=138, dense — at 7,323 ms on a 16-machine r3.4xlarge Spark cluster
-(reference: scripts/solver-comparisons-final.csv:14).
+Headline metric (BASELINE.md): TIMIT-shape exact least-squares fit —
+n=2.2M, d=1024, k=138, dense — measured by the reference at 7,323 ms on a
+16-machine r3.4xlarge Spark cluster (reference:
+scripts/solver-comparisons-final.csv:14). vs_baseline > 1 means this
+framework on one chip beats the 16-node cluster.
 
-This benchmark runs the same-shape problem through keystone_tpu's
-LinearMapEstimator fit path (sharded Gram over the mesh + centered normal
-equations + Cholesky) on the available accelerator and prints one JSON
-line. vs_baseline > 1 means faster than the 16-node reference cluster.
+Also measured (reported as extra keys on the same JSON line):
+  - gram_mfu: achieved TFLOP/s + MFU of the sharded Gram matmul, the
+    kernel at the heart of every exact/block solver here.
+  - cifar_random_patch: featurizer images/sec + block-solve time at the
+    reference config (numFilters=10000 — reference:
+    examples/images/cifar_random_patch.sh:30-36).
+  - imagenet_fv: per-stage wall-clock (SIFT / LCS / PCA / GMM / FV /
+    solve) of the flagship SIFT+LCS+FisherVector pipeline (reference:
+    pipelines/images/imagenet/ImageNetSiftLcsFV.scala:75-141).
+
+Robustness contract (this file must NEVER exit non-zero without printing
+a machine-readable line): the parent process runs the actual benchmark in
+a child subprocess; on backend-init failure or timeout it retries once,
+then falls back to an 8-virtual-device CPU mesh with reduced shapes and
+explicit ``extrapolated`` marking, and always prints one JSON line.
 """
 
+from __future__ import annotations
+
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
+TIMIT_BASELINE_MS = 7_323.0  # reference: scripts/solver-comparisons-final.csv:14
+
+# Known peak dense-matmul throughput per chip (TFLOP/s), for the MFU
+# figure. Keys are substrings of jax Device.device_kind. bf16 peaks from
+# public TPU specs; fp32 on TPU runs through the MXU at ~1/2 bf16 rate
+# (3-pass bf16x3 emulation on v4+).
+PEAK_TFLOPS_BF16 = {
+    "v6": 918.0,
+    "v5p": 459.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v3": 123.0,
+    "v2": 45.0,
+}
 
 
-def main() -> None:
+def _device_peak_tflops(kind: str) -> float | None:
+    kind = kind.lower()
+    for sub, peak in PEAK_TFLOPS_BF16.items():
+        if sub in kind:
+            return peak
+    return None
+
+
+# --------------------------------------------------------------------------
+# Child: the actual benchmark body (imports jax; may die on backend init).
+# --------------------------------------------------------------------------
+
+
+def _bench_timit_exact(small: bool) -> dict:
+    """Exact least-squares fit at the TIMIT shape; adaptive halving of n
+    on OOM with linear extrapolation (Gram cost is linear in n)."""
     import jax
     import jax.numpy as jnp
-
-    platform = jax.devices()[0].platform
-    on_accelerator = platform not in ("cpu",)
-
-    # TIMIT shape (reference: scripts/constantEstimator.R:33-36).
-    n, d, k = (2_200_000, 1024, 138) if on_accelerator else (100_000, 256, 32)
-    baseline_ms = 7_323.0  # 16-node Spark cluster, Exact solver, d=1024
+    import numpy as np
 
     from keystone_tpu.data.dataset import ArrayDataset
     from keystone_tpu.ops.learning.linear import LinearMapEstimator
     from keystone_tpu.parallel.mesh import get_mesh
 
+    full_n, d, k = (100_000, 256, 32) if small else (2_200_000, 1024, 138)
     mesh = get_mesh()
     ndev = mesh.devices.size
-    n -= n % ndev  # keep rows divisible by the data axis
 
-    key = jax.random.PRNGKey(0)
-    ka, kb = jax.random.split(key)
-    x = jax.random.normal(ka, (n, d), dtype=jnp.float32)
-    y = jax.random.normal(kb, (n, k), dtype=jnp.float32)
-    jax.block_until_ready((x, y))
+    n = full_n - full_n % ndev
+    while True:
+        try:
+            key = jax.random.PRNGKey(0)
+            ka, kb = jax.random.split(key)
+            x = jax.random.normal(ka, (n, d), dtype=jnp.float32)
+            y = jax.random.normal(kb, (n, k), dtype=jnp.float32)
+            jax.block_until_ready((x, y))
 
-    features, labels = ArrayDataset(x), ArrayDataset(y)
-    est = LinearMapEstimator(reg=1e-2)
+            est = LinearMapEstimator(reg=1e-2)
+            features, labels = ArrayDataset(x), ArrayDataset(y)
 
-    def force(model):
-        # Materialize a scalar derived from the weights: robust against
-        # backends where block_until_ready does not force execution.
-        return float(jnp.sum(model.weights))
+            def force(model):
+                return float(jnp.sum(model.weights))
 
-    # Warm-up compiles everything; then measure steady-state fit.
-    force(est.fit(features, labels))
+            force(est.fit(features, labels))  # compile warm-up
+            times = []
+            for _ in range(3):
+                start = time.perf_counter()
+                force(est.fit(features, labels))
+                times.append((time.perf_counter() - start) * 1000.0)
+            ms = float(np.median(times))
+            break
+        except Exception as e:  # OOM or shape-dependent failure: halve n
+            if n <= full_n // 4 or "RESOURCE_EXHAUSTED" not in str(e).upper():
+                raise
+            n = (n // 2) - ((n // 2) % ndev)
 
+    out = {"fit_ms": round(ms, 2), "shape": [n, d, k]}
+    if n < 2_200_000 or d < 1024:
+        # Scale to the full TIMIT shape: Gram cost is linear in n and
+        # quadratic in d.
+        scale = (2_200_000 / n) * (1024 / d) ** 2
+        out["fit_ms_extrapolated_full_shape"] = round(ms * scale, 2)
+        out["extrapolated"] = True
+    return out
+
+
+def _bench_gram_mfu(small: bool) -> dict:
+    """Achieved TFLOP/s and MFU of the raw Gram matmul X^T X (fp32 and
+    bf16) — the MXU kernel under every solver here."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    n, d = (50_000, 256) if small else (1_000_000, 1024)
+    dev = jax.devices()[0]
+    peak = _device_peak_tflops(getattr(dev, "device_kind", ""))
+
+    out = {"shape": [n, d]}
+    for dtype, label in ((jnp.float32, "fp32"), (jnp.bfloat16, "bf16")):
+        x = jax.random.normal(jax.random.PRNGKey(1), (n, d), dtype=dtype)
+        gram = jax.jit(lambda a: a.T @ a)
+        jax.block_until_ready(gram(x))
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(gram(x))
+            times.append(time.perf_counter() - t0)
+        sec = float(np.median(times))
+        tflops = 2.0 * n * d * d / sec / 1e12
+        out[f"{label}_tflops"] = round(tflops, 2)
+        if peak is not None:
+            # fp32 matmuls lower to multi-pass bf16 on the MXU; report MFU
+            # against the bf16 peak for both so numbers are comparable.
+            out[f"{label}_mfu_vs_bf16_peak"] = round(tflops / peak, 4)
+    if peak is not None:
+        out["device_peak_bf16_tflops"] = peak
+    out["device_kind"] = getattr(dev, "device_kind", "unknown")
+    return out
+
+
+def _bench_cifar_random_patch(small: bool) -> dict:
+    """CIFAR RandomPatch at the reference config: conv(10000 filters, 6x6)
+    → symmetric rectify → sum-pool → vectorize featurizer throughput,
+    plus the 10-block least-squares solve over the resulting 40,960-dim
+    features (reference: examples/images/cifar_random_patch.sh:30-36,
+    RandomPatchCifar.scala:45-77)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.images import (
+        Convolver,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    num_filters = 128 if small else 10_000
+    chunk = 16 if small else 128
+    n_train_full = 50_000
+    rng = np.random.default_rng(0)
+    filters = rng.normal(size=(num_filters, 6 * 6 * 3)).astype(np.float32) * 0.1
+
+    conv = Convolver(filters, 3, normalize_patches=True)
+    rect = SymmetricRectifier(alpha=0.25)
+    pool = Pooler(13, 14, None, "sum")
+    vec = ImageVectorizer()
+
+    def featurize(imgs):
+        return vec.apply_arrays(pool.apply_arrays(rect.apply_arrays(conv.apply_arrays(imgs))))
+
+    feat_fn = jax.jit(featurize)
+    imgs = jnp.asarray(rng.random((chunk, 32, 32, 3), dtype=np.float32))
+    feats = jax.block_until_ready(feat_fn(imgs))  # compile warm-up
     times = []
     for _ in range(3):
-        start = time.perf_counter()
-        force(est.fit(features, labels))
-        times.append((time.perf_counter() - start) * 1000.0)
-    ms = float(np.median(times))
+        t0 = time.perf_counter()
+        jax.block_until_ready(feat_fn(imgs))
+        times.append(time.perf_counter() - t0)
+    sec = float(np.median(times))
+    ips = chunk / sec
+    d = int(feats.shape[-1])  # 2*2*num_filters*... after pool+vectorize
 
+    # Solve stage at the full feature width over synthetic features.
+    n_solve = 2_048 if small else n_train_full
+    xs = jax.random.normal(jax.random.PRNGKey(2), (n_solve, d), dtype=jnp.float32)
+    ys = jax.random.normal(jax.random.PRNGKey(3), (n_solve, 10), dtype=jnp.float32)
+    jax.block_until_ready((xs, ys))
+    est = BlockLeastSquaresEstimator(4096, num_iter=1, reg=3000.0)
+    t0 = time.perf_counter()
+    model = est.fit(ArrayDataset(xs), ArrayDataset(ys))
+    jax.block_until_ready(model.weights)
+    solve_ms = (time.perf_counter() - t0) * 1000.0
+
+    return {
+        "featurize_images_per_sec": round(ips, 1),
+        "featurize_50k_extrapolated_s": round(n_train_full / ips, 1),
+        "feature_dim": d,
+        "num_filters": num_filters,
+        "solve_ms": round(solve_ms, 1),
+        "solve_shape": [n_solve, d, 10],
+    }
+
+
+def _bench_imagenet_fv(small: bool) -> dict:
+    """Per-stage wall-clock of the flagship ImageNet SIFT+LCS+FV pipeline
+    at the reference hyperparameters (descDim=64, vocabSize=16 —
+    reference: ImageNetSiftLcsFV.scala:132-167) over synthetic images."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.ops.images.fisher import FisherVector
+    from keystone_tpu.ops.images.lcs import LCSExtractor
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+    from keystone_tpu.ops.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.ops.learning.pca import compute_pca
+    from keystone_tpu.ops.learning.weighted import BlockWeightedLeastSquaresEstimator
+    from keystone_tpu.ops.stats.core import NormalizeRows, SignedHellingerMapper
+
+    n_img, size = (4, 64) if small else (32, 256)
+    desc_dim, vocab = 64, 16
+    num_classes = 16 if small else 1000
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.random((n_img, size, size, 3), dtype=np.float32) * 255.0)
+
+    stages: dict[str, float] = {}
+
+    def timed(name, fn, *args):
+        # warm-up (compile), then one timed call
+        jax.block_until_ready(fn(*args))
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        stages[name] = round((time.perf_counter() - t0) * 1000.0, 1)
+        return out
+
+    gray = GrayScaler().apply_arrays(PixelScaler().apply_arrays(images))
+    sift = SIFTExtractor(scale_step=1)
+    hell = SignedHellingerMapper()
+    sift_desc = timed("sift_ms", jax.jit(lambda g: hell.apply_arrays(sift.apply_arrays(g))), gray)
+
+    lcs = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
+    lcs_desc = timed("lcs_ms", jax.jit(lcs.apply_arrays), images)
+
+    # PCA on pooled descriptors (columns = descriptor dims), per branch.
+    flat = sift_desc.reshape(-1, sift_desc.shape[-1])
+    pca_components = timed("pca_fit_ms", jax.jit(lambda f: compute_pca(f, desc_dim)), flat)
+    reduced = (flat @ pca_components).reshape(n_img, -1, desc_dim)
+
+    gmm_est = GaussianMixtureModelEstimator(vocab, max_iterations=25, seed=0)
+    t0 = time.perf_counter()
+    gmm = gmm_est.fit(ArrayDataset(np.asarray(reduced.reshape(-1, desc_dim))))
+    stages["gmm_fit_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+
+    fv = FisherVector(gmm)
+    norm = NormalizeRows()
+
+    def encode(r):
+        out = fv.apply_arrays(r).reshape(n_img, -1)
+        return norm.apply_arrays(hell.apply_arrays(norm.apply_arrays(out)))
+
+    encoded = timed("fisher_encode_ms", jax.jit(encode), reduced)
+
+    # Solve at the combined-FV width (both branches → 2 * d * 2K) over a
+    # synthetic training set of ImageNet-like size-per-class.
+    d_fv = int(encoded.shape[-1]) * 2
+    n_solve = 512 if small else 12_800
+    xs = jax.random.normal(jax.random.PRNGKey(5), (n_solve, d_fv), dtype=jnp.float32)
+    ys = -np.ones((n_solve, num_classes), dtype=np.float32)
+    ys[np.arange(n_solve), rng.integers(0, num_classes, n_solve)] = 1.0
+    est = BlockWeightedLeastSquaresEstimator(4096, num_iter=1, reg=6e-5, mixture_weight=0.25)
+    t0 = time.perf_counter()
+    model = est.fit(ArrayDataset(xs), ArrayDataset(jnp.asarray(ys)))
+    jax.block_until_ready(model.weights)
+    stages["solve_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+
+    stages["sift_images_per_sec"] = round(n_img / max(stages["sift_ms"], 1e-6) * 1000.0, 1)
+    stages["num_images"] = n_img
+    stages["image_size"] = size
+    stages["fv_dim_combined"] = d_fv
+    return stages
+
+
+def child_main(small: bool) -> int:
+    import jax
+
+    t_init = time.time()
+    devices = jax.devices()
+    platform = devices[0].platform
+    report: dict = {
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "backend_init_s": round(time.time() - t_init, 1),
+        "small_shapes": small,
+    }
+
+    workloads = {
+        "timit_exact": _bench_timit_exact,
+        "gram_mfu": _bench_gram_mfu,
+        "cifar_random_patch": _bench_cifar_random_patch,
+        "imagenet_fv": _bench_imagenet_fv,
+    }
+    for name, fn in workloads.items():
+        t0 = time.time()
+        try:
+            report[name] = fn(small)
+        except Exception as e:  # record, keep going — partial data beats none
+            report[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
+        report[name]["wall_s"] = round(time.time() - t0, 1)
+
+    print("BENCH_CHILD_JSON:" + json.dumps(report), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------------
+# Parent: subprocess orchestration, retry, CPU fallback, single JSON line.
+# --------------------------------------------------------------------------
+
+
+def _run_child(env: dict, small: bool, timeout_s: float) -> tuple[dict | None, str]:
+    cmd = [sys.executable, os.path.abspath(__file__), "--child"]
+    if small:
+        cmd.append("--small")
+    try:
+        proc = subprocess.run(
+            cmd, env=env, capture_output=True, text=True, timeout=timeout_s
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"child timed out after {timeout_s:.0f}s"
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_CHILD_JSON:"):
+            try:
+                return json.loads(line[len("BENCH_CHILD_JSON:"):]), ""
+            except json.JSONDecodeError as e:
+                return None, f"bad child JSON: {e}"
+    tail = (proc.stderr or proc.stdout or "")[-1500:]
+    return None, f"child rc={proc.returncode}, no JSON. tail: {tail}"
+
+
+def _probe_backend(env: dict, timeout_s: float = 300) -> tuple[bool, str]:
+    """Cheap check that the default backend initializes at all — a hung
+    TPU tunnel would otherwise consume the full benchmark timeout twice."""
+    code = "import jax; d = jax.devices(); print('PROBE_OK', d[0].platform, len(d))"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend probe hung >{timeout_s:.0f}s"
+    if "PROBE_OK" in proc.stdout:
+        return True, proc.stdout.strip()
+    return False, (proc.stderr or proc.stdout or "")[-500:]
+
+
+def main() -> int:
+    diagnostics: list[str] = []
+    report = None
+
+    # Attempts 1-2: the real backend (TPU via the session's default env),
+    # each gated by a fast init probe so a hung tunnel costs minutes, not
+    # the full benchmark timeout.
+    for attempt in range(2):
+        ok, info = _probe_backend(dict(os.environ))
+        if not ok:
+            diagnostics.append(f"probe {attempt + 1}: {info}")
+            time.sleep(10)
+            continue
+        report, err = _run_child(dict(os.environ), small=False, timeout_s=2400)
+        if report is not None:
+            break
+        diagnostics.append(f"attempt {attempt + 1}: {err}")
+        time.sleep(5)
+
+    # Attempt 3: 8-virtual-device CPU mesh, reduced shapes, marked.
+    if report is None:
+        env = dict(os.environ)
+        # The axon sitecustomize dials the TPU relay at interpreter start
+        # whenever this var is set — with the tunnel down that hangs every
+        # python process, including a pure-CPU one. Drop it for the
+        # fallback leg.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+        report, err = _run_child(env, small=True, timeout_s=1200)
+        if report is None:
+            diagnostics.append(f"cpu fallback: {err}")
+
+    if report is None:  # total failure: still print one machine-readable line
+        print(json.dumps({
+            "metric": "timit_exact_lstsq_fit_ms_n2.2M_d1024_k138",
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": None,
+            "error": "all benchmark attempts failed",
+            "diagnostics": diagnostics,
+        }))
+        return 0
+
+    timit = report.get("timit_exact", {})
+    ms = timit.get("fit_ms_extrapolated_full_shape", timit.get("fit_ms"))
     result = {
         "metric": "timit_exact_lstsq_fit_ms_n2.2M_d1024_k138",
-        "value": round(ms, 2),
+        "value": ms,
         "unit": "ms",
-        "vs_baseline": round(baseline_ms / ms, 3),
+        "vs_baseline": round(TIMIT_BASELINE_MS / ms, 3) if ms else None,
+        **{k: v for k, v in report.items() if k != "timit_exact"},
+        "timit_exact": timit,
     }
-    if not on_accelerator:
-        # CPU fallback runs a smaller problem; report it as an explicit
-        # extrapolation rather than passing it off as the measured metric.
-        scale = (2_200_000 / n) * (1024 / d) ** 2
-        result.update(
-            {
-                "value": round(ms * scale, 2),
-                "vs_baseline": round(baseline_ms / (ms * scale), 3),
-                "extrapolated": True,
-                "measured_shape": [n, d, k],
-            }
-        )
+    if diagnostics:
+        result["diagnostics"] = diagnostics
     print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
+    if "--child" in sys.argv:
+        sys.exit(child_main(small="--small" in sys.argv))
     sys.exit(main())

@@ -218,13 +218,26 @@ def main(argv: Optional[list] = None) -> int:
     config_cls, run_fn = resolved[args.workload]
     config = build_config(config_cls, args)
     results = run_fn(config)
-    printable = {
-        k: (float(v) if hasattr(v, "item") else v)
-        for k, v in results.items()
-        if isinstance(v, (int, float, str)) or hasattr(v, "item")
-    }
-    print(json.dumps({"workload": args.workload, **printable}))
+    print(json.dumps({"workload": args.workload, **printable_results(results)}))
     return 0
+
+
+def printable_results(results: dict) -> dict:
+    """JSON-serializable view of a workload's results dict: true scalars
+    become floats, small arrays become lists (e.g. the VOC run's (20,)
+    per-class AP), large arrays and non-serializable objects are skipped."""
+    import numpy as _np
+
+    printable = {}
+    for k, v in results.items():
+        if isinstance(v, (int, float, str)):
+            printable[k] = v
+        elif hasattr(v, "item"):
+            if _np.ndim(v) == 0 or getattr(v, "size", 0) == 1:
+                printable[k] = float(_np.asarray(v).reshape(()))
+            elif getattr(v, "size", 0) <= 64:
+                printable[k] = _np.asarray(v).tolist()
+    return printable
 
 
 if __name__ == "__main__":

@@ -28,7 +28,7 @@ def read_label_map(labels_path: str) -> Dict[str, int]:
             line = line.strip()
             if not line:
                 continue
-            parts = line.split(" ")
+            parts = line.split()
             out[parts[0]] = int(parts[1])
     return out
 

@@ -95,17 +95,27 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
         # Pad the feature dim to a whole number of blocks (zero columns are
         # inert: their Gram rows/cols are zero and λ keeps the solve PD).
+        # On a 2-D (data, model) mesh each model group needs a whole number
+        # of blocks, so pad to model_axis·block columns.
         block = min(self.block_size, d)
-        d_pad = _round_up(d, block)
+        m = linalg.model_axis_size(mesh)
+        d_pad = _round_up(d, block * m)
         if d_pad != d:
             xc = jnp.pad(xc, ((0, 0), (0, d_pad - d)))
 
-        xc = linalg.prepare_row_sharded(xc, mesh)
-        yc = linalg.prepare_row_sharded(yc, mesh)
         reg = self.reg if self.reg > 0 else 1e-6  # keep padded blocks PD
-        w = linalg.block_coordinate_descent(
-            xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
-        )
+        if m > 1:
+            xc = linalg.prepare_block_sharded(xc, mesh)
+            yc = linalg.prepare_block_sharded(yc, mesh, fine_rows=True)
+            w = linalg.block_coordinate_descent_2d(
+                xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
+            )
+        else:
+            xc = linalg.prepare_row_sharded(xc, mesh)
+            yc = linalg.prepare_row_sharded(yc, mesh)
+            w = linalg.block_coordinate_descent(
+                xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
+            )
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a
         )

@@ -27,7 +27,12 @@ from ...parallel.mesh import num_devices
 from ...workflow.optimize import DataStats, Optimizable
 from ...workflow.pipeline import LabelEstimator, Transformer
 from .block import BlockLeastSquaresEstimator
-from .cost import DEFAULT_COST_WEIGHTS, CostModel, CostWeights
+from .cost import (
+    DEFAULT_COST_WEIGHTS,
+    CostModel,
+    CostWeights,
+    default_cost_weights,
+)
 from .lbfgs import DenseLBFGSEstimator, SparseLBFGSEstimator
 from .linear import LinearMapEstimator
 
@@ -74,13 +79,15 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         self,
         reg: float = 0.0,
         num_machines: Optional[int] = None,
-        weights: CostWeights = DEFAULT_COST_WEIGHTS,
+        weights: Optional[CostWeights] = None,
         sparse_threshold: float = 0.2,
         block_size: int = 1000,
         block_iters: int = 3,
     ):
         self.reg = reg
         self.num_machines = num_machines
+        # None → resolved per-backend at optimize() time (measured-TPU
+        # constants on accelerators, the reference's on CPU).
         self.weights = weights
         self.sparse_threshold = sparse_threshold
         self.block_size = block_size
@@ -98,28 +105,31 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         n = stats.n_total
         d, k, sparsity = _sample_shape_stats(sample_x, samples[1] if len(samples) > 1 else None)
         machines = self.num_machines or num_devices()
+        # Resolve per call, not in __init__: the right weights depend on
+        # the backend active when planning runs.
+        weights = self.weights if self.weights is not None else default_cost_weights()
 
         candidates = [
             (
-                _SparseLBFGSCost().cost(n, d, k, sparsity, machines, self.weights)
+                _SparseLBFGSCost().cost(n, d, k, sparsity, machines, weights)
                 if sparsity < self.sparse_threshold
                 else np.inf,
                 SparseLBFGSEstimator(reg=self.reg),
             ),
             (
-                _DenseLBFGSCost().cost(n, d, k, 1.0, machines, self.weights),
+                _DenseLBFGSCost().cost(n, d, k, 1.0, machines, weights),
                 DenseLBFGSEstimator(reg=self.reg),
             ),
             (
                 _BlockSolveCost(self.block_size, self.block_iters).cost(
-                    n, d, k, 1.0, machines, self.weights
+                    n, d, k, 1.0, machines, weights
                 ),
                 BlockLeastSquaresEstimator(
                     self.block_size, num_iter=self.block_iters, reg=self.reg
                 ),
             ),
             (
-                _ExactCost().cost(n, d, k, 1.0, machines, self.weights),
+                _ExactCost().cost(n, d, k, 1.0, machines, weights),
                 LinearMapEstimator(reg=self.reg),
             ),
         ]

@@ -5,7 +5,7 @@ Each module exposes a config dataclass, ``build_pipeline`` builders, and a
 reference's scopt-parsed ``object ... { def run(sc, config) }`` programs.
 """
 
-from . import cifar, imagenet, mnist_random_fft, stupid_backoff, text, timit, voc
+import importlib
 
 __all__ = [
     "cifar",
@@ -16,3 +16,9 @@ __all__ = [
     "timit",
     "voc",
 ]
+
+
+def __getattr__(name):  # PEP 562: import workload modules on first access
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

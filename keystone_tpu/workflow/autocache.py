@@ -22,6 +22,7 @@ multiplying the recomputation count exactly as the reference's
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set, Tuple
@@ -114,9 +115,10 @@ class _ProfilingInterpreter:
     timing each node (the analog of the reference's per-node sample
     profiling, AutoCacheRule.scala:153-465)."""
 
-    def __init__(self, graph: Graph, scale: int):
+    def __init__(self, graph: Graph, scale: int, clock=time.perf_counter):
         self.graph = graph
         self.scale = scale
+        self.clock = clock
         self.times: Dict[NodeId, float] = {}
         self.sizes: Dict[NodeId, int] = {}
         self._memo: Dict = {}
@@ -134,10 +136,10 @@ class _ProfilingInterpreter:
         else:
             deps = [self.execute(d) for d in self.graph.get_dependencies(graph_id)]
             expressions = [wrap_expression(d) for d in deps]
-            start = time.perf_counter()
+            start = self.clock()
             result = op.execute(expressions).get()
             _block(result)
-            self.times[graph_id] = time.perf_counter() - start
+            self.times[graph_id] = self.clock() - start
             if isinstance(result, Dataset):
                 self.sizes[graph_id] = _estimate_bytes(result)
         self._memo[graph_id] = result
@@ -172,12 +174,17 @@ class AutoCacheRule(Rule):
         strategy: str = "greedy",
         profile_scales: Tuple[int, ...] = (2, 4),
         num_trials: int = 1,
+        clock=time.perf_counter,
     ):
         assert strategy in ("greedy", "aggressive")
         self.budget_bytes = budget_bytes
         self.strategy = strategy
         self.profile_scales = profile_scales
         self.num_trials = num_trials
+        # Injectable timer: profile-driven tests replace the wall clock
+        # with a deterministic fake so cache choices don't depend on
+        # machine load.
+        self.clock = clock
 
     # ------------------------------------------------------------- structure
     def _dependents(self, graph: Graph) -> Dict[NodeId, List]:
@@ -234,12 +241,17 @@ class AutoCacheRule(Rule):
         samples: Dict[NodeId, List[SampleProfile]] = {}
         for scale in self.profile_scales:
             for _ in range(self.num_trials):
-                interp = _ProfilingInterpreter(graph, scale)
+                interp = _ProfilingInterpreter(graph, scale, clock=self.clock)
                 try:
                     for sink in graph.sinks:
                         interp.execute(sink)
-                except Exception:
-                    return {}  # unbound sources etc.: no profile, no caching
+                except Exception as e:
+                    # unbound sources etc.: no profile, no caching
+                    logging.getLogger(__name__).warning(
+                        "auto-cache profiling failed (%s): running without "
+                        "cache planning", e,
+                    )
+                    return {}
                 for n, t in interp.times.items():
                     samples.setdefault(n, []).append(
                         SampleProfile(scale, t, interp.sizes.get(n, 0))

@@ -17,6 +17,7 @@ through the DAG.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -70,7 +71,11 @@ class NodeOptimizationRule(Rule):
                 sample_datasets = [s for s in samples if isinstance(s, Dataset)]
                 stats = sampler.stats_for(graph.get_dependencies(node))
                 replacement = op.optimize(sample_datasets, stats)
-            except Exception:  # sampling must never break planning
+            except Exception as e:  # sampling must never break planning
+                logging.getLogger(__name__).warning(
+                    "node optimization skipped for %s (%s): falling back to "
+                    "the default operator", node, e,
+                )
                 continue
             if replacement is not op:
                 graph = graph.set_operator(node, replacement)

@@ -114,6 +114,11 @@ def main(argv=None):
     parser.add_argument("--out", default="solver-comparisons.csv")
     parser.add_argument("--preset", choices=("quick", "full"), default="quick")
     parser.add_argument("--fit-constants", action="store_true")
+    parser.add_argument(
+        "--constants-out", default=None,
+        help="where to write fitted constants (default: the in-package "
+        "tpu_cost_constants.json, the commit-and-ship workflow)",
+    )
     parser.add_argument("--reg", type=float, default=1e-3)
     args = parser.parse_args(argv)
 
@@ -144,6 +149,8 @@ def main(argv=None):
     if args.fit_constants:
         # Non-negative LS fit of ms ≈ cpu·Mflop + mem·MB + net·MBmoved
         # (the reference's constantEstimator.R equivalent).
+        from scipy.optimize import nnls
+
         feats, times = [], []
         for r in rows:
             feats.append(
@@ -154,12 +161,38 @@ def main(argv=None):
             times.append(r["ms"])
         A = np.asarray(feats)
         t = np.asarray(times)
-        w, *_ = np.linalg.lstsq(A, t, rcond=None)
-        w = np.maximum(w, 1e-12)
+        w, residual = nnls(A, t)
         print(
             "fitted CostWeights(cpu=%.3e, mem=%.3e, network=%.3e)  # ms per Mflop/MB"
             % tuple(w)
         )
+        if (w <= 0).all():
+            print("degenerate fit (all-zero weights); not persisting")
+            return 1
+        # Persist in the raw units cost() uses (ms per flop / per fp32
+        # element): Mflop → flop is /1e6; MB → element is /1e6 then ×4
+        # bytes per element. Committing this file makes the measured
+        # constants the default on TPU (cost.measured_tpu_weights).
+        if jax.default_backend() != "cpu":
+            import json
+
+            from keystone_tpu.ops.learning.cost import MEASURED_CONSTANTS_PATH
+
+            payload = {
+                "cpu": float(w[0] / 1e6),
+                "mem": float(w[1] / 1e6 * 4.0),
+                "network": float(w[2] / 1e6 * 4.0),
+                "fitted_on": getattr(jax.devices()[0], "device_kind", "unknown"),
+                "preset": args.preset,
+                "fit_residual_ms": float(residual),
+            }
+            out_path = args.constants_out or MEASURED_CONSTANTS_PATH
+            try:
+                with open(out_path, "w") as f:
+                    json.dump(payload, f, indent=1)
+                print(f"wrote {out_path}")
+            except OSError as e:
+                print(f"could not write {out_path} ({e}); constants printed above")
     return 0
 
 

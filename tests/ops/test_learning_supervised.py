@@ -163,6 +163,44 @@ def test_meta_solver_picks_sparse_for_sparse_data():
     assert isinstance(chosen, SparseLBFGSEstimator)
 
 
+def test_meta_solver_choice_flips_at_tpu_crossover_shapes():
+    """With the TPU cost weights the solver choice must flip from exact
+    normal equations to block coordinate descent as d grows at the TIMIT
+    shape — the behavior contract of the reference's cost-driven
+    auto-selection (reference: LeastSquaresEstimator.scala:26-87) refit
+    for this hardware (VERDICT round 1, item 3)."""
+    from keystone_tpu.ops.learning.cost import tpu_weights
+
+    rng = np.random.default_rng(0)
+    y = rng.normal(size=(64, 2)).astype(np.float32)
+    stats = DataStats(n_total=2_200_000, num_shards=8, n_per_shard=[275_000] * 8)
+
+    def choice(d):
+        est = LeastSquaresEstimator(reg=0.1, weights=tpu_weights(), num_machines=8)
+        x = rng.normal(size=(64, d)).astype(np.float32)
+        return est.optimize([ArrayDataset(x), ArrayDataset(y)], stats)
+
+    assert isinstance(choice(1024), LinearMapEstimator)       # exact wins small-d
+    assert isinstance(choice(16384), BlockLeastSquaresEstimator)  # block wins big-d
+
+
+def test_default_weights_resolve_by_backend():
+    """weights=None resolves to the reference's constants on CPU and the
+    TPU constants on accelerators (cost.default_cost_weights)."""
+    from keystone_tpu.ops.learning.cost import (
+        DEFAULT_COST_WEIGHTS,
+        default_cost_weights,
+        measured_tpu_weights,
+        tpu_weights,
+    )
+
+    assert default_cost_weights("cpu") == DEFAULT_COST_WEIGHTS
+    assert default_cost_weights("tpu") in (
+        measured_tpu_weights() or tpu_weights(),
+        tpu_weights(),
+    )
+
+
 def test_per_class_weighted_least_squares_learns():
     """reference: PerClassWeightedLeastSquares.scala:31-223 — per-class
     example-weighted solve recovers separable class prototypes."""

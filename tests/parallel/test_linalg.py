@@ -8,6 +8,7 @@ import jax
 
 from keystone_tpu.parallel import linalg
 from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+from keystone_tpu.utils.testing import assert_about_eq
 
 
 @pytest.fixture(scope="module")
@@ -213,3 +214,107 @@ def test_all_to_all_shard_transpose():
     got = np.asarray(out).reshape(4, 4)
     want = np.arange(16, dtype=np.float32).reshape(4, 4).T
     np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------ 2-D (data, model) mesh
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+    return make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS), devices=jax.devices()[:8])
+
+
+def test_bcd_2d_matches_closed_form(mesh2d):
+    """Column-sharded A + model-sharded W converge to the same ridge
+    solution as the closed form — the VERDICT item 4 acceptance test."""
+    a = rand((64, 32), seed=11)
+    x_true = rand((32, 3), seed=12)
+    y = a @ x_true
+    lam = 0.1
+    expected = np.linalg.solve(a.T @ a + lam * np.eye(32), a.T @ y)
+    asd = linalg.prepare_block_sharded(a, mesh2d)
+    ysd = linalg.prepare_block_sharded(y, mesh2d, fine_rows=True)
+    w = np.asarray(
+        linalg.block_coordinate_descent_2d(
+            asd, ysd, reg=lam, num_epochs=40, block_size=8, mesh=mesh2d
+        )
+    )
+    assert_about_eq(w, expected, thresh=5e-2)
+
+
+def test_bcd_2d_w_is_model_sharded(mesh2d):
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.parallel.mesh import MODEL_AXIS
+
+    a = rand((32, 16), seed=13)
+    y = rand((32, 2), seed=14)
+    asd = linalg.prepare_block_sharded(a, mesh2d)
+    ysd = linalg.prepare_block_sharded(y, mesh2d, fine_rows=True)
+    w = linalg.block_coordinate_descent_2d(
+        asd, ysd, reg=0.2, num_epochs=5, block_size=4, mesh=mesh2d
+    )
+    assert w.sharding.is_equivalent_to(
+        jax.sharding.NamedSharding(mesh2d, P(MODEL_AXIS, None)), w.ndim
+    )
+
+
+def test_bcd_2d_single_pass_matches_1d_order(mesh2d):
+    """With one block per model group the 2-D update order degenerates to
+    the sequential order, so a single epoch must match the 1-D solver
+    bit-for-tolerance."""
+    a = rand((64, 8), seed=15)
+    y = rand((64, 2), seed=16)
+    lam = 0.3
+    mesh1d = make_mesh(devices=jax.devices()[:8])
+    w1 = np.asarray(
+        linalg.block_coordinate_descent(
+            linalg.prepare_row_sharded(a, mesh1d),
+            linalg.prepare_row_sharded(y, mesh1d),
+            reg=lam, num_epochs=1, block_size=4, mesh=mesh1d,
+        )
+    )
+    w2 = np.asarray(
+        linalg.block_coordinate_descent_2d(
+            linalg.prepare_block_sharded(a, mesh2d),
+            linalg.prepare_block_sharded(y, mesh2d, fine_rows=True),
+            reg=lam, num_epochs=1, block_size=4, mesh=mesh2d,
+        )
+    )
+    assert_about_eq(w2, w1, thresh=1e-3)
+
+
+def test_block_sharded_apply_matches_matmul(mesh2d):
+    a = rand((48, 16), seed=17)
+    w = rand((16, 5), seed=18)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.parallel.mesh import MODEL_AXIS
+
+    asd = linalg.prepare_block_sharded(a, mesh2d)
+    wsd = jax.device_put(w, NamedSharding(mesh2d, P(MODEL_AXIS, None)))
+    got = np.asarray(linalg.block_sharded_apply(asd, wsd, mesh=mesh2d))
+    assert_about_eq(got, a @ w)
+
+
+def test_block_estimator_on_2d_mesh(mesh2d):
+    """BlockLeastSquaresEstimator transparently uses the 2-D path when the
+    active mesh has a model axis, and matches the centered closed form."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    a = rand((64, 16), seed=19)
+    x_true = rand((16, 3), seed=20)
+    y = a @ x_true
+    with use_mesh(mesh2d):
+        model = BlockLeastSquaresEstimator(8, num_iter=30, reg=0.1).fit(
+            ArrayDataset(a), ArrayDataset(y)
+        )
+        preds = np.asarray(model.apply_arrays(a))
+    ac = a - a.mean(axis=0)
+    yc = y - y.mean(axis=0)
+    w_want = np.linalg.solve(ac.T @ ac + 0.1 * np.eye(16), ac.T @ yc)
+    want = ac @ w_want + y.mean(axis=0)
+    np.testing.assert_allclose(preds, want, rtol=5e-2, atol=5e-2)

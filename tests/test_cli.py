@@ -49,3 +49,30 @@ def test_run_mnist_synthetic_through_cli(capsys):
     payload = json.loads(line)
     assert payload["workload"] == "mnist-random-fft"
     assert 0.0 <= payload["train_error"] <= 1.0
+
+
+def test_printable_results_handles_arrays():
+    """Scalars → float, small arrays → list, large arrays dropped — the
+    per-class-AP crash fix (a (20,) ndarray must not hit float())."""
+    import json
+
+    import numpy as np
+
+    from keystone_tpu.cli import printable_results
+
+    out = printable_results(
+        {
+            "err": 0.5,
+            "name": "voc",
+            "scalar_arr": np.float32(1.5),
+            "zero_d": np.asarray(2.0),
+            "per_class_ap": np.linspace(0, 1, 20),
+            "huge": np.zeros((1000,)),
+            "obj": object(),
+        }
+    )
+    assert out["err"] == 0.5 and out["name"] == "voc"
+    assert out["scalar_arr"] == 1.5 and out["zero_d"] == 2.0
+    assert isinstance(out["per_class_ap"], list) and len(out["per_class_ap"]) == 20
+    assert "huge" not in out and "obj" not in out
+    json.dumps(out)  # round-trips

@@ -4,8 +4,6 @@ The reference suite builds graphs by hand with toy transformers and
 weighted estimators, then asserts on the selected cache set; same here.
 """
 
-import time
-
 import numpy as np
 
 from keystone_tpu.data.dataset import ArrayDataset, Dataset
@@ -15,13 +13,25 @@ from keystone_tpu.workflow.graph import Graph
 from keystone_tpu.workflow.operators import DatasetOperator, TransformerOperator
 
 
-class CountingOp(TransformerOperator):
-    """Identity-ish op that counts batch executions and can sleep."""
+class FakeClock:
+    """Deterministic clock: ops advance it explicitly instead of sleeping,
+    so profile-driven cache choices are load-independent."""
 
-    def __init__(self, name, delay_s=0.0, weight=1):
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+
+class CountingOp(TransformerOperator):
+    """Identity-ish op that counts batch executions and can charge fake time."""
+
+    def __init__(self, name, delay_s=0.0, weight=1, clock=None):
         self.name = name
         self.delay_s = delay_s
         self.weight = weight
+        self.clock = clock
         self.batch_calls = 0
 
     @property
@@ -33,17 +43,17 @@ class CountingOp(TransformerOperator):
 
     def batch_transform(self, datasets):
         self.batch_calls += 1
-        if self.delay_s:
-            time.sleep(self.delay_s)
+        if self.delay_s and self.clock is not None:
+            self.clock.t += self.delay_s
         return datasets[0]
 
 
-def diamond_graph(n=64, delay_s=0.0, weight=1):
+def diamond_graph(n=64, delay_s=0.0, weight=1, clock=None):
     """source-bound dataset → expensive shared node → two consumers → sinks."""
     data = ArrayDataset(np.ones((n, 4), dtype=np.float32))
     g = Graph()
     g, d = g.add_node(DatasetOperator(data), [])
-    shared = CountingOp("shared", delay_s=delay_s)
+    shared = CountingOp("shared", delay_s=delay_s, clock=clock)
     g, sh = g.add_node(shared, [d])
     g, c1 = g.add_node(CountingOp("left", weight=weight), [sh])
     g, c2 = g.add_node(CountingOp("right"), [sh])
@@ -72,24 +82,29 @@ def test_aggressive_caches_every_reused_node():
 
 
 def test_greedy_caches_expensive_shared_node_under_budget():
-    g, shared_id, _ = diamond_graph(delay_s=0.01)
-    out, _ = AutoCacheRule(budget_bytes=1 << 30, strategy="greedy").apply(g, {})
+    clock = FakeClock()
+    g, shared_id, _ = diamond_graph(delay_s=0.01, clock=clock)
+    out, _ = AutoCacheRule(
+        budget_bytes=1 << 30, strategy="greedy", clock=clock
+    ).apply(g, {})
     caches = cacher_nodes(out)
     assert len(caches) == 1
     assert out.get_dependencies(caches[0]) == (shared_id,)
 
 
 def test_greedy_zero_budget_caches_nothing():
-    g, _, _ = diamond_graph(delay_s=0.01)
-    out, _ = AutoCacheRule(budget_bytes=0, strategy="greedy").apply(g, {})
+    clock = FakeClock()
+    g, _, _ = diamond_graph(delay_s=0.01, clock=clock)
+    out, _ = AutoCacheRule(budget_bytes=0, strategy="greedy", clock=clock).apply(g, {})
     assert cacher_nodes(out) == []
 
 
 def test_single_use_node_never_cached():
+    clock = FakeClock()
     data = ArrayDataset(np.ones((16, 4), dtype=np.float32))
     g = Graph()
     g, d = g.add_node(DatasetOperator(data), [])
-    g, a = g.add_node(CountingOp("a", delay_s=0.005), [d])
+    g, a = g.add_node(CountingOp("a", delay_s=0.005, clock=clock), [d])
     g, b = g.add_node(CountingOp("b"), [a])
     g, s = g.add_sink(b)
     out, _ = AutoCacheRule(strategy="aggressive").apply(g, {})
@@ -138,20 +153,21 @@ def test_greedy_credits_ancestor_recompute_savings():
     """Caching a cheap shared node whose ancestor is expensive must win over
     caching a moderately expensive independent shared node: the cost model
     sees the ancestor's time through the runs() recursion."""
+    clock = FakeClock()
     data = ArrayDataset(np.ones((64, 4), dtype=np.float32))
     g = Graph()
     g, d = g.add_node(DatasetOperator(data), [])
-    g, a = g.add_node(CountingOp("expensive-ancestor", delay_s=0.02), [d])
+    g, a = g.add_node(CountingOp("expensive-ancestor", delay_s=0.02, clock=clock), [d])
     g, s_cheap = g.add_node(CountingOp("cheap-shared"), [a])
     g, c1 = g.add_node(CountingOp("u1"), [s_cheap])
     g, c2 = g.add_node(CountingOp("u2"), [s_cheap])
-    g, b = g.add_node(CountingOp("independent-shared", delay_s=0.005), [d])
+    g, b = g.add_node(CountingOp("independent-shared", delay_s=0.005, clock=clock), [d])
     g, c3 = g.add_node(CountingOp("u3"), [b])
     g, c4 = g.add_node(CountingOp("u4"), [b])
     for n in (c1, c2, c3, c4):
         g, _ = g.add_sink(n)
     # Budget fits exactly one cached copy of (64,4) float32 = 1024 bytes.
-    out, _ = AutoCacheRule(budget_bytes=1100, strategy="greedy").apply(g, {})
+    out, _ = AutoCacheRule(budget_bytes=1100, strategy="greedy", clock=clock).apply(g, {})
     caches = cacher_nodes(out)
     assert len(caches) == 1
     assert out.get_dependencies(caches[0]) == (s_cheap,)
