@@ -77,7 +77,22 @@ class ObjectDataset(Dataset):
         self._items = list(items)
         self._num_shards = num_shards or 1
 
-    def map(self, fn: Callable[[Any], Any]) -> "ObjectDataset":
+    def map(self, fn: Callable[[Any], Any], parallel: Optional[bool] = None) -> "ObjectDataset":
+        """Per-item host map, fanned over a thread pool for larger
+        datasets (the RDD-map analog; pays off when ``fn`` releases the
+        GIL — numpy, PIL, the native kernels — which is what host-side
+        featurizer fallbacks do). Order is preserved.
+
+        ``fn`` must be safe to call concurrently (the RDD-map contract);
+        pass ``parallel=False`` for functions with shared mutable state,
+        ``parallel=True`` to force the pool for small datasets."""
+        if parallel is None:
+            parallel = len(self._items) >= 64
+        if parallel:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                return ObjectDataset(list(pool.map(fn, self._items)), self._num_shards)
         return ObjectDataset([fn(x) for x in self._items], self._num_shards)
 
     def collect(self) -> List[Any]:

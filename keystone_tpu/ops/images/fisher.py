@@ -55,6 +55,36 @@ class FisherVector(BatchTransformer):
         )
         return jnp.concatenate([fv1, fv2], axis=2)          # (N, D, 2K)
 
+    def apply_arrays_masked(self, x, valid):
+        """Fisher-encode ragged descriptor batches: ``x`` (N, n_pad, D)
+        with per-image validity ``valid`` (N, n_pad) from the bucketed
+        extractors. Invalid rows contribute nothing and the statistics
+        normalize by each image's true descriptor count — equal to
+        ``apply_arrays`` on the image's own valid descriptors (the
+        reference encodes per-image descriptor sets of varying size,
+        FisherVector.scala:33-53)."""
+        x = x.astype(jnp.float32)
+        means = self.gmm.means.astype(jnp.float32)
+        variances = self.gmm.variances.astype(jnp.float32)
+        weights = self.gmm.weights.astype(jnp.float32)
+
+        m = jnp.asarray(valid, jnp.float32)                 # (N, n)
+        count = jnp.maximum(jnp.sum(m, axis=1), 1.0)        # (N,)
+        flat = x.reshape(-1, x.shape[-1])
+        q = self.gmm.apply_arrays(flat).reshape(x.shape[0], x.shape[1], -1)
+        q = q * m[..., None]                                # zero invalid rows
+
+        s0 = jnp.sum(q, axis=1) / count[:, None]
+        s1 = jnp.einsum("bnd,bnk->bdk", x, q) / count[:, None, None]
+        s2 = jnp.einsum("bnd,bnk->bdk", x * x, q) / count[:, None, None]
+
+        s0b = s0[:, None, :]
+        fv1 = (s1 - means * s0b) / (jnp.sqrt(variances) * jnp.sqrt(weights))
+        fv2 = (s2 - 2.0 * means * s1 + (means * means - variances) * s0b) / (
+            variances * jnp.sqrt(2.0 * weights)
+        )
+        return jnp.concatenate([fv1, fv2], axis=2)
+
 
 class GMMFisherVectorEstimator(Estimator, Optimizable):
     """Fit a diagonal GMM on all descriptors, return a FisherVector encoder

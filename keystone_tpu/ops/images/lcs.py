@@ -88,3 +88,55 @@ class LCSExtractor(BatchTransformer):
         # neighbor: LCSExtractor.scala:113-121)
         pairs = jnp.stack([m, sd], axis=-1)  # (N, kx, ky, C, 4, 4, 2)
         return pairs.reshape(n, len(kx) * len(ky), -1)
+
+    def apply_arrays_masked(self, x, dims):
+        """Native-resolution LCS over a size-bucketed batch
+        (see ``data.buckets``): ``x`` (N, Xb, Yb, C) padded, ``dims``
+        (N, 2) true sizes. Returns ``(descriptors, valid)`` with the
+        padded keypoint grid and a per-image validity mask.
+
+        The box filters are zero-boundary, so the padded region is
+        re-zeroed from ``dims`` first — valid keypoints then read exactly
+        what a native-size ``apply_arrays`` run reads (the reference's
+        per-image behavior, LCSExtractor.scala:56-70)."""
+        x = x.astype(jnp.float32)
+        n, xd, yd, c = x.shape
+        s = self.sub_patch_size
+        dims = jnp.asarray(dims, jnp.int32)
+        xn = dims[:, 0][:, None, None, None]
+        yn = dims[:, 1][:, None, None, None]
+        rows = jnp.arange(xd)[None, :, None, None]
+        cols = jnp.arange(yd)[None, None, :, None]
+        x = jnp.where((rows < xn) & (cols < yn), x, 0.0)
+
+        means = _box_filter_same(x, s)
+        sq = _box_filter_same(x * x, s)
+        stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+
+        kx = np.arange(self.stride_start, xd - self.stride_start, self.stride)
+        ky = np.arange(self.stride_start, yd - self.stride_start, self.stride)
+        if len(kx) == 0 or len(ky) == 0:
+            raise ValueError("bucket too small for any LCS keypoint")
+        offs = self._neighbor_offsets()
+        ax = kx[:, None] + offs[None, :]
+        ay = ky[:, None] + offs[None, :]
+        if (ax < 0).any() or (ax >= xd).any() or (ay < 0).any() or (ay >= yd).any():
+            raise ValueError(
+                "LCS neighborhood exceeds image bounds; increase stride_start"
+            )
+
+        def grid_read(img):
+            g = img[:, ax.reshape(-1), :, :][:, :, ay.reshape(-1), :]
+            g = g.reshape(n, len(kx), len(offs), len(ky), len(offs), c)
+            return jnp.transpose(g, (0, 1, 3, 5, 2, 4))
+
+        pairs = jnp.stack([grid_read(means), grid_read(stds)], axis=-1)
+        desc = pairs.reshape(n, len(kx) * len(ky), -1)
+
+        # A keypoint exists at native size iff it lies in
+        # [stride_start, native_dim - stride_start).
+        valid = (
+            (jnp.asarray(kx)[None, :, None] < (dims[:, 0] - self.stride_start)[:, None, None])
+            & (jnp.asarray(ky)[None, None, :] < (dims[:, 1] - self.stride_start)[:, None, None])
+        ).reshape(n, len(kx) * len(ky))
+        return desc * valid[..., None], valid

@@ -129,6 +129,112 @@ class SIFTExtractor(BatchTransformer):
             raise ValueError("image too small for any SIFT scale")
         return jnp.concatenate(per_scale, axis=1)
 
+    def apply_arrays_masked(self, x, dims):
+        """Native-resolution SIFT over a size-bucketed batch.
+
+        ``x`` is (N, Xb, Yb[, 1]) *edge-replicate padded* (see
+        ``data.buckets``), ``dims`` is (N, 2) true (x, y) sizes. Returns
+        ``(descriptors, valid)`` where descriptors has the padded-grid
+        shape and ``valid`` (N, n_desc) marks grid positions that exist at
+        the image's native size.
+
+        Exactness contract (the reference computes per-image at native
+        size, VLFeat.cxx:170-186): valid descriptors equal a native-size
+        ``apply_arrays`` run bit-for-float because (a) edge-replicate
+        padding reproduces the smoother's edge boundary exactly, (b) the
+        gradient stencil switches to the one-sided form at each image's
+        true border, and (c) gradient planes are zeroed outside the native
+        extent, reproducing the spatial binning's zero boundary.
+        """
+        if x.ndim == 4:
+            x = x[..., 0]
+        x = x.astype(jnp.float32)
+        dims = jnp.asarray(dims, jnp.int32)
+        per_scale, masks = [], []
+        for s in range(self.scales):
+            out = self._one_scale_masked(x, dims, s)
+            if out is not None:
+                per_scale.append(out[0])
+                masks.append(out[1])
+        if not per_scale:
+            raise ValueError("bucket too small for any SIFT scale")
+        return jnp.concatenate(per_scale, axis=1), jnp.concatenate(masks, axis=1)
+
+    def _one_scale_masked(self, x: jnp.ndarray, dims: jnp.ndarray, s: int):
+        n, xd, yd = x.shape
+        b = self.bin_size + 2 * s
+        step = self.step_size + s * self.scale_step
+        off = max(0, (1 + 2 * self.scales) - 3 * s)
+        span = (NUM_SPATIAL_BINS - 1) * b
+        nx = (xd - 1 - off - span) // step + 1
+        ny = (yd - 1 - off - span) // step + 1
+        if nx <= 0 or ny <= 0:
+            return None
+
+        xn = dims[:, 0][:, None, None]  # (N, 1, 1) true x size
+        yn = dims[:, 1][:, None, None]
+        rows = jnp.arange(xd)[None, :, None]
+        cols = jnp.arange(yd)[None, None, :]
+
+        smoothed = _separable_conv(x, _gaussian_kernel(b / MAGNIF), boundary="edge")
+
+        # Gradient stencil with the one-sided form at each image's TRUE
+        # border (not the padded buffer's) — matches the native-size run.
+        sxp = jnp.roll(smoothed, 1, axis=1)
+        sxn = jnp.roll(smoothed, -1, axis=1)
+        gx = 0.5 * (sxn - sxp)
+        gx = jnp.where(rows == 0, sxn - smoothed, gx)
+        gx = jnp.where(rows == xn - 1, smoothed - sxp, gx)
+        syp = jnp.roll(smoothed, 1, axis=2)
+        syn = jnp.roll(smoothed, -1, axis=2)
+        gy = 0.5 * (syn - syp)
+        gy = jnp.where(cols == 0, syn - smoothed, gy)
+        gy = jnp.where(cols == yn - 1, smoothed - syp, gy)
+
+        mag = jnp.sqrt(gx * gx + gy * gy)
+        theta = jnp.mod(jnp.arctan2(gy, gx), 2.0 * jnp.pi)
+        t = theta * (NUM_ORIENTATIONS / (2.0 * jnp.pi))
+
+        orient = jnp.arange(NUM_ORIENTATIONS, dtype=jnp.float32)
+        dist = jnp.abs(t[..., None] - orient)
+        dist = jnp.minimum(dist, NUM_ORIENTATIONS - dist)
+        w = jnp.maximum(0.0, 1.0 - dist)
+        planes = mag[..., None] * w
+        # Zero outside the native extent: the spatial binning then sees
+        # exactly the zero boundary the native-size run sees.
+        inside = ((rows < xn) & (cols < yn))[..., None]
+        planes = jnp.where(inside, planes, 0.0)
+
+        planes = jnp.transpose(planes, (0, 3, 1, 2)).reshape(n * NUM_ORIENTATIONS, xd, yd)
+        binned = _separable_conv(planes, _triangular_kernel(b))
+        binned = binned.reshape(n, NUM_ORIENTATIONS, xd, yd)
+
+        ox = off + np.arange(nx) * step
+        oy = off + np.arange(ny) * step
+        bx = ox[:, None] + np.arange(NUM_SPATIAL_BINS) * b
+        by = oy[:, None] + np.arange(NUM_SPATIAL_BINS) * b
+        g = binned[:, :, bx.reshape(-1), :][:, :, :, by.reshape(-1)]
+        g = g.reshape(n, NUM_ORIENTATIONS, nx, NUM_SPATIAL_BINS, ny, NUM_SPATIAL_BINS)
+        g = jnp.transpose(g, (0, 2, 4, 5, 3, 1))
+        raw = g.reshape(n, nx * ny, DESCRIPTOR_SIZE)
+
+        eps = 1e-10
+        norm1 = jnp.linalg.norm(raw, axis=-1, keepdims=True)
+        d = raw / jnp.maximum(norm1, eps)
+        d = jnp.minimum(d, 0.2)
+        d = d / jnp.maximum(jnp.linalg.norm(d, axis=-1, keepdims=True), eps)
+        d = jnp.where(norm1 > CONTRAST_THRESHOLD, d, 0.0)
+        desc = jnp.minimum(jnp.floor(512.0 * d), 255.0)
+
+        # Grid positions that exist at the native size.
+        nx_nat = jnp.maximum(0, (dims[:, 0] - 1 - off - span) // step + 1)
+        ny_nat = jnp.maximum(0, (dims[:, 1] - 1 - off - span) // step + 1)
+        valid = (
+            (jnp.arange(nx)[None, :, None] < nx_nat[:, None, None])
+            & (jnp.arange(ny)[None, None, :] < ny_nat[:, None, None])
+        ).reshape(n, nx * ny)
+        return desc * valid[..., None], valid
+
     def _one_scale(self, x: jnp.ndarray, s: int):
         n, xd, yd = x.shape
         b = self.bin_size + 2 * s

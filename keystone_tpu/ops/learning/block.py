@@ -58,6 +58,33 @@ class BlockLinearMapper(BatchTransformer):
             out = out + self.intercept
         return out
 
+    def apply_and_evaluate(self, x, evaluator):
+        """Streaming per-block apply: after adding feature block i's
+        contribution, call ``evaluator`` with the cumulative predictions
+        (+ intercept, added per call, never into the running sum) —
+        reference: BlockLinearMapper.scala:89-135 applyAndEvaluate.
+
+        Only the running (n, k) sum and one block's partial product are
+        live at a time, so predictions for all blocks are never
+        materialized together — the point of the reference API, kept here
+        for HBM rather than executor memory. Returns the list of
+        evaluator results, one per block."""
+        x = jnp.asarray(x)
+        d = x.shape[-1]
+        if self.feature_mean is not None:
+            x = x - self.feature_mean
+        w = self.weights[:d]
+        results = []
+        acc = None
+        for start in range(0, d, self.block_size):
+            xb = x[:, start : start + self.block_size]
+            wb = w[start : start + self.block_size]
+            part = linalg.mm(xb, wb)
+            acc = part if acc is None else acc + part
+            cur = acc + self.intercept if self.intercept is not None else acc
+            results.append(evaluator(cur))
+        return results
+
 
 class BlockLeastSquaresEstimator(LabelEstimator):
     """Feature-block coordinate-descent least squares

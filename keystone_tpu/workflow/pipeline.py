@@ -27,6 +27,7 @@ computations over whole batches rather than per-partition JVM loops.
 from __future__ import annotations
 
 import pickle
+import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 from ..data.dataset import ArrayDataset, Dataset, ObjectDataset, as_dataset
@@ -411,13 +412,39 @@ class FittedPipeline(Transformer):
         self.graph = graph
         self.source = source
         self.sink = sink
+        # Serving-loop fast path: the datum-bound graph is built once and
+        # reused; only the DatumOperator's payload is swapped per call,
+        # under a lock so concurrent serving calls can't read each
+        # other's datum. Safe because per-datum execution runs with
+        # optimize=False — a fresh executor per call, no cross-call memo,
+        # no prefix write-back keyed on the (mutated) operator.
+        self._datum_op: Optional[DatumOperator] = None
+        self._datum_graph: Optional[Graph] = None
+        self._datum_lock = threading.Lock()
+
+    def __getstate__(self):
+        # save() must not pickle the last served datum (or the lock).
+        state = self.__dict__.copy()
+        state["_datum_op"] = None
+        state["_datum_graph"] = None
+        state["_datum_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._datum_lock = threading.Lock()
 
     def apply(self, datum: Any) -> Any:
-        graph, node = self.graph.add_node(DatumOperator(datum), [])
-        graph = graph.replace_dependency(self.source, node)
-        graph = graph.remove_source(self.source)
-        executor = GraphExecutor(graph, optimize=False)
-        return executor.execute(self.sink).get()
+        with self._datum_lock:
+            if self._datum_graph is None:
+                self._datum_op = DatumOperator(datum)
+                graph, node = self.graph.add_node(self._datum_op, [])
+                graph = graph.replace_dependency(self.source, node)
+                self._datum_graph = graph.remove_source(self.source)
+            else:
+                self._datum_op.datum = datum
+            executor = GraphExecutor(self._datum_graph, optimize=False)
+            return executor.execute(self.sink).get()
 
     def apply_batch(self, dataset: Dataset) -> Dataset:
         graph, node = self.graph.add_node(DatasetOperator(dataset), [])

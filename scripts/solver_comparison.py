@@ -22,10 +22,15 @@ from __future__ import annotations
 
 import argparse
 import csv
+import os
 import sys
 import time
 
 import numpy as np
+
+# Runnable as `python scripts/solver_comparison.py` from anywhere: put the
+# repo root (the script's parent's parent) ahead of scripts/ on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 QUICK_GRID = [
@@ -41,72 +46,117 @@ FULL_GRID = [
     (500_000, 1024, 138, 1.0),
     (500_000, 2048, 138, 1.0),
     (1_000_000, 1024, 138, 1.0),
-    # Amazon-like sparse shapes (reference csv: n=65M, k=2, sparsity=0.005)
+    # Amazon-like sparse shapes (reference csv: n=65M, k=2, sparsity=0.005;
+    # d=16384 is the reference's widest measured sparse column, csv:12-13)
     (1_000_000, 1024, 2, 0.005),
     (1_000_000, 4096, 2, 0.005),
+    (1_000_000, 16384, 2, 0.005),
 ]
+
+# Dense-materialization ceiling: sparse problems above this many logical
+# elements only run the sparse solver (the dense ones would need the
+# densified matrix in memory).
+DENSE_ELEMS_LIMIT = 2e8
 
 
 def make_problem(n, d, k, sparsity, seed=0):
+    """Returns (x, y) — x is a scipy CSR matrix for sparse shapes (never
+    densified at generation time), a dense float32 array otherwise."""
     rng = np.random.default_rng(seed)
     w_true = rng.normal(size=(d, k)).astype(np.float32)
-    x = rng.normal(size=(n, d)).astype(np.float32)
     if sparsity < 1.0:
-        x *= (rng.random((n, d)) < sparsity).astype(np.float32)
+        import scipy.sparse as sp
+
+        x = sp.random(n, d, density=sparsity, format="csr", dtype=np.float32,
+                      random_state=seed)
+        y = np.asarray(x @ w_true, dtype=np.float32)
+        y += 0.1 * rng.normal(size=(n, k)).astype(np.float32)
+        return x, y
+    x = rng.normal(size=(n, d)).astype(np.float32)
     y = x @ w_true + 0.1 * rng.normal(size=(n, k)).astype(np.float32)
     return x, y
 
 
 def time_solver(name, fit, x, y):
     import jax
+    import scipy.sparse as sp
 
-    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.data.dataset import ArrayDataset, ObjectDataset
 
-    xd, yd = ArrayDataset(x), ArrayDataset(y)
+    is_sparse = sp.issparse(x)
+    if name == "sparse_lbfgs":
+        xd = ObjectDataset([x if is_sparse else sp.csr_matrix(x)])
+    else:
+        xd = ArrayDataset(np.asarray(x.todense()) if is_sparse else x)
+    yd = ArrayDataset(y)
     start = time.perf_counter()
     model = fit(xd, yd)
     # force: a scalar fetch guarantees completion on relay-backed devices
     float(np.asarray(jax.device_get(model.weights)).ravel()[0])
     seconds = time.perf_counter() - start
-    pred = np.asarray(model.apply_arrays(x[: min(len(x), 65536)]))
-    err = float(np.mean((pred - y[: len(pred)]) ** 2))
+    head = min(x.shape[0], 65536)
+    xh = np.asarray(x[:head].todense()) if is_sparse else x[:head]
+    pred = np.asarray(model.apply_arrays(xh))
+    err = float(np.mean((pred - y[:head]) ** 2))
     return seconds * 1000.0, err
 
 
-def solvers(reg=1e-3):
+def solvers(reg=1e-3, sparsity=1.0, n=0, d=0):
     from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
-    from keystone_tpu.ops.learning.lbfgs import DenseLBFGSEstimator
+    from keystone_tpu.ops.learning.lbfgs import (
+        DenseLBFGSEstimator,
+        SparseLBFGSEstimator,
+    )
     from keystone_tpu.ops.learning.linear import LinearMapEstimator
 
-    return {
-        "exact": lambda xd, yd: LinearMapEstimator(reg).fit(xd, yd),
-        "block": lambda xd, yd: BlockLeastSquaresEstimator(
-            1024, num_iter=3, reg=reg
-        ).fit(xd, yd),
-        "lbfgs": lambda xd, yd: DenseLBFGSEstimator(
+    out = {}
+    if sparsity >= 1.0 or n * d <= DENSE_ELEMS_LIMIT:
+        out.update(
+            {
+                "exact": lambda xd, yd: LinearMapEstimator(reg).fit(xd, yd),
+                "block": lambda xd, yd: BlockLeastSquaresEstimator(
+                    1024, num_iter=3, reg=reg
+                ).fit(xd, yd),
+                "lbfgs": lambda xd, yd: DenseLBFGSEstimator(
+                    num_iterations=20, reg=reg
+                ).fit(xd, yd),
+            }
+        )
+    if sparsity < 1.0:
+        out["sparse_lbfgs"] = lambda xd, yd: SparseLBFGSEstimator(
             num_iterations=20, reg=reg
-        ).fit(xd, yd),
-    }
+        ).fit(xd, yd)
+    return out
 
 
-def flops_bytes_moved(name, n, d, k, sparsity, num_machines):
-    """Cost-model features per solver (mirrors each solver's cost())."""
-    nnz = n * d * sparsity
+def cost_features(name, n, d, k, sparsity, num_machines):
+    """Per-solver (flops, elements scanned, elements moved) — the EXACT
+    expressions the CostModel classes use
+    (keystone_tpu/ops/learning/least_squares.py:_ExactCost/_BlockSolveCost/
+    _DenseLBFGSCost; keep in sync), in the raw units CostWeights carries
+    (ms per flop / per fp32 element). Fitting ms ≈ cpu·flops + mem·elems
+    + net·moved is the linearization of cost()'s max(cpu·flops,
+    mem·elems) + net·moved — exact whenever one term dominates, which it
+    does at the measured shapes."""
+    m = num_machines
+    log_m = np.log2(max(2, m))
     if name == "exact":
-        flops = nnz * d + d * d * d / 3
-        mem = nnz * 4
-        net = d * d * 4 * np.log2(max(2, num_machines))
+        flops = n * d * (d + k) / m + d * d * d
+        elems = n * d / m + d * d
+        moved = d * (d + k)
     elif name == "block":
-        iters = 3 * (d // 1024 + 1)
-        flops = iters * (nnz * 1024 + 1024**3 / 3)
-        mem = iters * nnz * 4
-        net = iters * 1024 * k * 4 * np.log2(max(2, num_machines))
-    else:  # lbfgs
+        b = 1024
+        iters = 3 * max(d // b, 1)
+        flops = iters * (n * b * (b + k)) / m
+        elems = iters * n * b / m
+        moved = iters * (b * b + b * k) * log_m
+    else:  # lbfgs / sparse_lbfgs (cost: _DenseLBFGSCost with sparsity)
         iters = 20
-        flops = iters * 2 * nnz * k
-        mem = iters * nnz * 4
-        net = iters * d * k * 4 * np.log2(max(2, num_machines))
-    return flops / 1e6, mem / 1e6, net / 1e6  # Mflop, MB, MB
+        sp_ = max(sparsity, 1e-12)
+        flops = iters * n * d * k * sp_ / m
+        elems = iters * n * d * sp_ / m
+        moved = iters * d * k * log_m
+    return flops, elems, moved
 
 
 def main(argv=None):
@@ -129,7 +179,7 @@ def main(argv=None):
     rows = []
     for n, d, k, sparsity in grid:
         x, y = make_problem(n, d, k, sparsity)
-        for name, fit in solvers(args.reg).items():
+        for name, fit in solvers(args.reg, sparsity=sparsity, n=n, d=d).items():
             ms, err = time_solver(name, fit, x, y)
             rows.append(
                 {
@@ -147,14 +197,17 @@ def main(argv=None):
     print(f"wrote {args.out} ({len(rows)} measurements)")
 
     if args.fit_constants:
-        # Non-negative LS fit of ms ≈ cpu·Mflop + mem·MB + net·MBmoved
-        # (the reference's constantEstimator.R equivalent).
+        # Non-negative LS fit of ms ≈ cpu·flops + mem·elems + net·moved in
+        # the raw units cost() consumes (the reference's
+        # constantEstimator.R equivalent).
         from scipy.optimize import nnls
+
+        from keystone_tpu.ops.learning.cost import tpu_weights
 
         feats, times = [], []
         for r in rows:
             feats.append(
-                flops_bytes_moved(
+                cost_features(
                     r["solver"], r["n"], r["d"], r["k"], r["sparsity"], num_machines
                 )
             )
@@ -162,37 +215,47 @@ def main(argv=None):
         A = np.asarray(feats)
         t = np.asarray(times)
         w, residual = nnls(A, t)
-        print(
-            "fitted CostWeights(cpu=%.3e, mem=%.3e, network=%.3e)  # ms per Mflop/MB"
-            % tuple(w)
-        )
         if (w <= 0).all():
             print("degenerate fit (all-zero weights); not persisting")
             return 1
-        # Persist in the raw units cost() uses (ms per flop / per fp32
-        # element): Mflop → flop is /1e6; MB → element is /1e6 then ×4
-        # bytes per element. Committing this file makes the measured
-        # constants the default on TPU (cost.measured_tpu_weights).
-        if jax.default_backend() != "cpu":
-            import json
+        # nnls zeroes weights at active constraints; a zero-cost resource
+        # is unphysical and would make the meta-solver treat that term as
+        # free everywhere. Floor each component at 1% of the
+        # first-principles value.
+        fp = tpu_weights()
+        w = np.maximum(w, 0.01 * np.asarray([fp.cpu, fp.mem, fp.network]))
+        print(
+            "fitted CostWeights(cpu=%.3e, mem=%.3e, network=%.3e)  "
+            "# ms per flop / fp32 element" % tuple(w)
+        )
+        # Committing the in-package file makes the measured constants the
+        # default on TPU (cost.measured_tpu_weights). On CPU nothing is
+        # persisted unless --constants-out names an explicit destination.
+        import json
 
-            from keystone_tpu.ops.learning.cost import MEASURED_CONSTANTS_PATH
+        from keystone_tpu.ops.learning.cost import MEASURED_CONSTANTS_PATH
 
+        on_accelerator = jax.default_backend() != "cpu"
+        out_path = args.constants_out or (
+            MEASURED_CONSTANTS_PATH if on_accelerator else None
+        )
+        if out_path is not None:
             payload = {
-                "cpu": float(w[0] / 1e6),
-                "mem": float(w[1] / 1e6 * 4.0),
-                "network": float(w[2] / 1e6 * 4.0),
+                "cpu": float(w[0]),
+                "mem": float(w[1]),
+                "network": float(w[2]),
                 "fitted_on": getattr(jax.devices()[0], "device_kind", "unknown"),
                 "preset": args.preset,
                 "fit_residual_ms": float(residual),
             }
-            out_path = args.constants_out or MEASURED_CONSTANTS_PATH
             try:
                 with open(out_path, "w") as f:
                     json.dump(payload, f, indent=1)
                 print(f"wrote {out_path}")
             except OSError as e:
                 print(f"could not write {out_path} ({e}); constants printed above")
+        else:
+            print("cpu backend and no --constants-out: constants printed only")
     return 0
 
 

@@ -71,3 +71,56 @@ def test_imagenet_sift_lcs_fv_end_to_end(imagenet_fixture):
     assert results["test_error_percent"] <= 50.0
     pipeline = results["pipeline"]
     assert pipeline is not None
+
+
+def test_native_resolution_run_end_to_end(tmp_path):
+    """image_size=None path: mixed-size JPEGs → buckets → masked dual-branch
+    featurization → weighted solve, end to end."""
+    import io
+    import tarfile
+
+    import pytest
+
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image as PILImage
+
+    from keystone_tpu.pipelines.imagenet import (
+        ImageNetSiftLcsFVConfig,
+        run_native_resolution,
+    )
+
+    rng = np.random.default_rng(0)
+
+    def jpeg(w, h):
+        arr = (rng.random((h, w, 3)) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        PILImage.fromarray(arr).save(buf, format="JPEG", quality=95)
+        return buf.getvalue()
+
+    tar_path = tmp_path / "shard.tar"
+    sizes = [(48, 48), (50, 44), (72, 64), (48, 48), (60, 70), (44, 50)]
+    with tarfile.open(tar_path, "w") as tar:
+        for i, (w, h) in enumerate(sizes):
+            cls = "n01" if i % 2 == 0 else "n02"
+            payload = jpeg(w, h)
+            info = tarfile.TarInfo(f"{cls}/img{i}.jpg")
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+    (tmp_path / "labels.txt").write_text("n01 0\nn02 1\n")
+
+    config = ImageNetSiftLcsFVConfig(
+        train_location=str(tar_path),
+        label_path=str(tmp_path / "labels.txt"),
+        desc_dim=8,
+        vocab_size=2,
+        num_classes=2,
+        num_pca_samples=2000,
+        num_gmm_samples=2000,
+        solver_block_size=64,
+        image_size=None,
+        lcs_stride=8,
+    )
+    results = run_native_resolution(config)
+    assert results["num_train"] == 6
+    assert results["num_buckets"] >= 2
+    assert 0.0 <= results["train_error_percent"] <= 100.0

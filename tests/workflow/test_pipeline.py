@@ -174,3 +174,18 @@ def test_tracing_off_by_default_keeps_laziness():
     assert calls == []  # untraced application stays lazy until forced
     assert result.get().collect() == [2, 3]
     assert calls == [1, 2]
+
+
+def test_fitted_pipeline_apply_is_thread_safe():
+    """Concurrent serving calls must each get their own datum's result
+    (the memoized datum-graph fast path swaps a shared operator under a
+    lock)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    est = CountingEstimator()
+    fitted = (Plus(1) >> est.with_data(ObjectDataset([2.0, 4.0]))).fit()
+    inputs = [float(i) for i in range(64)]
+    expected = [fitted.apply(v) for v in inputs]
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        got = list(pool.map(fitted.apply, inputs))
+    assert got == expected
