@@ -266,20 +266,23 @@ class KernelBlockLinearMapper(BatchTransformer):
         xt = linalg.prepare_row_sharded(_pad_rows_to(jnp.asarray(x, jnp.float32), m_pad), mesh)
         train_sharded = linalg.prepare_row_sharded(self.train, mesh)
         duals_sharded = linalg.prepare_row_sharded(self.duals, mesh)
-        out = _ring_kernel_apply(mesh)(
-            xt, train_sharded, duals_sharded, jnp.float32(self.gamma)
+        from ..pallas.kernel_apply import fused_apply_enabled
+
+        fused = fused_apply_enabled(self.train.shape[1], self.duals.shape[1])
+        out = _ring_kernel_apply(mesh, fused, float(self.gamma))(
+            xt, train_sharded, duals_sharded
         )
         return out[:m]
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_kernel_apply(mesh: Mesh):
+def _ring_kernel_apply(mesh: Mesh, fused: bool = False, gamma: float = 1.0):
     axes = row_axes(mesh)
     nd = mesh.shape[DATA_AXIS]
     nr = mesh.shape.get(REPLICA_AXIS, 1)
     nshards = nd * nr
 
-    def per_device(xt_local, xs, ws, gamma):
+    def per_device(xt_local, xs, ws):
         data_perm = [(j, (j + 1) % nd) for j in range(nd)]
         replica_perm = [(j, (j + 1) % nr) for j in range(nr)]
 
@@ -288,8 +291,15 @@ def _ring_kernel_apply(mesh: Mesh):
 
         def ring_step(i, carry):
             acc, xs, ws = carry
-            panel = gaussian_kernel_block(xt_local, xs, gamma)
-            acc = acc + linalg.mm(panel, ws)
+            if fused:
+                # Flash-style fused hop: the kernel panel lives only in
+                # VMEM (ops.pallas.kernel_apply) — no (m, n) HBM panel.
+                from ..pallas.kernel_apply import fused_gaussian_apply
+
+                acc = acc + fused_gaussian_apply(xt_local, xs, ws, float(gamma))
+            else:
+                panel = gaussian_kernel_block(xt_local, xs, gamma)
+                acc = acc + linalg.mm(panel, ws)
             # inner ICI ring every step; after each full data cycle the
             # shards hop once across the DCN replica ring, so nd*nr steps
             # visit every (replica, data) shard exactly once.

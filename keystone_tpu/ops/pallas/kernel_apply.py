@@ -1,0 +1,110 @@
+"""Fused kernel-panel apply: O = exp(−γ‖x_i−y_j‖²) · W in one Pallas pass.
+
+The kernel ridge apply path (reference:
+KernelBlockLinearMapper.scala:28-90) computes, per ring hop,
+``panel = K(X_test, X_shard); acc += panel @ W_shard``. XLA must
+materialize the (m, n) panel in HBM and read it back for the matmul —
+2·m·n·4 bytes of HBM traffic per hop that exists only as glue.
+
+This kernel is the flash-attention schedule applied to kernel regression
+(scores → pointwise transform → weighted sum of values, minus the
+softmax): each (TM, TN) panel tile lives only in VMEM — MXU for x·yᵀ, VPU
+for the exp epilogue, MXU again for tile·W — and the only HBM writes are
+the (m, k) output. For m=n=8192, k≤512 that removes ~0.5 GB of panel
+traffic per hop. It is also the fused ring-rotation variant promised by
+``ops.pallas.gaussian``: the ring loop calls it per hop when enabled.
+
+Dispatch is opt-in (``KEYSTONE_PALLAS_KAPPLY=1``) until measured on-chip;
+``bench.py`` times both paths so the default can be flipped on evidence.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+TILE_M = 256
+TILE_N = 256
+# (TM, d) + (TN, d) fp32 operand tiles must fit VMEM alongside the
+# (TM, k) accumulator; 4096 keeps the working set ≤ ~10 MB at k=512.
+MAX_FUSED_DIM = 4096
+MAX_FUSED_K = 512
+
+
+def fused_apply_enabled(d: int, k: int) -> bool:
+    if os.environ.get("KEYSTONE_PALLAS_KAPPLY", "0") != "1":
+        return False
+    if d > MAX_FUSED_DIM or k > MAX_FUSED_K:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(x_ref, y_ref, w_ref, o_ref, *, gamma: float):
+    j = pl.program_id(1)
+    x = x_ref[:]  # (TM, d)
+    y = y_ref[:]  # (TN, d)
+    w = w_ref[:]  # (TN, k)
+    ab = jax.lax.dot_general(
+        x, y, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    an = jnp.sum(x * x, axis=1, keepdims=True)
+    bn = jnp.sum(y * y, axis=1)[None, :]
+    tile = jnp.exp(-gamma * jnp.maximum(an - 2.0 * ab + bn, 0.0))
+    contrib = jax.lax.dot_general(
+        tile, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(j == 0)
+    def _init():
+        o_ref[:] = contrib
+
+    @pl.when(j != 0)
+    def _accumulate():
+        o_ref[:] += contrib
+
+
+@functools.partial(jax.jit, static_argnames=("gamma", "interpret"))
+def fused_gaussian_apply(x, y, w, gamma: float, interpret: bool = False):
+    """exp(−γ‖x_i−y_j‖²) · W, panel tiles never leaving VMEM.
+
+    x: (m, d) queries, y: (n, d) anchors, w: (n, k) values. Rows are
+    padded to tile multiples internally; padded y rows produce nonzero
+    kernel values but their zero-padded w rows null the contribution, so
+    the result equals the unpadded product exactly.
+    """
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
+    m, d = x.shape
+    n, k = w.shape
+    assert y.shape == (n, d), (y.shape, (n, d))
+
+    mp = -(-m // TILE_M) * TILE_M
+    np_ = -(-n // TILE_N) * TILE_N
+    if mp != m:
+        x = jnp.pad(x, ((0, mp - m), (0, 0)))
+    if np_ != n:
+        y = jnp.pad(y, ((0, np_ - n), (0, 0)))
+        w = jnp.pad(w, ((0, np_ - n), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, gamma=float(gamma)),
+        out_shape=jax.ShapeDtypeStruct((mp, k), jnp.float32),
+        grid=(mp // TILE_M, np_ // TILE_N),
+        in_specs=[
+            pl.BlockSpec((TILE_M, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((TILE_N, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((TILE_N, k), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((TILE_M, k), lambda i, j: (i, 0)),
+        interpret=interpret,
+    )(x, y, w)
+    return out[:m]
