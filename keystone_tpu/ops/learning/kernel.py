@@ -269,20 +269,24 @@ class KernelBlockLinearMapper(BatchTransformer):
         from ..pallas.kernel_apply import fused_apply_enabled
 
         fused = fused_apply_enabled(self.train.shape[1], self.duals.shape[1])
-        out = _ring_kernel_apply(mesh, fused, float(self.gamma))(
-            xt, train_sharded, duals_sharded
+        # Pallas needs gamma static; the XLA branch keeps it traced so one
+        # compiled executable serves every gamma (no per-gamma cache leak).
+        static_gamma = float(self.gamma) if fused else None
+        out = _ring_kernel_apply(mesh, fused, static_gamma)(
+            xt, train_sharded, duals_sharded, jnp.float32(self.gamma)
         )
         return out[:m]
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_kernel_apply(mesh: Mesh, fused: bool = False, gamma: float = 1.0):
+def _ring_kernel_apply(mesh: Mesh, fused: bool = False,
+                       static_gamma: Optional[float] = None):
     axes = row_axes(mesh)
     nd = mesh.shape[DATA_AXIS]
     nr = mesh.shape.get(REPLICA_AXIS, 1)
     nshards = nd * nr
 
-    def per_device(xt_local, xs, ws):
+    def per_device(xt_local, xs, ws, gamma):
         data_perm = [(j, (j + 1) % nd) for j in range(nd)]
         replica_perm = [(j, (j + 1) % nr) for j in range(nr)]
 
@@ -296,7 +300,7 @@ def _ring_kernel_apply(mesh: Mesh, fused: bool = False, gamma: float = 1.0):
                 # VMEM (ops.pallas.kernel_apply) — no (m, n) HBM panel.
                 from ..pallas.kernel_apply import fused_gaussian_apply
 
-                acc = acc + fused_gaussian_apply(xt_local, xs, ws, float(gamma))
+                acc = acc + fused_gaussian_apply(xt_local, xs, ws, static_gamma)
             else:
                 panel = gaussian_kernel_block(xt_local, xs, gamma)
                 acc = acc + linalg.mm(panel, ws)
@@ -321,7 +325,7 @@ def _ring_kernel_apply(mesh: Mesh, fused: bool = False, gamma: float = 1.0):
         in_specs=(P(axes, None), P(axes, None), P(axes, None), P()),
         out_specs=P(axes, None),
     )
-    return jax.jit(fn)
+    return jax.jit(fn)  # gamma (4th arg) is replicated + traced
 
 
 def _linear_shard_index(mesh: Mesh, axes):
