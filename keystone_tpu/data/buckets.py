@@ -119,3 +119,17 @@ def bucketize_dataset(
         dataset.collect(), granularity=granularity, pad_mode=pad_mode,
         label_key=label_key,
     )
+
+
+def to_bucketed_dataset(buckets: List[ImageBucket]):
+    """Wrap ImageBuckets as a workflow-executable BucketedDataset whose
+    per-bucket data is ``{"image", "dims"[, "label"]}`` — the shape the
+    masked extractors (``ops.images.native``) consume."""
+    from .dataset import BucketedDataset
+
+    return BucketedDataset([b.to_dataset() for b in buckets])
+
+
+def bucket_labels(buckets: List[ImageBucket]) -> np.ndarray:
+    """Labels in ``BucketedDataset.concat()`` (bucket-major) order."""
+    return np.concatenate([b.labels for b in buckets])

@@ -220,6 +220,69 @@ class ArrayDataset(Dataset):
         return f"ArrayDataset(n={self.num_examples}, shapes={shapes})"
 
 
+class BucketedDataset(Dataset):
+    """A logical dataset physically stored as static-shape groups.
+
+    The native-resolution path (SURVEY §7 hard part 4) groups images by
+    padded size so each group is one XLA compilation; this class makes
+    those groups a first-class Dataset the workflow layer can execute —
+    batched transformers map per bucket, estimators consume the
+    concatenation — so native-resolution pipelines flow through the
+    optimizer/autocache/prefix-reuse machinery instead of a bespoke host
+    loop. Example order is bucket-major and stable across ops, so labels
+    aligned to ``concat()`` order stay aligned downstream.
+    """
+
+    def __init__(self, buckets: Sequence["ArrayDataset"]):
+        if not buckets:
+            raise ValueError("BucketedDataset needs at least one bucket")
+        self.buckets = list(buckets)
+
+    # ------------------------------------------------------------- protocol
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+    def collect(self) -> List[Any]:
+        out: List[Any] = []
+        for b in self.buckets:
+            out.extend(b.collect())
+        return out
+
+    def map(self, fn: Callable[[Any], Any]) -> "ObjectDataset":
+        return ObjectDataset([fn(x) for x in self.collect()])
+
+    def map_datasets(self, fn: Callable[["ArrayDataset"], "ArrayDataset"]) -> "BucketedDataset":
+        """Apply a per-bucket Dataset→Dataset function (the workflow-layer
+        entry point: one static-shape computation per bucket)."""
+        return BucketedDataset([fn(b) for b in self.buckets])
+
+    def map_batched(self, fn: Callable[[Any], Any]) -> "BucketedDataset":
+        return BucketedDataset([b.map_batched(fn) for b in self.buckets])
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.buckets)
+
+    def per_shard_counts(self) -> List[int]:
+        return [len(b) for b in self.buckets]
+
+    def concat(self) -> "ArrayDataset":
+        """Concatenate buckets along the example axis (valid once trailing
+        shapes agree — e.g. after Fisher encoding collapses per-bucket
+        descriptor grids to fixed-width features)."""
+        datas = [
+            jax.tree_util.tree_map(lambda a: a[: len(b)], b.data)
+            for b in self.buckets
+        ]
+        joined = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *datas
+        )
+        return ArrayDataset(joined)
+
+    def __repr__(self) -> str:
+        return f"BucketedDataset(buckets={[len(b) for b in self.buckets]})"
+
+
 def as_dataset(value: Any) -> Dataset:
     """Coerce lists/arrays into a Dataset."""
     if isinstance(value, Dataset):

@@ -4,6 +4,7 @@ from .core import (
     CenterCornerPatcher,
     Convolver,
     Cropper,
+    FusedConvFeaturizer,
     GrayScaler,
     ImageExtractor,
     ImageVectorizer,
@@ -34,6 +35,7 @@ __all__ = [
     "CenterCornerPatcher",
     "Convolver",
     "Cropper",
+    "FusedConvFeaturizer",
     "GrayScaler",
     "ImageExtractor",
     "ImageVectorizer",

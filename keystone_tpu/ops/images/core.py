@@ -190,6 +190,93 @@ class Convolver(BatchTransformer):
         return out
 
 
+class FusedConvFeaturizer(BatchTransformer):
+    """Memory-bounded conv → symmetric-rectify → pool → vectorize.
+
+    Computes exactly ``ImageVectorizer(pool(rect(conv(x))))`` but scans
+    over blocks of ``filter_block`` filters so the full (N, rx, ry, F)
+    convolution output never materializes — per scan step only one
+    (N, rx, ry, filter_block) panel plus the tiny pooled accumulator are
+    live. At the reference CIFAR config (numFilters=10000,
+    examples/images/cifar_random_patch.sh:30-36) the unfused intermediate
+    is ~37 GB for a 1k-image batch; the fused form is bounded by the block
+    panel regardless of F. Channel layout matches the unfused ops: pooled
+    positives for all F filters, then pooled negatives for all F.
+    """
+
+    def __init__(
+        self,
+        convolver: "Convolver",
+        rectifier: "SymmetricRectifier",
+        pooler: "Pooler",
+        filter_block: int = 512,
+    ):
+        self.conv = convolver
+        self.rect = rectifier
+        self.pool = pooler
+        self.filter_block = filter_block
+
+    def apply_arrays(self, x):
+        conv, rect, pool = self.conv, self.rect, self.pool
+        x = x.astype(jnp.float32)
+        n = x.shape[0]
+        f = conv.num_filters
+        fb = min(self.filter_block, f)
+        nb = -(-f // fb)
+        f_pad = nb * fb
+
+        kernel = conv.kernel  # (s, s, c, F)
+        fsums = conv.filter_sums
+        offset = conv.offset if conv.offset is not None else jnp.zeros((f,), jnp.float32)
+        if f_pad != f:
+            kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, 0), (0, f_pad - f)))
+            fsums = jnp.pad(fsums, (0, f_pad - f))
+            offset = jnp.pad(offset, (0, f_pad - f))
+        s = conv.conv_size
+        c = conv.img_channels
+        kblocks = jnp.moveaxis(kernel.reshape(s, s, c, nb, fb), 3, 0)  # (nb, s, s, c, fb)
+        fsum_blocks = fsums.reshape(nb, fb)
+        offset_blocks = offset.reshape(nb, fb)
+
+        if conv.normalize_patches:
+            d = float(s * s * c)
+            ones = jnp.ones((s, s, c, 1), dtype=jnp.float32)
+            box = partial(
+                lax.conv_general_dilated,
+                rhs=ones,
+                window_strides=(1, 1),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            m = box(x) / d  # (N, rx, ry, 1)
+            var = jnp.maximum(box(x * x) - d * m * m, 0.0) / (d - 1.0)
+            sd = jnp.sqrt(var + conv.var_constant)
+        else:
+            m = sd = None
+
+        def block_step(_, inputs):
+            kb, fs_b, off_b = inputs
+            raw = lax.conv_general_dilated(
+                x, kb, (1, 1), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            out = (raw - m * fs_b) / sd if m is not None else raw
+            out = out - off_b
+            pos = jnp.maximum(rect.max_val, out - rect.alpha)
+            neg = jnp.maximum(rect.max_val, -out - rect.alpha)
+            return _, (pool.apply_arrays(pos), pool.apply_arrays(neg))
+
+        _, (pp, pn) = lax.scan(
+            block_step, None, (kblocks, fsum_blocks, offset_blocks)
+        )
+        # (nb, N, px, py, fb) → (N, px, py, nb·fb) in global filter order.
+        px, py = pp.shape[2], pp.shape[3]
+        pp = jnp.moveaxis(pp, 0, 3).reshape(n, px, py, f_pad)[..., :f]
+        pn = jnp.moveaxis(pn, 0, 3).reshape(n, px, py, f_pad)[..., :f]
+        pooled = jnp.concatenate([pp, pn], axis=-1)
+        return jnp.transpose(pooled, (0, 2, 1, 3)).reshape(n, -1)
+
+
 _POOL_FUNCTIONS = {
     "sum": (lax.add, 0.0),
     "max": (lax.max, -jnp.inf),

@@ -85,6 +85,25 @@ class FisherVector(BatchTransformer):
         )
         return jnp.concatenate([fv1, fv2], axis=2)
 
+    def apply_batch(self, dataset):
+        """Masked-descriptor datasets ({"desc", "valid"}) encode through
+        ``apply_arrays_masked`` and come out dense — the boundary where
+        the native-resolution raggedness collapses to fixed-width rows."""
+        from ...data.dataset import ArrayDataset, BucketedDataset
+
+        if isinstance(dataset, BucketedDataset):
+            return dataset.map_datasets(self.apply_batch)
+        if (
+            isinstance(dataset, ArrayDataset)
+            and isinstance(dataset.data, dict)
+            and "valid" in dataset.data
+        ):
+            out = self.apply_arrays_masked(
+                dataset.data["desc"], dataset.data["valid"]
+            )
+            return ArrayDataset(out, dataset.num_examples)
+        return super().apply_batch(dataset)
+
 
 class GMMFisherVectorEstimator(Estimator, Optimizable):
     """Fit a diagonal GMM on all descriptors, return a FisherVector encoder

@@ -95,10 +95,20 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     reference weights it: 3·num_iter + 1 passes over the data.
     """
 
-    def __init__(self, block_size: int, num_iter: int = 1, reg: float = 0.0):
+    def __init__(
+        self,
+        block_size: int,
+        num_iter: int = 1,
+        reg: float = 0.0,
+        host_streaming: Optional[bool] = None,
+    ):
         self.block_size = block_size
         self.num_iter = num_iter
         self.reg = reg
+        # None = auto: stream feature blocks from host RAM when the feature
+        # matrix is a host array too large to sit in HBM next to its
+        # centered copy and Gram workspace.
+        self.host_streaming = host_streaming
 
     @property
     def weight(self) -> int:
@@ -108,6 +118,34 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
         mesh = get_mesh()
+
+        raw = features.data
+        stream = self.host_streaming
+        if stream is None:
+            # Auto-stream only on pure data meshes: the streaming solver's
+            # shard_map spans the row axes only, so on a (data, model) mesh
+            # it would replicate every block's work across the model axis —
+            # the 2-D in-core path below owns that layout.
+            stream = (
+                isinstance(raw, np.ndarray)
+                and raw.nbytes > _host_streaming_threshold_bytes()
+                and linalg.model_axis_size(mesh) == 1
+            )
+        if stream:
+            reg = self.reg if self.reg > 0 else 1e-6
+            w, mu_a, mu_b = linalg.block_coordinate_descent_streaming(
+                np.asarray(raw),
+                np.asarray(targets.data, np.float32),
+                reg=reg,
+                num_epochs=self.num_iter,
+                block_size=min(self.block_size, raw.shape[1]),
+                num_examples=features.num_examples,
+                mesh=mesh,
+            )
+            return BlockLinearMapper(
+                w, block_size=min(self.block_size, raw.shape[1]),
+                intercept=mu_b, feature_mean=mu_a,
+            )
 
         x = jnp.asarray(features.data, dtype=jnp.float32)
         y = jnp.asarray(targets.data, dtype=jnp.float32)
@@ -150,3 +188,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+def _host_streaming_threshold_bytes() -> int:
+    """Above this, a host ndarray feature matrix is streamed block-by-block
+    instead of placed whole in HBM. Default 4 GB (the in-core path also
+    materializes a centered copy, so real residency is ~2× + Gram
+    workspace); override with KEYSTONE_STREAM_BYTES."""
+    import os
+
+    return int(float(os.environ.get("KEYSTONE_STREAM_BYTES", 4e9)))

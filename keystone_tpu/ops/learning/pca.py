@@ -71,7 +71,22 @@ class BatchPCATransformer(Transformer):
         return np.asarray(mat) @ np.asarray(self.components)
 
     def apply_batch(self, dataset: Dataset) -> Dataset:
+        from ...data.dataset import BucketedDataset
+
+        if isinstance(dataset, BucketedDataset):
+            return dataset.map_datasets(self.apply_batch)
         if isinstance(dataset, ArrayDataset):
+            if isinstance(dataset.data, dict) and "valid" in dataset.data:
+                # Masked descriptors: project, validity flows through
+                # (zero rows stay zero under a right-multiply).
+                out = jnp.einsum(
+                    "ncd,dk->nck", jnp.asarray(dataset.data["desc"]),
+                    self.components, precision=linalg.PRECISION,
+                )
+                return ArrayDataset(
+                    {"desc": out, "valid": dataset.data["valid"]},
+                    dataset.num_examples,
+                )
             x = jnp.asarray(dataset.data)
             if x.ndim == 2:  # flat (n, d) descriptor rows
                 out = linalg.mm(x, self.components)
@@ -259,8 +274,14 @@ class ColumnPCAEstimator(Estimator, Optimizable, CostModel):
         items = sample.take(8)
         if not items:
             return self.distributed
-        cols = float(np.mean([np.asarray(m).shape[0] for m in items]))
-        d = int(np.asarray(items[0]).shape[1])
+        if isinstance(items[0], dict) and "valid" in items[0]:
+            # Masked-descriptor items ({"desc": (n_pad, d), "valid": ...}):
+            # the true per-item descriptor count is the valid total.
+            cols = float(np.mean([np.asarray(m["valid"]).sum() for m in items]))
+            d = int(np.asarray(items[0]["desc"]).shape[-1])
+        else:
+            cols = float(np.mean([np.asarray(m).shape[0] for m in items]))
+            d = int(np.asarray(items[0]).shape[1])
         n = int(cols * stats.n_total)
         machines = self.num_machines or num_devices()
         lc = self.local.cost(n, d, self.dims, 1.0, machines, self.weights)

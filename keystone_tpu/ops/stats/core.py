@@ -222,6 +222,20 @@ class ColumnSampler(Transformer):
         return self._sample(datum, np.random.default_rng(self.seed))
 
     def apply_batch(self, dataset: Dataset) -> ArrayDataset:
+        from ...data.dataset import BucketedDataset
+
+        if isinstance(dataset, BucketedDataset):
+            # Masked/bucketed descriptors: sample on device per bucket
+            # (Gumbel top-k over valid slots — no host desc[valid] fancy
+            # indexing), concatenate the small sample matrices.
+            parts = [
+                np.asarray(self._sample_bucket(b, i).data)
+                for i, b in enumerate(dataset.buckets)
+            ]
+            return ArrayDataset(np.concatenate(parts, axis=0))
+        if isinstance(dataset, ArrayDataset) and isinstance(dataset.data, dict) \
+                and "valid" in dataset.data:
+            return self._sample_bucket(dataset, 0)
         if isinstance(dataset, ArrayDataset):
             # (N, c, d) uniform batch: one vectorized gather per batch.
             x = np.asarray(dataset.data)[: dataset.num_examples]
@@ -238,8 +252,35 @@ class ColumnSampler(Transformer):
         rows = [self._sample(item, rng) for item in dataset.collect()]
         return ArrayDataset(np.concatenate(rows, axis=0))
 
+    def _sample_bucket(self, bucket: ArrayDataset, bucket_idx: int) -> ArrayDataset:
+        """Uniform sample-without-replacement of valid descriptors, on
+        device: Gumbel perturbation + top_k over the flattened valid slots
+        (invalid slots get −inf, so they are never chosen while the take
+        count stays within the valid total)."""
+        import jax
+
+        desc = jnp.asarray(bucket.data["desc"])
+        valid = jnp.asarray(bucket.data["valid"])
+        n = bucket.num_examples
+        desc = desc[:n]
+        valid = valid[:n]
+        flat = desc.reshape(-1, desc.shape[-1])
+        v = valid.reshape(-1).astype(bool)
+        num_valid = int(jnp.sum(v))  # one scalar fetch per bucket
+        take = min(self.num_samples_per_item * n, num_valid)
+        if take == 0:
+            return ArrayDataset(np.zeros((0, desc.shape[-1]), np.float32))
+        key = jax.random.PRNGKey(self.seed + 7919 * bucket_idx)
+        g = jax.random.gumbel(key, v.shape) + jnp.where(v, 0.0, -jnp.inf)
+        _, idx = jax.lax.top_k(g, take)
+        return ArrayDataset(flat[idx])
+
 
 def _as_array_dataset(data: Dataset) -> ArrayDataset:
     if isinstance(data, ArrayDataset):
         return data
+    from ...data.dataset import BucketedDataset
+
+    if isinstance(data, BucketedDataset):
+        return data.concat()
     return data.to_arrays()  # type: ignore[attr-defined]

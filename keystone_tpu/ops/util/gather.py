@@ -25,6 +25,17 @@ class GatherTransformer(TransformerOperator):
         return list(datums)
 
     def batch_transform(self, datasets: List[Dataset]) -> Dataset:
+        from ...data.dataset import BucketedDataset
+
+        if all(isinstance(d, BucketedDataset) for d in datasets):
+            counts = {tuple(len(b) for b in d.buckets) for d in datasets}
+            if len(counts) == 1:  # aligned buckets: gather bucket-wise
+                return BucketedDataset(
+                    [
+                        self.batch_transform(list(bs))
+                        for bs in zip(*(d.buckets for d in datasets))
+                    ]
+                )
         if all(isinstance(d, ArrayDataset) for d in datasets):
             import jax
 

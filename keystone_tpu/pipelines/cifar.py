@@ -28,6 +28,7 @@ from ..data.loaders.cifar import load_cifar
 from ..evaluation.multiclass import MulticlassClassifierEvaluator
 from ..ops.images import (
     Convolver,
+    FusedConvFeaturizer,
     GrayScaler,
     ImageVectorizer,
     Pooler,
@@ -73,6 +74,9 @@ class RandomCifarConfig:
     augment_img_size: int = 24
     flip_chance: float = 0.5
     seed: int = 12334
+    # memory bound for the featurizer: filters per fused conv block (the
+    # (N, rx, ry, numFilters) conv output never materializes).
+    filter_block: int = 512
 
 
 def _load(config_location: str, sample_frac: Optional[float], seed: int) -> ArrayDataset:
@@ -180,13 +184,12 @@ def build_random_patch(
             size=(config.num_filters, config.patch_size**2 * NUM_CHANNELS)
         ).astype(np.float32)
 
-    featurizer = (
-        Convolver(filters, NUM_CHANNELS, whitener=whitener, normalize_patches=True)
-        .to_pipeline()
-        .then(SymmetricRectifier(alpha=config.alpha))
-        .then(Pooler(config.pool_stride, config.pool_size, None, "sum"))
-        .then(ImageVectorizer())
-    )
+    featurizer = FusedConvFeaturizer(
+        Convolver(filters, NUM_CHANNELS, whitener=whitener, normalize_patches=True),
+        SymmetricRectifier(alpha=config.alpha),
+        Pooler(config.pool_stride, config.pool_size, None, "sum"),
+        filter_block=config.filter_block,
+    ).to_pipeline()
     scaled = featurizer.then_estimator(StandardScaler(), train_images)
     if solver == "block":
         fitted = scaled.then_label_estimator(

@@ -178,125 +178,102 @@ def build_pipeline(
     ) >> TopKClassifier(5)
 
 
-def _branch_features_bucketed(
-    buckets,
-    extract_fn,
+def build_native_resolution_pipeline(
     config: ImageNetSiftLcsFVConfig,
-    samples_per_image: int,
-    seed: int,
-):
-    """One featurization branch over size buckets: masked extract → PCA on
-    sampled valid descriptors → GMM → masked FisherVector → the
-    Hellinger/normalize post-chain. Returns per-bucket (N_b, fv_dim)
-    arrays in bucket order.
+    train_buckets,
+    train_labels: ArrayDataset,
+) -> Pipeline:
+    """The flagship dual-branch DAG over native-resolution size buckets.
 
-    This is the native-resolution analog of
-    ``compute_pca_fisher_branch`` (reference:
-    ImageNetSiftLcsFV.scala:22-73): identical math, but each image is
-    featurized at its own size (reference: VLFeat.cxx:170-186) via the
-    bucket masks instead of a destructive global resize."""
-    import jax
+    Same graph as :func:`build_pipeline` (reference:
+    ImageNetSiftLcsFV.scala:96-136) but the featurization prefixes are
+    ``MaskedExtractor`` ops over a :class:`BucketedDataset`, so every image
+    is featurized at its own size (reference: VLFeat.cxx:170-186 takes
+    per-call w,h) while the whole flow — sampling, optimizable PCA, GMM
+    fit, masked Fisher encoding, gather, solver — runs through the
+    workflow layer (optimizer/autocache/prefix reuse see all of it).
+    """
+    from ..ops.images.native import MaskedExtractor
 
-    from ..ops.learning.gmm import GaussianMixtureModelEstimator
-    from ..ops.learning.pca import compute_pca
-    from ..ops.images.fisher import FisherVector
-    from ..ops.stats.core import NormalizeRows, SignedHellingerMapper
+    num_train = len(train_buckets)
+    pca_samples_per_image = max(1, config.num_pca_samples // max(1, num_train))
+    gmm_samples_per_image = max(1, config.num_gmm_samples // max(1, num_train))
 
-    rng = np.random.default_rng(seed)
-    extracted = []  # (desc (N, n_pad, raw_dim), valid (N, n_pad)) per bucket
-    samples = []
-    for b in buckets:
-        desc, valid = extract_fn(b)
-        desc, valid = np.asarray(desc), np.asarray(valid)
-        extracted.append((desc, valid))
-        flat = desc[valid]  # (total_valid, raw_dim)
-        take = min(len(flat), samples_per_image * len(b))
-        if take:
-            idx = rng.choice(len(flat), size=take, replace=False)
-            samples.append(flat[idx])
-    sample_mat = np.concatenate(samples, axis=0).astype(np.float32)
-
-    pca = np.asarray(compute_pca(sample_mat, config.desc_dim))  # (raw, desc_dim)
-    from ..data.dataset import ArrayDataset as _AD
-
-    gmm = GaussianMixtureModelEstimator(config.vocab_size, seed=seed).fit(
-        _AD(sample_mat @ pca)
+    pix, gray, hell = PixelScaler(), GrayScaler(), SignedHellingerMapper()
+    sift_prefix = MaskedExtractor(
+        SIFTExtractor(scale_step=config.sift_scale_step),
+        pre=lambda x: gray.apply_arrays(pix.apply_arrays(x)),
+        post=hell.apply_arrays,
+    ).to_pipeline()
+    sift_branch = compute_pca_fisher_branch(
+        sift_prefix,
+        train_buckets,
+        config,
+        pca_samples_per_image,
+        gmm_samples_per_image,
+        config.sift_pca_file,
+        (config.sift_gmm_mean_file, config.sift_gmm_var_file, config.sift_gmm_wts_file),
     )
-    fv = FisherVector(gmm)
-    hell, norm = SignedHellingerMapper(), NormalizeRows()
 
-    out = []
-    for desc, valid in extracted:
-        reduced = desc.astype(np.float32) @ pca
-        enc = fv.apply_arrays_masked(reduced, valid)
-        enc = np.asarray(enc).reshape(len(desc), -1).astype(np.float64)
-        enc = np.asarray(norm.apply_arrays(hell.apply_arrays(norm.apply_arrays(enc))))
-        out.append(enc)
-    return out
+    lcs_prefix = MaskedExtractor(
+        LCSExtractor(
+            stride=config.lcs_stride,
+            stride_start=config.lcs_border,
+            sub_patch_size=config.lcs_patch,
+        )
+    ).to_pipeline()
+    lcs_branch = compute_pca_fisher_branch(
+        lcs_prefix,
+        train_buckets,
+        config,
+        pca_samples_per_image,
+        gmm_samples_per_image,
+        config.lcs_pca_file,
+        (config.lcs_gmm_mean_file, config.lcs_gmm_var_file, config.lcs_gmm_wts_file),
+    )
+
+    return (
+        Pipeline.gather([sift_branch, lcs_branch])
+        >> VectorCombiner()
+    ).then_label_estimator(
+        BlockWeightedLeastSquaresEstimator(
+            config.solver_block_size,
+            num_iter=1,
+            reg=config.reg,
+            mixture_weight=config.mixture_weight,
+        ),
+        train_buckets,
+        train_labels,
+    ) >> TopKClassifier(min(5, config.num_classes))
 
 
 def run_native_resolution(config: ImageNetSiftLcsFVConfig) -> dict:
     """End-to-end ImageNet SIFT+LCS+FV with per-image native-resolution
     featurization (``image_size=None`` path): loader keeps original
-    dimensions, images group into padded size buckets
-    (``data.buckets``), and the masked extractors reproduce the
-    reference's featurize-at-own-size behavior exactly."""
-    from ..data.buckets import bucketize_dataset
-    from ..ops.images.core import GrayScaler, PixelScaler
-    from ..ops.images.lcs import LCSExtractor
-    from ..ops.images.sift import SIFTExtractor
-    from ..ops.stats.core import SignedHellingerMapper
+    dimensions, images group into padded size buckets executed as a
+    :class:`BucketedDataset` through the standard Pipeline API."""
+    from ..data.buckets import bucket_labels, bucketize_dataset, to_bucketed_dataset
 
     start = time.time()
     ds = load_imagenet(config.train_location, config.label_path, resize=None)
     buckets = bucketize_dataset(ds, granularity=32)
-    num_train = sum(len(b) for b in buckets)
-    pca_spi = max(1, config.num_pca_samples // max(1, num_train))
-
-    pix, gray, hell = PixelScaler(), GrayScaler(), SignedHellingerMapper()
-    sift = SIFTExtractor(scale_step=config.sift_scale_step)
-    lcs = LCSExtractor(
-        stride=config.lcs_stride,
-        stride_start=config.lcs_border,
-        sub_patch_size=config.lcs_patch,
-    )
-
-    def extract_sift(b):
-        g = gray.apply_arrays(pix.apply_arrays(b.images.astype(np.float32)))
-        d, v = sift.apply_arrays_masked(g, b.dims)
-        return hell.apply_arrays(d), v
-
-    def extract_lcs(b):
-        return lcs.apply_arrays_masked(b.images.astype(np.float32), b.dims)
-
-    sift_feats = _branch_features_bucketed(buckets, extract_sift, config, pca_spi, config.seed)
-    lcs_feats = _branch_features_bucketed(buckets, extract_lcs, config, pca_spi, config.seed + 1)
-
-    features = np.concatenate(
-        [np.concatenate(sift_feats, axis=0), np.concatenate(lcs_feats, axis=0)],
-        axis=1,
-    ).astype(np.float32)
-    labels = np.concatenate([b.labels for b in buckets])
-
+    train_buckets = to_bucketed_dataset(buckets)
+    labels = bucket_labels(buckets)
     train_labels = ClassLabelIndicators(config.num_classes).apply_batch(
         ArrayDataset(labels)
     )
-    solver = BlockWeightedLeastSquaresEstimator(
-        config.solver_block_size,
-        num_iter=1,
-        reg=config.reg,
-        mixture_weight=config.mixture_weight,
-    )
-    model = solver.fit(ArrayDataset(features), train_labels)
-    predicted = np.asarray(
-        TopKClassifier(min(5, config.num_classes)).apply_arrays(
-            model.apply_arrays(features)
-        )
-    )
+
+    predictor = build_native_resolution_pipeline(config, train_buckets, train_labels)
+    predicted_ds = predictor(train_buckets).get()
+    from ..data.dataset import BucketedDataset
+
+    if isinstance(predicted_ds, BucketedDataset):
+        predicted_ds = predicted_ds.concat()
+    predicted = np.asarray(predicted_ds.data)
     return {
-        "model": model,
+        "pipeline": predictor,
         "num_buckets": len(buckets),
-        "num_train": num_train,
+        "num_train": len(train_buckets),
         "train_error_percent": top_k_err_percent(predicted, labels),
         "seconds": time.time() - start,
     }

@@ -208,9 +208,30 @@ class BatchTransformer(Transformer):
         import jax
         import jax.numpy as jnp
 
+        from ..data.dataset import BucketedDataset
+
+        if isinstance(dataset, BucketedDataset):
+            # Native-resolution path: one static-shape application per
+            # size bucket (each bucket compiles once, like any batch).
+            return dataset.map_datasets(self.apply_batch)
         if isinstance(dataset, ObjectDataset):
             dataset = dataset.to_arrays()
         assert isinstance(dataset, ArrayDataset)
+        if (
+            isinstance(dataset.data, dict)
+            and "desc" in dataset.data
+            and "valid" in dataset.data
+        ):
+            # Masked descriptor convention ({"desc": (N, n_pad, d),
+            # "valid": (N, n_pad)} from ops.images.native): the op acts on
+            # the descriptors, validity flows through untouched. Safe for
+            # the chain between extractor and FisherVector (elementwise
+            # maps and PCA matmuls keep zero rows zero).
+            out = self.apply_arrays(dataset.data["desc"])
+            return ArrayDataset(
+                {"desc": out, "valid": dataset.data["valid"]},
+                dataset.num_examples,
+            )
         out = dataset.map_batched(self.apply_arrays)
         if out.physical_rows > out.num_examples:
             real_row = jnp.arange(out.physical_rows) < out.num_examples

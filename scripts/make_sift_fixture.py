@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Generate the external SIFT golden fixture from OpenCV.
+
+OpenCV's SIFT (an implementation this repo's authors did not write) is
+used as an independent oracle for the dense SIFT extractor, the way the
+reference validated its native kernel against MATLAB vl_phow output
+(reference: src/test/scala/keystoneml/utils/external/VLFeatSuite.scala:34-52).
+
+Geometry mapping (probed empirically, see tests/ops/test_sift_opencv_fixture.py):
+- our dense grid at bin size b, single scale, descriptor centers at
+  (off + 1.5·b + i·step, off + 1.5·b + j·step);
+- OpenCV keypoint at (x=col, y=row) with size = 2·b/3 (OpenCV's spatial
+  bin width is 3·σ = 3·size/2, so size = 2b/3 matches bin width b) and a
+  fixed angle so no orientation is estimated;
+- our (4, 4, 8) descriptor maps to OpenCV's with the x/y bin axes
+  swapped and the orientation axis rolled by 6.
+
+Both implementations quantize identically (L2-normalize, clamp 0.2,
+renormalize, ×512, saturate 255), so cosine similarity on the quantized
+vectors is meaningful. Exact equality is NOT expected: OpenCV weights
+spatial bins with a Gaussian window and trilinear interpolation; vl_dsift
+(our semantics) uses a flat window on a smoothed image.
+
+The test image is reproducible without OpenCV (seeded RNG +
+scipy.ndimage.gaussian_filter), so the committed CSV is the only
+artifact; run this script only to regenerate it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from scipy.ndimage import gaussian_filter
+
+BIN_SIZE = 4
+STEP = 4
+IMG_SIZE = 80
+CV_SIZE = 2.0 * BIN_SIZE / 3.0
+FIXTURE_DIR = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "fixtures", "sift_opencv"
+)
+
+
+def make_image(seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    base = rng.random((IMG_SIZE, IMG_SIZE)).astype(np.float32)
+    img = gaussian_filter(base, 3.0, mode="nearest")
+    return (img - img.min()) / (img.max() - img.min())
+
+
+def grid_centers() -> list[tuple[float, float]]:
+    off = max(0, (1 + 2 * 1) - 0)  # scales=1, s=0 → offset 3
+    span = 3 * BIN_SIZE
+    n = (IMG_SIZE - 1 - off - span) // STEP + 1
+    c0 = off + 1.5 * BIN_SIZE
+    return [(c0 + i * STEP, c0 + j * STEP) for i in range(n) for j in range(n)]
+
+
+def main() -> None:
+    import cv2
+
+    os.makedirs(FIXTURE_DIR, exist_ok=True)
+    sift = cv2.SIFT_create()
+    for seed in (42, 7):
+        img8 = (make_image(seed) * 255).astype(np.uint8)
+        kps = [
+            cv2.KeyPoint(float(cy), float(cx), float(CV_SIZE), -1)
+            for (cx, cy) in grid_centers()
+        ]
+        _, desc = sift.compute(img8, kps)
+        path = os.path.join(FIXTURE_DIR, f"opencv_dsift_seed{seed}.csv")
+        np.savetxt(path, desc, fmt="%.1f", delimiter=",")
+        print(f"wrote {path} {desc.shape}")
+
+
+if __name__ == "__main__":
+    main()

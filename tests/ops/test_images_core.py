@@ -8,6 +8,8 @@ straight-line reference implementations / golden conv values
 import numpy as np
 import pytest
 
+import jax.numpy as jnp
+
 from keystone_tpu.data.dataset import ArrayDataset
 from keystone_tpu.ops.images import (
     CenterCornerPatcher,
@@ -186,3 +188,71 @@ def test_vectorize_roundtrip():
     meta = imutil.ImageMetadata.of(img)
     vec = imutil.vectorize(img)
     np.testing.assert_allclose(imutil.unvectorize(vec, meta), img)
+
+
+# ------------------------------------------------------- fused featurizer
+
+
+def _cifar_ops(num_filters=37, alpha=0.25, whitener=None, normalize=True):
+    from keystone_tpu.ops.images import (
+        Convolver,
+        FusedConvFeaturizer,
+        ImageVectorizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+
+    rng = np.random.default_rng(0)
+    filters = rng.normal(size=(num_filters, 6 * 6 * 3)).astype(np.float32) * 0.1
+    conv = Convolver(filters, 3, whitener=whitener, normalize_patches=normalize)
+    rect = SymmetricRectifier(alpha=alpha)
+    pool = Pooler(13, 14, None, "sum")
+    return conv, rect, pool, ImageVectorizer()
+
+
+@pytest.mark.parametrize("filter_block", [8, 16, 37, 64])
+def test_fused_conv_featurizer_matches_unfused(filter_block):
+    from keystone_tpu.ops.images import FusedConvFeaturizer
+
+    conv, rect, pool, vec = _cifar_ops()
+    rng = np.random.default_rng(1)
+    imgs = jnp.asarray(rng.random((5, 32, 32, 3), dtype=np.float32))
+    ref = vec.apply_arrays(pool.apply_arrays(rect.apply_arrays(conv.apply_arrays(imgs))))
+    fused = FusedConvFeaturizer(conv, rect, pool, filter_block=filter_block).apply_arrays(imgs)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(ref), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_fused_conv_featurizer_with_whitener_and_no_normalize():
+    from keystone_tpu.ops.learning.zca import ZCAWhitenerEstimator
+    from keystone_tpu.ops.images import FusedConvFeaturizer
+
+    rng = np.random.default_rng(2)
+    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(
+        rng.normal(size=(200, 6 * 6 * 3)).astype(np.float32)
+    )
+    for normalize in (True, False):
+        conv, rect, pool, vec = _cifar_ops(
+            num_filters=20, whitener=whitener, normalize=normalize
+        )
+        imgs = jnp.asarray(rng.random((3, 32, 32, 3), dtype=np.float32))
+        ref = vec.apply_arrays(
+            pool.apply_arrays(rect.apply_arrays(conv.apply_arrays(imgs)))
+        )
+        fused = FusedConvFeaturizer(conv, rect, pool, filter_block=7).apply_arrays(imgs)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_conv_featurizer_in_pipeline():
+    """The fused featurizer slots into the Pipeline API like the ops it
+    replaces (build_random_patch uses it at numFilters=10000 scale)."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.images import FusedConvFeaturizer
+
+    conv, rect, pool, _ = _cifar_ops(num_filters=12)
+    rng = np.random.default_rng(3)
+    imgs = ArrayDataset(rng.random((4, 32, 32, 3)).astype(np.float32))
+    pipe = FusedConvFeaturizer(conv, rect, pool, filter_block=5).to_pipeline()
+    out = pipe(imgs).get()
+    assert np.asarray(out.data).shape == (4, 2 * 2 * 24)
