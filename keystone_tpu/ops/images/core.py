@@ -216,15 +216,16 @@ class FusedConvFeaturizer(BatchTransformer):
         self.pool = pooler
         self.filter_block = filter_block
 
-    def apply_arrays(self, x):
-        conv, rect, pool = self.conv, self.rect, self.pool
-        x = x.astype(jnp.float32)
-        n = x.shape[0]
+    def packed_filter_blocks(self):
+        """Zero-padded (nb, s, s, c, fb) kernel blocks plus per-block
+        filter sums and whitener offsets — the traced inputs shared by
+        :meth:`apply_arrays` and the rematerializing solver
+        (ops/learning/conv_block.py)."""
+        conv = self.conv
         f = conv.num_filters
         fb = min(self.filter_block, f)
         nb = -(-f // fb)
         f_pad = nb * fb
-
         kernel = conv.kernel  # (s, s, c, F)
         fsums = conv.filter_sums
         offset = conv.offset if conv.offset is not None else jnp.zeros((f,), jnp.float32)
@@ -232,39 +233,61 @@ class FusedConvFeaturizer(BatchTransformer):
             kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, 0), (0, f_pad - f)))
             fsums = jnp.pad(fsums, (0, f_pad - f))
             offset = jnp.pad(offset, (0, f_pad - f))
-        s = conv.conv_size
-        c = conv.img_channels
-        kblocks = jnp.moveaxis(kernel.reshape(s, s, c, nb, fb), 3, 0)  # (nb, s, s, c, fb)
-        fsum_blocks = fsums.reshape(nb, fb)
-        offset_blocks = offset.reshape(nb, fb)
+        s, c = conv.conv_size, conv.img_channels
+        kblocks = jnp.moveaxis(kernel.reshape(s, s, c, nb, fb), 3, 0)
+        return kblocks, fsums.reshape(nb, fb), offset.reshape(nb, fb)
 
-        if conv.normalize_patches:
-            d = float(s * s * c)
-            ones = jnp.ones((s, s, c, 1), dtype=jnp.float32)
-            box = partial(
-                lax.conv_general_dilated,
-                rhs=ones,
-                window_strides=(1, 1),
-                padding="VALID",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
-            m = box(x) / d  # (N, rx, ry, 1)
-            var = jnp.maximum(box(x * x) - d * m * m, 0.0) / (d - 1.0)
-            sd = jnp.sqrt(var + conv.var_constant)
-        else:
-            m = sd = None
+    def norm_stats(self, x):
+        """Patch mean / stddev maps for per-patch normalization (None, None
+        when disabled) — filter-independent, computed once per image batch."""
+        conv = self.conv
+        if not conv.normalize_patches:
+            return None, None
+        s, c = conv.conv_size, conv.img_channels
+        d = float(s * s * c)
+        ones = jnp.ones((s, s, c, 1), dtype=jnp.float32)
+        box = partial(
+            lax.conv_general_dilated,
+            rhs=ones,
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        m = box(x) / d  # (N, rx, ry, 1)
+        var = jnp.maximum(box(x * x) - d * m * m, 0.0) / (d - 1.0)
+        return m, jnp.sqrt(var + conv.var_constant)
+
+    def block_pooled(self, x, kb, fs_b, off_b, m, sd):
+        """conv → normalize → rectify → pool for ONE filter block:
+        (N, px, py, 2·fb) pooled panel. The single source of the
+        featurizer math for every consumer."""
+        raw = lax.conv_general_dilated(
+            x, kb, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        out = (raw - m * fs_b) / sd if m is not None else raw
+        out = out - off_b
+        pos = jnp.maximum(self.rect.max_val, out - self.rect.alpha)
+        neg = jnp.maximum(self.rect.max_val, -out - self.rect.alpha)
+        return jnp.concatenate(
+            [self.pool.apply_arrays(pos), self.pool.apply_arrays(neg)], axis=-1
+        )
+
+    def apply_arrays(self, x):
+        conv = self.conv
+        x = x.astype(jnp.float32)
+        n = x.shape[0]
+        f = conv.num_filters
+        fb = min(self.filter_block, f)
+        nb = -(-f // fb)
+        f_pad = nb * fb
+        kblocks, fsum_blocks, offset_blocks = self.packed_filter_blocks()
+        m, sd = self.norm_stats(x)
 
         def block_step(_, inputs):
             kb, fs_b, off_b = inputs
-            raw = lax.conv_general_dilated(
-                x, kb, (1, 1), "VALID",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            )
-            out = (raw - m * fs_b) / sd if m is not None else raw
-            out = out - off_b
-            pos = jnp.maximum(rect.max_val, out - rect.alpha)
-            neg = jnp.maximum(rect.max_val, -out - rect.alpha)
-            return _, (pool.apply_arrays(pos), pool.apply_arrays(neg))
+            pooled = self.block_pooled(x, kb, fs_b, off_b, m, sd)
+            return _, (pooled[..., :fb], pooled[..., fb:])
 
         _, (pp, pn) = lax.scan(
             block_step, None, (kblocks, fsum_blocks, offset_blocks)

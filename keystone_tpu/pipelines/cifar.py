@@ -184,12 +184,30 @@ def build_random_patch(
             size=(config.num_filters, config.patch_size**2 * NUM_CHANNELS)
         ).astype(np.float32)
 
-    featurizer = FusedConvFeaturizer(
+    fused = FusedConvFeaturizer(
         Convolver(filters, NUM_CHANNELS, whitener=whitener, normalize_patches=True),
         SymmetricRectifier(alpha=config.alpha),
         Pooler(config.pool_stride, config.pool_size, None, "sum"),
         filter_block=config.filter_block,
-    ).to_pipeline()
+    )
+    if solver == "conv_block":
+        # Rematerializing fast path: featurize→standardize→BCD as one
+        # machine; the (n, 8·numFilters) feature matrix never exists
+        # (ops/learning/conv_block.py). Equivalent problem to the
+        # block path below, block partition in filter order.
+        from ..ops.learning.conv_block import ConvBlockLeastSquaresEstimator
+        from ..workflow.pipeline import Identity
+
+        fitted = Identity().to_pipeline().then_label_estimator(
+            ConvBlockLeastSquaresEstimator(
+                fused, block_size=None, num_iter=1, reg=config.reg or 0.0
+            ),
+            train_images,
+            train_labels,
+        )
+        return fitted >> MaxClassifier() if with_classifier else fitted
+
+    featurizer = fused.to_pipeline()
     scaled = featurizer.then_estimator(StandardScaler(), train_images)
     if solver == "block":
         fitted = scaled.then_label_estimator(

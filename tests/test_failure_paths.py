@@ -1,0 +1,175 @@
+"""Failure-path coverage (round-2 verdict item 8): OOM adaptation in the
+bench helpers, masked extractors at degenerate sizes, and solver
+validation on misconfigured meshes/shapes."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.parallel import linalg
+from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, use_mesh
+
+
+# ------------------------------------------------------------ bench helpers
+
+
+def test_imagenet_bench_ladder_reduces_on_oom(monkeypatch):
+    """The imagenet_fv bench walks its reduction ladder on
+    RESOURCE_EXHAUSTED and marks the result."""
+    import bench
+
+    calls = []
+
+    def fake_at(n_img, size, num_classes, small):
+        calls.append((n_img, size, num_classes))
+        if size > 64:
+            raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+        return {"num_images": n_img, "image_size": size}
+
+    monkeypatch.setattr(bench, "_imagenet_fv_at", fake_at)
+    out = bench._bench_imagenet_fv(small=False)
+    assert out["extrapolated"] is True
+    assert out["reduced_from"]["image_size"] == 256
+    assert out["reduced_from"]["num_classes"] == 1000
+    assert out["num_classes"] == 16
+    assert "RESOURCE_EXHAUSTED" in out["reduction_reason"]
+    assert len(calls) == 5  # walked every >64 rung before succeeding
+
+
+def test_imagenet_bench_ladder_reraises_non_oom(monkeypatch):
+    import bench
+
+    def fake_at(n_img, size, num_classes, small):
+        raise ValueError("not an OOM")
+
+    monkeypatch.setattr(bench, "_imagenet_fv_at", fake_at)
+    with pytest.raises(ValueError):
+        bench._bench_imagenet_fv(small=False)
+
+
+def test_bench_workload_registry_consistent():
+    import bench
+
+    assert set(bench.WORKLOADS) == set(bench._workload_registry())
+
+
+# -------------------------------------------------- masked degenerate sizes
+
+
+def test_masked_sift_image_smaller_than_grid():
+    """A bucket member far smaller than the padded shape must yield zero
+    valid descriptors at scales its native size can't host, and the valid
+    count must match its native-size run."""
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+
+    ext = SIFTExtractor(scale_step=1)
+    rng = np.random.default_rng(0)
+    big, small = 96, 24
+    img_small = rng.random((small, small)).astype(np.float32)
+    padded = np.pad(img_small, ((0, big - small), (0, big - small)), mode="edge")
+    batch = jnp.asarray(padded[None])
+    dims = jnp.asarray([[small, small]], jnp.int32)
+    desc, valid = ext.apply_arrays_masked(batch, dims)
+    native = np.asarray(ext.apply_arrays(jnp.asarray(img_small[None])))
+    assert int(valid.sum()) == native.shape[1]
+    got = np.asarray(desc)[0][np.asarray(valid)[0]]
+    np.testing.assert_allclose(got, native[0], atol=1.0)
+    # 99.5%-within-1, the reference's own tolerance (VLFeatSuite.scala:47-52)
+    close = np.abs(got - native[0]) <= 1.0
+    assert close.mean() > 0.995
+
+
+def test_masked_lcs_degenerate_size():
+    from keystone_tpu.ops.images.lcs import LCSExtractor
+
+    ext = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
+    rng = np.random.default_rng(1)
+    small = 40  # barely above the 2*border minimum
+    img = rng.random((small, small, 3)).astype(np.float32)
+    padded = np.pad(img, ((0, 24), (0, 24), (0, 0)), mode="edge")
+    desc, valid = ext.apply_arrays_masked(
+        jnp.asarray(padded[None]), jnp.asarray([[small, small]], jnp.int32)
+    )
+    native = np.asarray(ext.apply_arrays(jnp.asarray(img[None])))
+    assert int(valid.sum()) == native.shape[1]
+
+
+def test_bucketize_rejects_nothing_but_groups_consistently():
+    from keystone_tpu.data.buckets import bucketize_images
+
+    rng = np.random.default_rng(2)
+    recs = [
+        {"image": rng.random((17, 23, 3)).astype(np.float32), "label": 0},
+        {"image": rng.random((17, 23, 3)).astype(np.float32), "label": 1},
+        {"image": rng.random((64, 64, 3)).astype(np.float32), "label": 2},
+    ]
+    buckets = bucketize_images(recs, granularity=32)
+    assert sorted(b.bucket_shape for b in buckets) == [(32, 32), (64, 64)]
+    assert sum(len(b) for b in buckets) == 3
+
+
+# ------------------------------------------------------- solver validation
+
+
+def test_bcd_rejects_non_dividing_block():
+    mesh = make_mesh(devices=jax.devices()[:8])
+    rng = np.random.default_rng(3)
+    a = rng.normal(size=(16, 10)).astype(np.float32)
+    y = rng.normal(size=(16, 2)).astype(np.float32)
+    with use_mesh(mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            linalg.block_coordinate_descent(
+                linalg.prepare_row_sharded(a, mesh),
+                linalg.prepare_row_sharded(y, mesh),
+                reg=0.1, num_epochs=1, block_size=3, mesh=mesh,
+            )
+
+
+def test_bcd2d_rejects_non_dividing_model_blocks():
+    mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS), devices=jax.devices()[:8])
+    rng = np.random.default_rng(4)
+    a = rng.normal(size=(16, 12)).astype(np.float32)
+    y = rng.normal(size=(16, 2)).astype(np.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        linalg.block_coordinate_descent_2d(
+            linalg.prepare_block_sharded(a, mesh),
+            linalg.prepare_block_sharded(y, mesh, fine_rows=True),
+            reg=0.1, num_epochs=1, block_size=8, mesh=mesh,
+        )
+
+
+def test_conv_block_estimator_rejects_bad_block_size():
+    from keystone_tpu.ops.images import (
+        Convolver,
+        FusedConvFeaturizer,
+        Pooler,
+        SymmetricRectifier,
+    )
+    from keystone_tpu.ops.learning.conv_block import (
+        ConvBlockLeastSquaresEstimator,
+    )
+
+    rng = np.random.default_rng(5)
+    fz = FusedConvFeaturizer(
+        Convolver(rng.normal(size=(8, 108)).astype(np.float32), 3),
+        SymmetricRectifier(alpha=0.25),
+        Pooler(13, 14, None, "sum"),
+    )
+    est = ConvBlockLeastSquaresEstimator(fz, block_size=12)  # 12 % 8 != 0
+    mesh = make_mesh(devices=jax.devices()[:8])
+    with use_mesh(mesh):
+        with pytest.raises(ValueError, match="not divisible"):
+            est.fit(
+                ArrayDataset(rng.random((16, 32, 32, 3)).astype(np.float32)),
+                ArrayDataset(rng.normal(size=(16, 2)).astype(np.float32)),
+            )
+
+
+def test_streaming_threshold_env_override(monkeypatch):
+    from keystone_tpu.ops.learning import block as block_mod
+
+    monkeypatch.setenv("KEYSTONE_STREAM_BYTES", "123")
+    assert block_mod._host_streaming_threshold_bytes() == 123
