@@ -216,14 +216,14 @@ class FusedConvFeaturizer(BatchTransformer):
         self.pool = pooler
         self.filter_block = filter_block
 
-    def packed_filter_blocks(self):
+    def packed_filter_blocks(self, fb: Optional[int] = None):
         """Zero-padded (nb, s, s, c, fb) kernel blocks plus per-block
         filter sums and whitener offsets — the traced inputs shared by
         :meth:`apply_arrays` and the rematerializing solver
-        (ops/learning/conv_block.py)."""
+        (ops/learning/conv_block.py, which passes its own block width)."""
         conv = self.conv
         f = conv.num_filters
-        fb = min(self.filter_block, f)
+        fb = min(self.filter_block, f) if fb is None else fb
         nb = -(-f // fb)
         f_pad = nb * fb
         kernel = conv.kernel  # (s, s, c, F)

@@ -85,11 +85,13 @@ class ConvBlockLeastSquaresEstimator(LabelEstimator):
     rematerialization (featurize → standardize → BCD as one machine).
 
     Equivalent to the pipeline ``FusedConvFeaturizer → StandardScaler →
-    BlockLeastSquaresEstimator(block_size, num_iter, reg)`` but the full
-    feature matrix never exists; each epoch refeaturizes every filter
-    block once. ``block_size`` must correspond to a whole number of
-    filters (block_size divisible by the per-filter feature count —
-    pool_x·pool_y·2 for the symmetric rectifier).
+    BlockLeastSquaresEstimator(block_size, num_iter, reg)`` (both floor
+    reg=0 to 1e-6 to keep the per-block solves PD; the block update
+    order here is filter-major rather than column-contiguous, same fixed
+    point) but the full feature matrix never exists; each epoch
+    refeaturizes every filter block once. ``block_size`` must correspond
+    to a whole number of filters (block_size divisible by the per-filter
+    feature count — pool_x·pool_y·2 for the symmetric rectifier).
     """
 
     def __init__(
@@ -178,18 +180,8 @@ class ConvBlockLeastSquaresEstimator(LabelEstimator):
         fpf, fb, nb, px, py = self._geometry(images.shape[1:3])
         f_pad = nb * fb
 
-        # Pad filters to whole blocks; stack per-block tracing inputs.
-        kernel = conv.kernel  # (s, s, c, F)
-        fsums = conv.filter_sums
-        offset = conv.offset if conv.offset is not None else jnp.zeros((conv.num_filters,), jnp.float32)
-        if f_pad != conv.num_filters:
-            kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, 0), (0, f_pad - conv.num_filters)))
-            fsums = jnp.pad(fsums, (0, f_pad - conv.num_filters))
-            offset = jnp.pad(offset, (0, f_pad - conv.num_filters))
-        s, c = conv.conv_size, conv.img_channels
-        kblocks = jnp.moveaxis(kernel.reshape(s, s, c, nb, fb), 3, 0)
-        fsum_blocks = fsums.reshape(nb, fb)
-        offset_blocks = offset.reshape(nb, fb)
+        # Shared packing with the featurizer, at the solver's block width.
+        kblocks, fsum_blocks, offset_blocks = fz.packed_filter_blocks(fb)
 
         # Row-shard images/labels; chunk size must divide the per-shard rows.
         ndev = row_shard_count(mesh)

@@ -55,16 +55,11 @@ from ..stats.core import _as_array_dataset
 
 
 def gaussian_kernel_block(xa, xb, gamma):
-    """exp(−γ‖a−b‖²) panel via one MXU matmul. On TPU the panel goes
-    through the fused Pallas kernel (ops/pallas/gaussian.py) — tile-wise
-    MXU + VPU epilogue in VMEM, no HBM squared-distance intermediate."""
-    from ..pallas.gaussian import gaussian_kernel_block_pallas, pallas_supported
+    """exp(−γ‖a−b‖²) panel via one MXU matmul + fused exp epilogue.
 
-    # The Pallas kernel takes gamma statically; inside jit/shard_map gamma
-    # is a tracer, so those call sites stay on the XLA path.
-    is_concrete = isinstance(gamma, (int, float, np.floating, np.integer))
-    if is_concrete and pallas_supported(int(xa.shape[1])):
-        return gaussian_kernel_block_pallas(xa, xb, float(gamma))
+    Pure XLA by measurement: a hand-tiled Pallas version ran 1.6× slower
+    on v5e (see ops/pallas/__init__.py for the numbers) — the emitter
+    already keeps the squared-distance intermediate out of HBM."""
     an = jnp.sum(xa * xa, axis=1, keepdims=True)
     bn = jnp.sum(xb * xb, axis=1)
     sq = an - 2.0 * linalg.mm(xa, xb.T) + bn
@@ -266,21 +261,15 @@ class KernelBlockLinearMapper(BatchTransformer):
         xt = linalg.prepare_row_sharded(_pad_rows_to(jnp.asarray(x, jnp.float32), m_pad), mesh)
         train_sharded = linalg.prepare_row_sharded(self.train, mesh)
         duals_sharded = linalg.prepare_row_sharded(self.duals, mesh)
-        from ..pallas.kernel_apply import fused_apply_enabled
-
-        fused = fused_apply_enabled(self.train.shape[1], self.duals.shape[1])
-        # Pallas needs gamma static; the XLA branch keeps it traced so one
-        # compiled executable serves every gamma (no per-gamma cache leak).
-        static_gamma = float(self.gamma) if fused else None
-        out = _ring_kernel_apply(mesh, fused, static_gamma)(
+        # gamma is traced, so one compiled executable serves every gamma.
+        out = _ring_kernel_apply(mesh)(
             xt, train_sharded, duals_sharded, jnp.float32(self.gamma)
         )
         return out[:m]
 
 
 @functools.lru_cache(maxsize=None)
-def _ring_kernel_apply(mesh: Mesh, fused: bool = False,
-                       static_gamma: Optional[float] = None):
+def _ring_kernel_apply(mesh: Mesh):
     axes = row_axes(mesh)
     nd = mesh.shape[DATA_AXIS]
     nr = mesh.shape.get(REPLICA_AXIS, 1)
@@ -295,15 +284,8 @@ def _ring_kernel_apply(mesh: Mesh, fused: bool = False,
 
         def ring_step(i, carry):
             acc, xs, ws = carry
-            if fused:
-                # Flash-style fused hop: the kernel panel lives only in
-                # VMEM (ops.pallas.kernel_apply) — no (m, n) HBM panel.
-                from ..pallas.kernel_apply import fused_gaussian_apply
-
-                acc = acc + fused_gaussian_apply(xt_local, xs, ws, static_gamma)
-            else:
-                panel = gaussian_kernel_block(xt_local, xs, gamma)
-                acc = acc + linalg.mm(panel, ws)
+            panel = gaussian_kernel_block(xt_local, xs, gamma)
+            acc = acc + linalg.mm(panel, ws)
             # inner ICI ring every step; after each full data cycle the
             # shards hop once across the DCN replica ring, so nd*nr steps
             # visit every (replica, data) shard exactly once.
