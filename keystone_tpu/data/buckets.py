@@ -69,6 +69,7 @@ def bucketize_images(
     granularity: int = 32,
     pad_mode: str = "edge",
     label_key: str = "label",
+    max_rows: Optional[int] = None,
 ) -> List[ImageBucket]:
     """Group ``{"image": (X, Y, C), label_key: …, "filename": …}`` records
     (the loaders' ObjectDataset items) into padded static-shape buckets.
@@ -76,6 +77,12 @@ def bucketize_images(
     Images are never resized or cropped — only zero-cost padding that the
     masked extractors exclude — so descriptors computed per bucket equal
     the per-image native-size run (the reference's behavior).
+
+    ``max_rows`` caps a bucket's image count by splitting large size
+    groups into several same-shape buckets — the HBM-residency knob: one
+    bucket is one XLA computation, so its working set (≈ rows × padded
+    pixels × extractor blow-up) must fit on chip. Same-shape buckets
+    share one compiled executable.
     """
     groups: Dict[Tuple[int, int], List[Dict[str, Any]]] = {}
     for rec in records:
@@ -83,8 +90,16 @@ def bucketize_images(
         key = (_round_up(img.shape[0], granularity), _round_up(img.shape[1], granularity))
         groups.setdefault(key, []).append(rec)
 
+    split_groups: List[Tuple[Tuple[int, int], List[Dict[str, Any]]]] = []
+    for key, recs in sorted(groups.items()):
+        if max_rows is None:
+            split_groups.append((key, recs))
+        else:
+            for start in range(0, len(recs), max_rows):
+                split_groups.append((key, recs[start : start + max_rows]))
+
     buckets = []
-    for (xb, yb), recs in sorted(groups.items()):
+    for (xb, yb), recs in split_groups:
         images = np.stack(
             [_pad_image(np.asarray(r["image"]), xb, yb, pad_mode) for r in recs]
         )
@@ -112,12 +127,13 @@ def bucketize_dataset(
     granularity: int = 32,
     pad_mode: str = "edge",
     label_key: str = "label",
+    max_rows: Optional[int] = None,
 ) -> List[ImageBucket]:
     """Bucketize a loader's ObjectDataset (e.g. ``load_imagenet(...,
     resize=None)``)."""
     return bucketize_images(
         dataset.collect(), granularity=granularity, pad_mode=pad_mode,
-        label_key=label_key,
+        label_key=label_key, max_rows=max_rows,
     )
 
 

@@ -45,8 +45,31 @@ class MaskedExtractor(Transformer):
         self.extractor = extractor
         self.pre = pre
         self.post = post
+        self._jit_cache = None
+
+    @property
+    def _jitted(self):
+        # One jitted computation per bucket shape (jax caches on shapes):
+        # eager per-primitive dispatch would pay the host→device round
+        # trip once per op instead of once per bucket. Built lazily and
+        # excluded from pickling (jit wrappers don't pickle; FittedPipeline
+        # save/load must keep working with this op in the graph).
+        import jax
+
+        if self._jit_cache is None:
+            self._jit_cache = jax.jit(self._apply_bucket_arrays)
+        return self._jit_cache
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_jit_cache"] = None
+        return state
 
     def apply(self, datum):
+        # Per-datum serving path: eager, NOT jitted — native-resolution
+        # datums have arbitrary (H, W), so jitting here would compile the
+        # full extractor once per distinct image size and grow the cache
+        # without bound. Batch (bucketed) application is the fast path.
         img = jnp.asarray(datum["image"])[None]
         dims = jnp.asarray(datum["dims"])[None]
         out = self._apply_bucket_arrays(img, dims)
@@ -68,7 +91,7 @@ class MaskedExtractor(Transformer):
             "MaskedExtractor needs {'image', 'dims'} bucket data "
             "(see data.buckets.to_bucketed_dataset)"
         )
-        out = self._apply_bucket_arrays(
+        out = self._jitted(
             jnp.asarray(dataset.data["image"]), jnp.asarray(dataset.data["dims"])
         )
         return ArrayDataset(out, dataset.num_examples)

@@ -59,3 +59,117 @@ def test_augmented_borda_policy():
     labels = np.array([2, 2])
     m = AugmentedExamplesEvaluator(names, 3, policy="borda").evaluate(scores, labels)
     assert m.total_error == 0.0
+
+
+# --------------------------------------------------- sklearn golden tests
+
+
+def test_multiclass_metrics_match_sklearn():
+    """Confusion matrix + macro/micro precision/recall/F1 vs sklearn —
+    an oracle this repo's authors didn't write (the reference validated
+    its evaluator arithmetic by hand, MulticlassClassifierEvaluatorSuite)."""
+    from sklearn.metrics import (
+        confusion_matrix,
+        f1_score,
+        precision_score,
+        recall_score,
+    )
+
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+
+    rng = np.random.default_rng(0)
+    k, n = 5, 400
+    actual = rng.integers(0, k, n)
+    predicted = np.where(rng.random(n) < 0.6, actual, rng.integers(0, k, n))
+
+    m = MulticlassClassifierEvaluator(k).evaluate(predicted, actual)
+
+    # Our convention: matrix[i, j] counts actual i predicted j (transpose
+    # if the internal layout differs — total/diagonal agreement pins it).
+    sk = confusion_matrix(actual, predicted, labels=np.arange(k))
+    np.testing.assert_array_equal(np.asarray(m.confusion_matrix), sk)
+
+    np.testing.assert_allclose(
+        m.macro_precision,
+        precision_score(actual, predicted, average="macro", zero_division=0),
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        m.macro_recall,
+        recall_score(actual, predicted, average="macro", zero_division=0),
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        m.micro_f1,
+        f1_score(actual, predicted, average="micro", zero_division=0),
+        atol=1e-12,
+    )
+    np.testing.assert_allclose(
+        m.total_accuracy, float((actual == predicted).mean()), atol=1e-12
+    )
+
+
+def test_macro_f1_is_mean_of_class_f1():
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+
+    rng = np.random.default_rng(1)
+    actual = rng.integers(0, 3, 100)
+    predicted = rng.integers(0, 3, 100)
+    m = MulticlassClassifierEvaluator(3).evaluate(predicted, actual)
+    np.testing.assert_allclose(m.macro_f1, m.class_f1().mean())
+
+
+def test_binary_metrics_match_sklearn():
+    from sklearn.metrics import f1_score, precision_score, recall_score
+
+    from keystone_tpu.evaluation import BinaryClassifierEvaluator
+
+    rng = np.random.default_rng(2)
+    actual = rng.random(300) < 0.4
+    predicted = rng.random(300) < 0.5
+    m = BinaryClassifierEvaluator().evaluate(predicted, actual)
+    np.testing.assert_allclose(
+        m.precision, precision_score(actual, predicted, zero_division=0), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        m.recall, recall_score(actual, predicted, zero_division=0), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        m.f_score(), f1_score(actual, predicted, zero_division=0), atol=1e-12
+    )
+
+
+def test_map_matches_direct_recomputation():
+    """Verify the evaluator's vectorized per-class argsort AP against a
+    straight-line scalar recomputation of VOC2007 11-point AP from the
+    same ranking (independent arithmetic path)."""
+    from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
+
+    rng = np.random.default_rng(3)
+    n, k = 200, 3
+    scores = rng.random((n, k))
+    labels = [
+        [c for c in range(k) if rng.random() < 0.3] for _ in range(n)
+    ]
+    aps = MeanAveragePrecisionEvaluator(k).evaluate(scores, labels)
+
+    for c in range(k):
+        y = np.array([1 if c in lab else 0 for lab in labels])
+        order = np.argsort(-scores[:, c], kind="stable")
+        ys = y[order]
+        tp = np.cumsum(ys)
+        prec = tp / (np.arange(n) + 1)
+        rec = tp / max(ys.sum(), 1)
+        ap = 0.0
+        for t in np.linspace(0.0, 1.0, 11):
+            mask = rec >= t - 1e-12
+            ap += prec[mask].max() if mask.any() else 0.0
+        np.testing.assert_allclose(aps[c], ap / 11.0, atol=1e-9)
+
+
+def test_multiclass_summary_renders():
+    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+
+    m = MulticlassClassifierEvaluator(3).evaluate([0, 1, 2, 1], [0, 1, 1, 1])
+    s = m.summary()
+    assert "Accuracy" in s or "accuracy" in s

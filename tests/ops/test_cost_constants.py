@@ -1,0 +1,149 @@
+"""Cost-constant plumbing: measured-on-chip weights must actually steer
+the meta-solver (round-2 verdict item 4's test leg).
+
+The reference fit its constants on its cluster and shipped them
+(reference: nodes/learning/LeastSquaresEstimator.scala:17-31,
+scripts/solver-comparisons-final.csv); here the analogous artifact is
+keystone_tpu/ops/learning/tpu_cost_constants.json written by
+scripts/solver_comparison.py --fit-constants on the chip.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning import cost as cost_mod
+from keystone_tpu.ops.learning.cost import CostWeights
+from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+from keystone_tpu.workflow.optimize import DataStats
+
+
+def _choice(weights, n, d, k, sparsity=1.0, machines=1):
+    """The meta-solver's pick for given stats/weights, via the same cost
+    comparison optimize() runs (shape stats supplied directly)."""
+    from keystone_tpu.data.dataset import ArrayDataset
+
+    est = LeastSquaresEstimator(weights=weights, num_machines=machines)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, d)).astype(np.float32)
+    if sparsity < 1.0:
+        mask = rng.random((32, d)) < sparsity
+        x = x * mask
+    y = rng.normal(size=(32, k)).astype(np.float32)
+    picked = est.optimize(
+        [ArrayDataset(x), ArrayDataset(y)],
+        DataStats(n_total=n, num_shards=1, n_per_shard=[n]),
+    )
+    return type(picked).__name__
+
+
+def test_measured_constants_file_preferred(tmp_path, monkeypatch):
+    path = tmp_path / "tpu_cost_constants.json"
+    path.write_text(json.dumps({"cpu": 1e-11, "mem": 2e-9, "network": 3e-8}))
+    monkeypatch.setattr(cost_mod, "MEASURED_CONSTANTS_PATH", str(path))
+    w = cost_mod.default_cost_weights(backend="tpu")
+    assert w == CostWeights(cpu=1e-11, mem=2e-9, network=3e-8)
+
+
+def test_missing_or_corrupt_measured_file_falls_back(tmp_path, monkeypatch):
+    monkeypatch.setattr(
+        cost_mod, "MEASURED_CONSTANTS_PATH", str(tmp_path / "nope.json")
+    )
+    assert cost_mod.default_cost_weights(backend="tpu") == cost_mod.tpu_weights()
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    monkeypatch.setattr(cost_mod, "MEASURED_CONSTANTS_PATH", str(bad))
+    assert cost_mod.default_cost_weights(backend="tpu") == cost_mod.tpu_weights()
+
+
+def test_cpu_backend_keeps_reference_constants():
+    assert (
+        cost_mod.default_cost_weights(backend="cpu")
+        == cost_mod.DEFAULT_COST_WEIGHTS
+    )
+
+
+def test_weights_are_load_bearing():
+    """optimize() must actually consume the weights: a compute-dominated
+    and a network-dominated weight set must disagree somewhere on a shape
+    grid — guards against the weights being plumbed but ignored."""
+    cpu_heavy = CostWeights(cpu=1e-6, mem=1e-15, network=1e-15)
+    net_heavy = CostWeights(cpu=1e-15, mem=1e-15, network=1e-3)
+    grid = [
+        (n, d, k)
+        for n in (10_000, 1_000_000)
+        for d in (128, 1024, 4096)
+        for k in (2, 138)
+    ]
+    flips = [
+        (n, d, k)
+        for (n, d, k) in grid
+        if _choice(cpu_heavy, n, d, k, machines=8)
+        != _choice(net_heavy, n, d, k, machines=8)
+    ]
+    assert flips, "no shape flips the solver choice between weight sets"
+
+
+def test_meta_solver_prediction_matches_measured_sweep():
+    """With the committed on-chip constants, the meta-solver's pick at
+    each measured dense shape must be (near-)fastest among what the sweep
+    actually measured — the end-to-end check that the refit makes
+    auto-selection reflect this machine (reference analog:
+    LeastSquaresEstimator's constants reproducing
+    solver-comparisons-final.csv's winners)."""
+    import csv
+    import os
+
+    csv_path = os.path.join(
+        os.path.dirname(__file__), "..", "..", "scripts",
+        "solver-comparisons-tpu.csv",
+    )
+    w = cost_mod.measured_tpu_weights()
+    if w is None or not os.path.exists(csv_path):
+        pytest.skip("on-chip sweep/constants not committed yet")
+
+    by_shape = {}
+    with open(csv_path) as f:
+        for row in csv.DictReader(f):
+            key = (int(row["n"]), int(row["d"]), int(row["k"]), float(row["sparsity"]))
+            by_shape.setdefault(key, {})[row["solver"]] = float(row["ms"])
+
+    name_map = {
+        "LinearMapEstimator": "exact",
+        "BlockLeastSquaresEstimator": "block",
+        "DenseLBFGSEstimator": "lbfgs",
+        "SparseLBFGSEstimator": "sparse_lbfgs",
+    }
+    for (n, d, k, sparsity), times in by_shape.items():
+        if len(times) < 2:
+            continue  # single-candidate shapes can't mis-rank
+        picked = name_map[_choice(w, n, d, k, sparsity=sparsity)]
+        if picked not in times:
+            continue  # picked solver wasn't measured at this shape
+        fastest = min(times.values())
+        assert times[picked] <= 2.0 * fastest, (
+            f"at (n={n}, d={d}, k={k}, sp={sparsity}) picked {picked} "
+            f"({times[picked]:.0f} ms) vs fastest {fastest:.0f} ms: {times}"
+        )
+
+
+def test_sparse_data_picks_sparse_solver():
+    """The Amazon asymmetry (reference csv: sparse d=16384 inverts the
+    winner, solver-comparisons-final.csv:11-12) must survive any weights:
+    very sparse wide data routes to the sparse LBFGS path."""
+    for w in (cost_mod.DEFAULT_COST_WEIGHTS, cost_mod.tpu_weights()):
+        picked = _choice(w, 50_000_000, 16384, 2, sparsity=0.005)
+        assert picked == "SparseLBFGSEstimator", (w, picked)
+
+
+def test_measured_constants_committed_and_sane():
+    """Once the on-chip refit has run, the committed JSON must load and
+    carry positive weights fitted on a TPU device kind."""
+    w = cost_mod.measured_tpu_weights()
+    if w is None:
+        pytest.skip("tpu_cost_constants.json not committed yet")
+    assert w.cpu > 0 and w.mem > 0 and w.network > 0
+    with open(cost_mod.MEASURED_CONSTANTS_PATH) as f:
+        payload = json.load(f)
+    assert "fitted_on" in payload

@@ -79,8 +79,11 @@ def test_masked_extractor_op_equals_raw_extractor(bucketed):
         desc, valid = ext.apply_arrays_masked(
             jnp.asarray(bucket.images, jnp.float32), jnp.asarray(bucket.dims)
         )
+        # Jit fusion can shift a value across the floor(512·d) quantization
+        # boundary; ±1 quantization unit is the reference's own tolerance
+        # (VLFeatSuite.scala:47-52).
         np.testing.assert_allclose(
-            np.asarray(bucket_ds.data["desc"]), np.asarray(desc), atol=1e-5
+            np.asarray(bucket_ds.data["desc"]), np.asarray(desc), atol=1.0
         )
         np.testing.assert_array_equal(
             np.asarray(bucket_ds.data["valid"]), np.asarray(valid)
@@ -112,3 +115,21 @@ def test_column_sampler_masked_on_device(bucketed):
     assert arr.shape[0] <= 5 * len(bd)
     norms = np.linalg.norm(arr, axis=1)
     assert (norms > 0).all()
+
+
+def test_masked_extractor_pipeline_pickles(tmp_path, bucketed):
+    """FittedPipeline.save must work with MaskedExtractor in the graph
+    (the jit cache is rebuilt lazily after load, never pickled)."""
+    import pickle
+
+    buckets, bd, _ = bucketed
+    op = MaskedExtractor(SIFTExtractor(scale_step=2))
+    _ = op.apply_batch(bd)  # populate the jit cache
+    blob = pickle.dumps(op)
+    op2 = pickle.loads(blob)
+    out = op2.apply_batch(bd)
+    assert isinstance(out, BucketedDataset)
+    np.testing.assert_allclose(
+        np.asarray(out.buckets[0].data["valid"]),
+        np.asarray(op.apply_batch(bd).buckets[0].data["valid"]),
+    )
