@@ -99,6 +99,10 @@ WORKLOADS: Dict[str, Tuple[str, str, str, Dict[str, Any], str]] = {
         "imagenet", "ImageNetSiftLcsFVConfig", "run", {},
         "ImageNet dual-branch SIFT+LCS Fisher Vector pipeline",
     ),
+    "imagenet-native": (
+        "imagenet", "ImageNetSiftLcsFVConfig", "run_native_resolution", {},
+        "ImageNet SIFT+LCS+FV with per-image native-resolution featurization",
+    ),
     "amazon-reviews": (
         "text", "AmazonReviewsConfig", "run_amazon", {},
         "Amazon reviews n-gram logistic/LBFGS text pipeline",
@@ -117,8 +121,9 @@ WORKLOADS: Dict[str, Tuple[str, str, str, Dict[str, Any], str]] = {
             f"CIFAR-10 {v} workload",
         )
         for v in (
-            "linear_pixels", "random", "random_patch", "random_patch_kernel",
-            "random_patch_augmented", "random_patch_kernel_augmented",
+            "linear_pixels", "random", "random_patch", "random_patch_fused",
+            "random_patch_kernel", "random_patch_augmented",
+            "random_patch_kernel_augmented",
         )
     },
 }

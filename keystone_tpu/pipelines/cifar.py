@@ -290,6 +290,13 @@ def run_augmented(config: RandomCifarConfig, solver: str = "block") -> dict:
     return results
 
 
+_PATCH_SOLVERS = {
+    "random_patch": "block",
+    "random_patch_fused": "conv_block",
+    "random_patch_kernel": "kernel",
+}
+
+
 def run(config: RandomCifarConfig, variant: str = "random_patch") -> dict:
     """Run a CIFAR workload end to end; returns train/test error."""
     if variant in ("random_patch_augmented", "random_patch_kernel_augmented"):
@@ -303,12 +310,13 @@ def run(config: RandomCifarConfig, variant: str = "random_patch") -> dict:
         pipeline = build_linear_pixels(train)
     elif variant == "random":
         pipeline = build_random_patch(train, config, solver="linear")
-    elif variant == "random_patch":
+    elif variant in _PATCH_SOLVERS:
+        # random_patch_fused = the rematerializing solver: featurize +
+        # standardize + solve as one machine (ops/learning/conv_block.py).
         filters, whitener = learn_random_patch_filters(train_images, config)
-        pipeline = build_random_patch(train, config, filters, whitener, solver="block")
-    elif variant == "random_patch_kernel":
-        filters, whitener = learn_random_patch_filters(train_images, config)
-        pipeline = build_random_patch(train, config, filters, whitener, solver="kernel")
+        pipeline = build_random_patch(
+            train, config, filters, whitener, solver=_PATCH_SOLVERS[variant]
+        )
     else:
         raise ValueError(f"unknown variant {variant!r}")
 

@@ -51,13 +51,13 @@ def test_linear_pixels_learns():
     assert ev.total_error < 0.15
 
 
-@pytest.mark.parametrize("solver", ["block", "kernel"])
+@pytest.mark.parametrize("solver", ["block", "kernel", "conv_block"])
 def test_random_patch_cifar_learns(solver):
     train = make_synthetic_cifar(192, seed=1)
     config = cifar.RandomCifarConfig(
         num_filters=32,
         patch_steps=4,
-        reg=1.0 if solver == "block" else 1e-4,
+        reg=1.0 if solver in ("block", "conv_block") else 1e-4,
         kernel_block_size=64,
         gamma=1e-3,
     )

@@ -1,0 +1,154 @@
+"""Node-level optimization rule tests (reference:
+workflow/NodeOptimizationRuleSuite.scala: hand-built graphs with toy
+Optimizable operators, assertions on the chosen implementation) plus DOT
+export and estimator-chaining equivalences the reference asserts in
+EstimatorSuite/LabelEstimatorSuite.
+"""
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.workflow.optimize import (
+    DataStats,
+    NodeOptimizationRule,
+    Optimizable,
+)
+from keystone_tpu.workflow.pipeline import (
+    Estimator,
+    LabelEstimator,
+    Transformer,
+)
+
+
+class _Scale(Transformer):
+    def __init__(self, factor):
+        self.factor = factor
+
+    def apply(self, x):
+        return x * self.factor
+
+    def apply_batch(self, ds):
+        return ArrayDataset(np.asarray(ds.data) * self.factor, ds.num_examples)
+
+
+class _ChooseByN(Transformer, Optimizable):
+    """Toy optimizable: picks ×2 for small data, ×3 for large — and
+    records what it saw, so the test can assert the rule fed it samples
+    and FULL-data statistics (not sample-sized ones)."""
+
+    def __init__(self, threshold=50):
+        self.threshold = threshold
+        self.seen = None
+
+    def apply(self, x):
+        return x  # default when optimization never ran
+
+    def apply_batch(self, ds):
+        return ds
+
+    def optimize(self, samples, stats: DataStats):
+        self.seen = (len(samples[0]), stats)
+        return _Scale(2.0) if stats.n_total < self.threshold else _Scale(3.0)
+
+
+def _run(pipe, data):
+    out = pipe(data).get()
+    return np.asarray(out.data)[: len(data)]
+
+
+def test_rule_replaces_operator_using_full_data_stats():
+    op = _ChooseByN(threshold=50)
+    pipe = op.to_pipeline()
+    data = ArrayDataset(np.ones((80, 2), np.float32))
+    got = _run(pipe, data)
+    np.testing.assert_allclose(got, 3.0 * np.ones((80, 2)))
+    sample_len, stats = op.seen
+    assert stats.n_total == 80  # full size, not the sample's
+    assert sample_len <= NodeOptimizationRule().sample_size
+
+
+def test_rule_picks_small_branch_below_threshold():
+    op = _ChooseByN(threshold=50)
+    data = ArrayDataset(np.ones((10, 2), np.float32))
+    got = _run(op.to_pipeline(), data)
+    np.testing.assert_allclose(got, 2.0 * np.ones((10, 2)))
+
+
+def test_rule_failure_falls_back_to_default():
+    class _Broken(_ChooseByN):
+        def optimize(self, samples, stats):
+            raise RuntimeError("boom")
+
+    op = _Broken()
+    data = ArrayDataset(np.ones((10, 2), np.float32))
+    got = _run(op.to_pipeline(), data)  # default apply: identity
+    np.testing.assert_allclose(got, np.ones((10, 2)))
+
+
+# ------------------------------------------------------------- DOT export
+
+
+def test_graph_dot_export_names_operators():
+    pipe = _Scale(2.0).to_pipeline().then(_Scale(5.0))
+    dot = pipe.graph.to_dot()
+    assert dot.startswith("digraph")
+    assert dot.count("_Scale") >= 2
+    assert "->" in dot
+
+
+# ----------------------------------------- estimator chaining equivalences
+
+
+class _MeanEstimator(Estimator):
+    def fit(self, data):
+        mu = float(np.asarray(data.data)[: data.num_examples].mean())
+        return _Scale(mu)
+
+
+class _MeanLabelEstimator(LabelEstimator):
+    def fit(self, data, labels):
+        mu = float(np.asarray(labels.data)[: labels.num_examples].mean())
+        return _Scale(mu)
+
+
+def test_estimator_with_data_equals_direct_fit():
+    """est.with_data(d) spliced into a pipeline computes the same model
+    as est.fit(d) applied manually (reference: EstimatorSuite)."""
+    rng = np.random.default_rng(0)
+    train = ArrayDataset(rng.random((20, 3)).astype(np.float32))
+    test = ArrayDataset(rng.random((5, 3)).astype(np.float32))
+
+    pipe = _MeanEstimator().with_data(train)
+    via_pipeline = np.asarray(pipe(test).get().data)[:5]
+
+    model = _MeanEstimator().fit(train)
+    direct = np.asarray(model.apply_batch(test).data)[:5]
+    np.testing.assert_allclose(via_pipeline, direct)
+
+
+def test_label_estimator_with_data_equals_direct_fit():
+    rng = np.random.default_rng(1)
+    train = ArrayDataset(rng.random((20, 3)).astype(np.float32))
+    labels = ArrayDataset(rng.random((20, 1)).astype(np.float32))
+    test = ArrayDataset(rng.random((5, 3)).astype(np.float32))
+
+    pipe = _MeanLabelEstimator().with_data(train, labels)
+    via_pipeline = np.asarray(pipe(test).get().data)[:5]
+
+    model = _MeanLabelEstimator().fit(train, labels)
+    direct = np.asarray(model.apply_batch(test).data)[:5]
+    np.testing.assert_allclose(via_pipeline, direct)
+
+
+def test_chained_estimator_sees_transformed_data():
+    """prefix.then_estimator(est, data): est must fit on prefix(data),
+    not raw data (reference: Chainable.andThen estimator overloads)."""
+    rng = np.random.default_rng(2)
+    raw = ArrayDataset(rng.random((16, 2)).astype(np.float32))
+    test = ArrayDataset(np.ones((4, 2), np.float32))
+
+    pipe = _Scale(10.0).to_pipeline().then_estimator(_MeanEstimator(), raw)
+    got = np.asarray(pipe(test).get().data)[:4]
+
+    want_mu = float((np.asarray(raw.data) * 10.0).mean())
+    np.testing.assert_allclose(got, 10.0 * want_mu * np.ones((4, 2)), rtol=1e-6)
