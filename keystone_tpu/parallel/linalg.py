@@ -31,14 +31,35 @@ from .collectives import shard_map
 from .mesh import DATA_AXIS, MODEL_AXIS, get_mesh, row_axes, row_shard_count
 
 
-# Solver matmuls run at full fp32 on the MXU: linear systems are far more
-# precision-sensitive than NN forward passes, and the reference computed in
-# float64 Breeze. HIGHEST ≈ 6-pass bf16 emulation of fp32 on TPU.
-PRECISION = lax.Precision.HIGHEST
+# Solver matmuls run at full fp32 on the MXU by default: linear systems
+# are far more precision-sensitive than NN forward passes, and the
+# reference computed in float64 Breeze. HIGHEST ≈ 6-pass bf16 emulation
+# of fp32 on TPU — measured at 32 TFLOP/s on v5e vs 173 for the 3-pass
+# default (bench.py gram_mfu). KEYSTONE_SOLVER_PRECISION=default opts
+# into the 5× faster 3-pass mode (Gram entries lose ~1 decimal digit;
+# fine for well-regularized solves, not for near-singular ones).
+def _solver_precision() -> lax.Precision:
+    import os
+
+    name = os.environ.get("KEYSTONE_SOLVER_PRECISION", "highest").lower()
+    table = {
+        "highest": lax.Precision.HIGHEST,
+        "high": lax.Precision.HIGH,
+        "default": lax.Precision.DEFAULT,
+    }
+    if name not in table:  # loud, not silent: a typo'd "fast mode" that
+        raise ValueError(  # silently ran 6-pass would mislead benchmarks
+            f"KEYSTONE_SOLVER_PRECISION={name!r}: expected one of {sorted(table)}"
+        )
+    return table[name]
+
+
+PRECISION = _solver_precision()
 
 
 def mm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Full-precision matmul for solver-critical products."""
+    """Solver-grade matmul (precision set once at import from
+    KEYSTONE_SOLVER_PRECISION; see note above)."""
     return jnp.matmul(a, b, precision=PRECISION)
 
 
