@@ -8,14 +8,24 @@ scripts/solver-comparisons-final.csv:14). vs_baseline > 1 means this
 framework on one chip beats the 16-node cluster.
 
 Also measured (reported as extra keys on the same JSON line):
-  - gram_mfu: achieved TFLOP/s + MFU of the sharded Gram matmul, the
-    kernel at the heart of every exact/block solver here.
-  - cifar_random_patch: featurizer images/sec + block-solve time at the
-    reference config (numFilters=10000 — reference:
-    examples/images/cifar_random_patch.sh:30-36).
+  - timit_exact_fastmode: the headline re-run with
+    KEYSTONE_SOLVER_PRECISION=default (3-pass matmuls) — train_mse
+    columns quantify the accuracy cost of the 5× Gram speedup.
+  - timit_wide_block: BCD at the reference's widest measured TIMIT point
+    (d=16384, block 1024; 580,555 ms on its cluster — reference csv:26).
+  - gram_mfu: slope-timed TFLOP/s + MFU of the raw Gram matmul (the
+    kernel under every solver) at bf16 / fp32 / fp32-HIGHEST, plus the
+    attachment's per-dispatch round-trip latency.
+  - cifar_random_patch: END-TO-END fit at the reference config
+    (50k images × numFilters=10000 — reference:
+    examples/images/cifar_random_patch.sh:30-36) via on-device block
+    rematerialization, plus device featurize throughput.
   - imagenet_fv: per-stage wall-clock (SIFT / LCS / PCA / GMM / FV /
     solve) of the flagship SIFT+LCS+FisherVector pipeline (reference:
-    pipelines/images/imagenet/ImageNetSiftLcsFV.scala:75-141).
+    pipelines/images/imagenet/ImageNetSiftLcsFV.scala:75-141), with an
+    OOM reduction ladder.
+  - imagenet_native: native-resolution (size-bucketed, masked) SIFT+LCS
+    featurization throughput at ≥10k mixed-size images.
 
 Robustness contract (this file must NEVER exit non-zero without printing
 a machine-readable line): the parent process runs the actual benchmark in

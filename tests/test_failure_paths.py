@@ -173,3 +173,28 @@ def test_streaming_threshold_env_override(monkeypatch):
 
     monkeypatch.setenv("KEYSTONE_STREAM_BYTES", "123")
     assert block_mod._host_streaming_threshold_bytes() == 123
+
+
+def test_solver_precision_env_knob():
+    """KEYSTONE_SOLVER_PRECISION resolves at import; invalid values raise
+    (a typo'd 'fast mode' must not silently run 6-pass)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu';"
+        "from keystone_tpu.parallel import linalg; print(linalg.PRECISION)"
+    )
+    for value, expect in (("default", "DEFAULT"), ("highest", "HIGHEST")):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**__import__("os").environ, "KEYSTONE_SOLVER_PRECISION": value},
+            capture_output=True, text=True, timeout=120,
+        )
+        assert expect in out.stdout, (value, out.stdout, out.stderr)
+    bad = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**__import__("os").environ, "KEYSTONE_SOLVER_PRECISION": "bf16"},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert bad.returncode != 0 and "KEYSTONE_SOLVER_PRECISION" in bad.stderr
