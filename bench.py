@@ -697,7 +697,13 @@ def main() -> int:
     # cifar OOM left imagenet_fv dying at 0.3s in the shared process).
     # Each attempt is gated by a fast init probe so a hung tunnel costs
     # minutes, not the full benchmark timeout.
-    per_workload_timeout = {"cifar_random_patch": 1200.0}
+    per_workload_timeout = {
+        "cifar_random_patch": 1200.0,
+        # 1000-class weighted solve = a scan of 1000 (4096, 4096)
+        # Cholesky factorizations at solver precision + the featurize
+        # stages; give it room before the ladder gets blamed.
+        "imagenet_fv": 1500.0,
+    }
     merged: dict = {}
     for attempt in range(2):
         # Only (re)run workloads with no successful result yet, so a flaky
