@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# One-shot sequence of every on-chip measurement this repo ships, in the
+# order that respects the single-chip claim (one TPU process at a time):
+#   1. solver comparison sweep + cost-constant fit (writes
+#      scripts/solver-comparisons-tpu.csv + ops/learning/tpu_cost_constants.json)
+#   2. the full benchmark suite (bench.py, per-workload child processes)
+# Run from the repo root. Each stage logs to /tmp and keeps going on
+# failure so one wedged stage doesn't blank the rest.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== stage 1: solver sweep + constant fit ==="
+python scripts/solver_comparison.py \
+    --out scripts/solver-comparisons-tpu.csv --preset full --fit-constants \
+    2>&1 | tee /tmp/sweep_tpu.log | tail -5 || echo "sweep failed (see /tmp/sweep_tpu.log)"
+
+echo "=== stage 2: full bench ==="
+python bench.py 2>&1 | tee /tmp/bench_full.log | tail -2 || echo "bench failed (see /tmp/bench_full.log)"
+
+echo "=== artifacts ==="
+ls -la scripts/solver-comparisons-tpu.csv keystone_tpu/ops/learning/tpu_cost_constants.json 2>/dev/null
