@@ -72,24 +72,26 @@ class LinearMapEstimator(LabelEstimator):
         )
         n = features.num_examples
 
-        # Single sharded pass: raw Grams + column sums; then center the
-        # normal equations algebraically (Σ(a−μ)(a−μ)ᵀ = AᵀA − n·μμᵀ) —
-        # no centered copy of the data is ever materialized, which matters
-        # when A fills most of HBM. Padded zero rows cancel exactly.
-        ata, atb, sa, sb = linalg.gram_with_sums(x, y, mesh=mesh)
-        w, mu_a, mu_b = _centered_solve(
-            ata, atb, sa, sb,
-            jnp.float32(n), jnp.float32(self.reg or 0.0),
+        # ONE dispatch: sharded Gram + column sums + algebraic centering
+        # (Σ(a−μ)(a−μ)ᵀ = AᵀA − n·μμᵀ) + replicated Cholesky — no centered
+        # copy of the data is ever materialized (matters when A fills most
+        # of HBM) and no second host→device round trip for the solve.
+        # KEYSTONE_SOLVER_PRECISION=refine swaps the 6-pass Gram for the
+        # fast 1-pass Gram + 2 high-precision residual-correction steps
+        # (cost 2·n·d·k vs n·d² — cheap when k ≪ d).
+        mode = linalg.solver_mode()
+        if mode == "refine":
+            gram_precision, refine_steps = jax.lax.Precision.DEFAULT, 2
+        else:
+            # The mode's own precision, not the import-time PRECISION —
+            # bench legs flip the env var after import and must get the
+            # Gram speed they asked for.
+            gram_precision, refine_steps = linalg.precision_for_mode(mode), 0
+        w, mu_a, mu_b = linalg.centered_solve_refined(
+            x, y, n, self.reg or 0.0, mesh=mesh,
+            gram_precision=gram_precision, refine_steps=refine_steps,
         )
         return LinearMapper(w, intercept=mu_b, feature_mean=mu_a)
-
-
-@jax.jit
-def _centered_solve(ata, atb, sa, sb, n, reg):
-    mu_a, mu_b = sa / n, sb / n
-    ata_c = ata - n * jnp.outer(mu_a, mu_a)
-    atb_c = atb - n * jnp.outer(mu_a, mu_b)
-    return linalg.solve_spd(ata_c, atb_c, reg=reg), mu_a, mu_b
 
 
 class LocalLeastSquaresEstimator(LabelEstimator):

@@ -279,6 +279,10 @@ class ColumnPCAEstimator(Estimator, Optimizable, CostModel):
             # the true per-item descriptor count is the valid total.
             cols = float(np.mean([np.asarray(m["valid"]).sum() for m in items]))
             d = int(np.asarray(items[0]["desc"]).shape[-1])
+        elif np.asarray(items[0]).ndim == 1:
+            # Plain feature vectors: one row per item.
+            cols = 1.0
+            d = int(np.asarray(items[0]).shape[0])
         else:
             cols = float(np.mean([np.asarray(m).shape[0] for m in items]))
             d = int(np.asarray(items[0]).shape[1])

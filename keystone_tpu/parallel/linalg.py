@@ -38,20 +38,40 @@ from .mesh import DATA_AXIS, MODEL_AXIS, get_mesh, row_axes, row_shard_count
 # default (bench.py gram_mfu). KEYSTONE_SOLVER_PRECISION=default opts
 # into the 5× faster 3-pass mode (Gram entries lose ~1 decimal digit;
 # fine for well-regularized solves, not for near-singular ones).
-def _solver_precision() -> lax.Precision:
+# One table for both readers below. "refine" selects the mixed-precision
+# exact solver (fast Gram + high-precision iterative refinement, see
+# centered_solve_refined); every other solver-grade matmul stays HIGHEST.
+_PRECISION_MODES = {
+    "highest": lax.Precision.HIGHEST,
+    "high": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+    "refine": lax.Precision.HIGHEST,
+}
+
+
+def solver_mode() -> str:
+    """The KEYSTONE_SOLVER_PRECISION mode, read per call (so tests and
+    bench legs can flip it without re-importing the module). The global
+    ``PRECISION``/``mm`` stay fixed at import; only the exact
+    normal-equations solver consults this dynamically."""
     import os
 
     name = os.environ.get("KEYSTONE_SOLVER_PRECISION", "highest").lower()
-    table = {
-        "highest": lax.Precision.HIGHEST,
-        "high": lax.Precision.HIGH,
-        "default": lax.Precision.DEFAULT,
-    }
-    if name not in table:  # loud, not silent: a typo'd "fast mode" that
-        raise ValueError(  # silently ran 6-pass would mislead benchmarks
-            f"KEYSTONE_SOLVER_PRECISION={name!r}: expected one of {sorted(table)}"
+    if name not in _PRECISION_MODES:  # loud, not silent: a typo'd "fast
+        raise ValueError(  # mode" that silently ran 6-pass would mislead
+            f"KEYSTONE_SOLVER_PRECISION={name!r}: expected one of "
+            f"{sorted(_PRECISION_MODES)}"
         )
-    return table[name]
+    return name
+
+
+def precision_for_mode(mode: str) -> lax.Precision:
+    """Matmul precision for a KEYSTONE_SOLVER_PRECISION mode name."""
+    return _PRECISION_MODES[mode]
+
+
+def _solver_precision() -> lax.Precision:
+    return _PRECISION_MODES[solver_mode()]
 
 
 PRECISION = _solver_precision()
@@ -129,27 +149,6 @@ def _gram2_fn(mesh: Mesh):
     )
 
 
-@functools.lru_cache(maxsize=None)
-def _gram_with_sums_fn(mesh: Mesh):
-    axes = row_axes(mesh)
-
-    def f(a_local, b_local):
-        ata = lax.psum(mm(a_local.T, a_local), axes)
-        atb = lax.psum(mm(a_local.T, b_local), axes)
-        sa = lax.psum(jnp.sum(a_local, axis=0), axes)
-        sb = lax.psum(jnp.sum(b_local, axis=0), axes)
-        return ata, atb, sa, sb
-
-    return jax.jit(
-        shard_map(
-            f,
-            mesh=mesh,
-            in_specs=(P(axes, None), P(axes, None)),
-            out_specs=(P(), P(), P(), P()),
-        )
-    )
-
-
 def gram(
     a: jnp.ndarray,
     b: Optional[jnp.ndarray] = None,
@@ -166,19 +165,102 @@ def gram(
     return _gram2_fn(mesh)(a, b)
 
 
-def gram_with_sums(
-    a: jnp.ndarray,
-    b: jnp.ndarray,
-    mesh: Optional[Mesh] = None,
-) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """One pass producing AᵀA, AᵀB, Σa_i, Σb_i.
+@functools.lru_cache(maxsize=None)
+def _centered_solve_fused_fn(
+    mesh: Mesh,
+    gram_precision: lax.Precision,
+    refine_steps: int,
+    resid_precision: lax.Precision,
+):
+    """ONE jitted computation: sharded Gram + algebraic centering +
+    replicated Cholesky solve + optional mixed-precision iterative
+    refinement. Fusing the whole solve into a single dispatch matters on
+    relay-backed attachments (~66 ms host→device round trip per dispatch,
+    docs/PERFORMANCE.md): the previous gram→solve split paid that twice.
 
-    Lets callers solve *centered* least squares without materializing a
-    centered copy of A (9 GB at TIMIT scale):
-        Σ(aᵢ−μ)(aᵢ−μ)ᵀ = AᵀA − n·μμᵀ  (zero-padded rows cancel exactly).
+    Refinement (classic mixed-precision IR): the Gram runs at a fast
+    precision, the Cholesky factor of that approximate Gram becomes the
+    preconditioner, and each step recomputes the TRUE normal-equations
+    residual from A itself at ``resid_precision`` — cost 2·n·d·k flops
+    per step vs n·d² for the Gram, cheap whenever k ≪ d. The residual of
+    the *centered* system is computed without materializing centered
+    data: with S = B − A·W (padded zero rows contribute nothing),
+
+        A_cᵀ(B_c − A_c·W) = AᵀS − μ_a·(1ᵀS)      (the n·μ_a·cᵀ terms cancel)
+
+    so each step is one sharded pass producing (AᵀS, 1ᵀS) + a psum.
+    """
+    axes = row_axes(mesh)
+
+    def gram_part(a_local, b_local):
+        g = lambda p, q: jnp.matmul(p, q, precision=gram_precision)
+        ata = lax.psum(g(a_local.T, a_local), axes)
+        atb = lax.psum(g(a_local.T, b_local), axes)
+        sa = lax.psum(jnp.sum(a_local, axis=0), axes)
+        sb = lax.psum(jnp.sum(b_local, axis=0), axes)
+        return ata, atb, sa, sb
+
+    gram_raw = shard_map(
+        gram_part, mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None)),
+        out_specs=(P(), P(), P(), P()),
+    )
+
+    def resid_part(a_local, b_local, w):
+        r = lambda p, q: jnp.matmul(p, q, precision=resid_precision)
+        s = b_local - r(a_local, w)
+        ats = lax.psum(r(a_local.T, s), axes)
+        ssum = lax.psum(jnp.sum(s, axis=0), axes)
+        return ats, ssum
+
+    resid_raw = shard_map(
+        resid_part, mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P()),
+        out_specs=(P(), P()),
+    )
+
+    def run(x, y, n, reg):
+        ata, atb, sa, sb = gram_raw(x, y)
+        mu_a, mu_b = sa / n, sb / n
+        d = ata.shape[0]
+        ata_c = ata - n * jnp.outer(mu_a, mu_a)
+        atb_c = atb - n * jnp.outer(mu_a, mu_b)
+        factor = jax.scipy.linalg.cho_factor(
+            ata_c + reg * jnp.eye(d, dtype=ata.dtype), lower=True
+        )
+        w = jax.scipy.linalg.cho_solve(factor, atb_c)
+        for _ in range(refine_steps):
+            ats, ssum = resid_raw(x, y, w)
+            r = ats - jnp.outer(mu_a, ssum) - reg * w
+            w = w + jax.scipy.linalg.cho_solve(factor, r)
+        return w, mu_a, mu_b
+
+    return jax.jit(run)
+
+
+def centered_solve_refined(
+    x: jnp.ndarray,
+    y: jnp.ndarray,
+    n: int,
+    reg: float,
+    mesh: Optional[Mesh] = None,
+    gram_precision: lax.Precision = None,
+    refine_steps: int = 0,
+    resid_precision: lax.Precision = lax.Precision.HIGHEST,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Centered ridge solve (w, μ_a, μ_b) in one dispatch, with optional
+    mixed-precision iterative refinement (see _centered_solve_fused_fn).
+
+    ``x``/``y`` must be row-sharded (zero-padded rows allowed); ``n`` is
+    the true (unpadded) row count.
     """
     mesh = mesh or get_mesh()
-    return _gram_with_sums_fn(mesh)(a, b)
+    if gram_precision is None:
+        gram_precision = PRECISION
+    fn = _centered_solve_fused_fn(
+        mesh, gram_precision, int(refine_steps), resid_precision
+    )
+    return fn(x, y, jnp.float32(n), jnp.float32(reg))
 
 
 def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg=0.0) -> jnp.ndarray:
@@ -193,7 +275,25 @@ def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg=0.0) -> jnp.ndarray:
     return jax.scipy.linalg.cho_solve(factor, atb)
 
 
-_solve_spd_jit = jax.jit(solve_spd)
+@functools.lru_cache(maxsize=None)
+def _normal_equations_fn(mesh: Mesh):
+    axes = row_axes(mesh)
+
+    def grams(a_local, b_local):
+        ata = lax.psum(mm(a_local.T, a_local), axes)
+        atb = lax.psum(mm(a_local.T, b_local), axes)
+        return ata, atb
+
+    gram_raw = shard_map(
+        grams, mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None)), out_specs=(P(), P()),
+    )
+
+    def run(a, b, reg):
+        ata, atb = gram_raw(a, b)
+        return solve_spd(ata, atb, reg=reg)
+
+    return jax.jit(run)
 
 
 def normal_equations_solve(
@@ -202,9 +302,13 @@ def normal_equations_solve(
     reg: float = 0.0,
     mesh: Optional[Mesh] = None,
 ) -> jnp.ndarray:
-    """One-shot distributed least squares: x = (AᵀA + λI)⁻¹ Aᵀb."""
-    ata, atb = gram(a, b, mesh=mesh)
-    return _solve_spd_jit(ata, atb, jnp.asarray(reg, dtype=ata.dtype))
+    """One-shot distributed least squares: x = (AᵀA + λI)⁻¹ Aᵀb.
+
+    Gram + replicated Cholesky fused into ONE dispatch (one relay
+    round trip, docs/PERFORMANCE.md on why that matters here).
+    """
+    mesh = mesh or get_mesh()
+    return _normal_equations_fn(mesh)(a, b, jnp.float32(reg))
 
 
 # ------------------------------------------------------------------------ TSQR

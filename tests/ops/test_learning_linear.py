@@ -112,3 +112,26 @@ def test_block_mapper_apply_and_evaluate_streams_per_block():
     assert_about_eq(seen[-1], full, thresh=1e-4)
     # intermediate partials differ from the final (blocks genuinely stream)
     assert not np.allclose(seen[0], full)
+
+
+def test_linear_map_estimator_refine_mode(monkeypatch):
+    """KEYSTONE_SOLVER_PRECISION=refine routes through the fused
+    fast-Gram + iterative-refinement solver and still matches the
+    closed-form ridge solution (mode is read at fit time, not import)."""
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "refine")
+    x, y, _, _ = make_problem(noise=0.3, seed=7)
+    reg = 1.0
+    w_exp, _, mu_b = closed_form(x, y, reg)
+    model = LinearMapEstimator(reg=reg).fit(ArrayDataset(x), ArrayDataset(y))
+    np.testing.assert_allclose(np.asarray(model.weights), w_exp, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(model.intercept), mu_b, atol=1e-4)
+
+
+def test_solver_mode_rejects_typos(monkeypatch):
+    import pytest
+
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "fastest")
+    with pytest.raises(ValueError, match="KEYSTONE_SOLVER_PRECISION"):
+        linalg.solver_mode()

@@ -75,6 +75,20 @@ def test_column_pca_on_descriptor_matrices():
     assert out.shape == (20, 2)
 
 
+def test_column_pca_optimize_accepts_vector_items():
+    # Regression: plain (d,) feature-vector datasets (one row per item,
+    # e.g. pooled features feeding PCA inside a Pipeline) used to raise
+    # IndexError in optimize(), silently skipping the cost-model choice.
+    from keystone_tpu.workflow.optimize import DataStats
+
+    rng = np.random.default_rng(4)
+    vecs = ArrayDataset(rng.normal(size=(50, 8)).astype(np.float32))
+    est = ColumnPCAEstimator(dims=2)
+    stats = DataStats(n_total=50, num_shards=1, n_per_shard=[50])
+    chosen = est.optimize([vecs], stats)
+    assert chosen in (est.local, est.distributed)
+
+
 def test_zca_whitens_covariance():
     rng = np.random.default_rng(2)
     x = (rng.normal(size=(500, 6)) @ rng.normal(size=(6, 6))).astype(np.float32)
