@@ -31,16 +31,20 @@ from .collectives import shard_map
 from .mesh import DATA_AXIS, MODEL_AXIS, get_mesh, row_axes, row_shard_count
 
 
-# Solver matmuls run at full fp32 on the MXU by default: linear systems
-# are far more precision-sensitive than NN forward passes, and the
-# reference computed in float64 Breeze. HIGHEST ≈ 6-pass bf16 emulation
-# of fp32 on TPU — measured at 32 TFLOP/s on v5e vs 173 for the 3-pass
-# default (bench.py gram_mfu). KEYSTONE_SOLVER_PRECISION=default opts
-# into the 5× faster 3-pass mode (Gram entries lose ~1 decimal digit;
-# fine for well-regularized solves, not for near-singular ones).
+# Precision menu, measured on v5e (Gram at (1M, 1024), fp32 inputs —
+# docs/PERFORMANCE.md): DEFAULT (1-pass bf16) 172 TFLOP/s, rel Frobenius
+# error 5.6e-5; HIGH (3-pass) 63 TFLOP/s, 1.1e-5; HIGHEST (6-pass fp32
+# emulation) 32 TFLOP/s, 1.6e-5. Linear systems are precision-sensitive
+# (the reference computed in float64 Breeze), so every solver-grade
+# matmul outside the refined exact solver runs at HIGHEST.
 # One table for both readers below. "refine" selects the mixed-precision
 # exact solver (fast Gram + high-precision iterative refinement, see
 # centered_solve_refined); every other solver-grade matmul stays HIGHEST.
+# "refine" is the DEFAULT for the exact solver on measured evidence
+# (docs/PERFORMANCE.md): at (500k, 1024, 138) with Gram cond 1e4 on v5e,
+# fast-Gram + 2 IR steps lands 540x closer to the converged solution than
+# the 6-pass HIGHEST Cholesky (3.4e-8 vs 1.8e-5 weight error) at ~1.4x
+# less compute — IR corrects the factorization's own rounding too.
 _PRECISION_MODES = {
     "highest": lax.Precision.HIGHEST,
     "high": lax.Precision.HIGH,
@@ -56,7 +60,7 @@ def solver_mode() -> str:
     normal-equations solver consults this dynamically."""
     import os
 
-    name = os.environ.get("KEYSTONE_SOLVER_PRECISION", "highest").lower()
+    name = os.environ.get("KEYSTONE_SOLVER_PRECISION", "refine").lower()
     if name not in _PRECISION_MODES:  # loud, not silent: a typo'd "fast
         raise ValueError(  # mode" that silently ran 6-pass would mislead
             f"KEYSTONE_SOLVER_PRECISION={name!r}: expected one of "
@@ -130,23 +134,29 @@ def _gram_fn(mesh: Mesh):
     return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axes, None), out_specs=P()))
 
 
-@functools.lru_cache(maxsize=None)
-def _gram2_fn(mesh: Mesh):
+def _gram2_raw(mesh: Mesh, precision: Optional[lax.Precision] = None):
+    """Un-jitted shard_map computing (AᵀA, AᵀB) with one psum each — the
+    shared kernel under gram(), normal_equations_solve and the fused
+    centered solve (one definition, three jit contexts)."""
     axes = row_axes(mesh)
+    prec = PRECISION if precision is None else precision
 
     def f2(a_local, b_local):
-        ata = lax.psum(mm(a_local.T, a_local), axes)
-        atb = lax.psum(mm(a_local.T, b_local), axes)
+        ata = lax.psum(jnp.matmul(a_local.T, a_local, precision=prec), axes)
+        atb = lax.psum(jnp.matmul(a_local.T, b_local, precision=prec), axes)
         return ata, atb
 
-    return jax.jit(
-        shard_map(
-            f2,
-            mesh=mesh,
-            in_specs=(P(axes, None), P(axes, None)),
-            out_specs=(P(), P()),
-        )
+    return shard_map(
+        f2,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None)),
+        out_specs=(P(), P()),
     )
+
+
+@functools.lru_cache(maxsize=None)
+def _gram2_fn(mesh: Mesh):
+    return jax.jit(_gram2_raw(mesh))
 
 
 def gram(
@@ -277,17 +287,7 @@ def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg=0.0) -> jnp.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _normal_equations_fn(mesh: Mesh):
-    axes = row_axes(mesh)
-
-    def grams(a_local, b_local):
-        ata = lax.psum(mm(a_local.T, a_local), axes)
-        atb = lax.psum(mm(a_local.T, b_local), axes)
-        return ata, atb
-
-    gram_raw = shard_map(
-        grams, mesh=mesh,
-        in_specs=(P(axes, None), P(axes, None)), out_specs=(P(), P()),
-    )
+    gram_raw = _gram2_raw(mesh)
 
     def run(a, b, reg):
         ata, atb = gram_raw(a, b)

@@ -67,8 +67,15 @@ def make_problem(n, d, k, sparsity, seed=0):
     if sparsity < 1.0:
         import scipy.sparse as sp
 
-        x = sp.random(n, d, density=sparsity, format="csr", dtype=np.float32,
-                      random_state=seed)
+        # Fixed nnz per row with replacement — O(nnz) construction.
+        # (sp.random's no-replacement sampling takes tens of minutes at
+        # 82M nnz; duplicate column hits within a row are harmless for
+        # solver timing — CSR matvec sums them.)
+        per_row = max(1, round(d * sparsity))
+        indices = rng.integers(0, d, size=n * per_row, dtype=np.int32)
+        indptr = np.arange(0, n * per_row + 1, per_row, dtype=np.int64)
+        data = rng.random(n * per_row, dtype=np.float32)
+        x = sp.csr_matrix((data, indices, indptr), shape=(n, d))
         y = np.asarray(x @ w_true, dtype=np.float32)
         y += 0.1 * rng.normal(size=(n, k)).astype(np.float32)
         return x, y
@@ -105,18 +112,23 @@ def time_solver(name, fit, x, y):
     # steady-state execution. The cost model is linear in (flops, elems,
     # moved); a ~30 s compile-time constant offset at these (deliberately
     # small) measurement shapes would swamp the signal and extrapolate
-    # nonsense to the real problem sizes auto-selection serves.
+    # nonsense to the real problem sizes auto-selection serves. The
+    # sparse solver is host-resident scipy — nothing to compile, so a
+    # warm-up would only double a minutes-long measurement.
     def run():
         model = fit(xd, yd)
         # scalar fetch guarantees completion on relay-backed devices
         float(np.asarray(jax.device_get(model.weights)).ravel()[0])
         return model
 
-    run()
+    if name != "sparse_lbfgs":
+        run()
     start = time.perf_counter()
     model = run()
     seconds = time.perf_counter() - start
-    head = min(x.shape[0], 65536)
+    # Cap the densified eval slice by ELEMENTS, not rows: 65536 rows at
+    # d=16384 is a 4.3 GB dense block — enough to OOM the host mid-sweep.
+    head = min(x.shape[0], 65536, max(1024, int(1e8 / x.shape[1])))
     xh = np.asarray(x[:head].todense()) if is_sparse else x[:head]
     pred = np.asarray(model.apply_arrays(xh))
     err = float(np.mean((pred - y[:head]) ** 2))
@@ -192,11 +204,38 @@ def main(argv=None):
         "tpu_cost_constants.json, the commit-and-ship workflow)",
     )
     parser.add_argument("--reg", type=float, default=1e-3)
+    parser.add_argument(
+        "--grid", choices=("all", "dense", "sparse"), default="all",
+        help="measure only the dense or sparse subset of the preset grid "
+        "(the sparse solver is host-side, so its rows can be re-measured "
+        "on CPU without re-claiming the TPU for the dense rows)",
+    )
+    parser.add_argument(
+        "--merge-csv", default=None,
+        help="CSV of previously measured rows to merge in before writing/"
+        "fitting; freshly measured rows win on (solver, n, d, k, sparsity)",
+    )
+    parser.add_argument(
+        "--fitted-on", default=None,
+        help="override the fitted_on provenance string (e.g. when dense "
+        "rows came from a TPU run and sparse rows from the host)",
+    )
     args = parser.parse_args(argv)
 
     import jax
 
+    # JAX_PLATFORMS=cpu alone is NOT enough here: the session's
+    # sitecustomize pre-registers the axon TPU platform at interpreter
+    # start, so a "CPU" sweep would silently run (and contend) on the
+    # chip. Mirror tests/conftest.py: force the platform post-import too.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     grid = QUICK_GRID if args.preset == "quick" else FULL_GRID
+    if args.grid == "dense":
+        grid = [g for g in grid if g[3] >= 1.0]
+    elif args.grid == "sparse":
+        grid = [g for g in grid if g[3] < 1.0]
     num_machines = len(jax.devices())
     rows = []
     for n, d, k, sparsity in grid:
@@ -211,6 +250,18 @@ def main(argv=None):
                 }
             )
             print(rows[-1], flush=True)
+
+    if args.merge_csv:
+        fresh = {(r["solver"], r["n"], r["d"], r["k"], r["sparsity"]) for r in rows}
+        with open(args.merge_csv) as f:
+            for r in csv.DictReader(f):
+                r = {
+                    "solver": r["solver"], "n": int(r["n"]), "d": int(r["d"]),
+                    "k": int(r["k"]), "sparsity": float(r["sparsity"]),
+                    "ms": float(r["ms"]), "train_mse": float(r["train_mse"]),
+                }
+                if (r["solver"], r["n"], r["d"], r["k"], r["sparsity"]) not in fresh:
+                    rows.append(r)
 
     with open(args.out, "w", newline="") as f:
         writer = csv.DictWriter(f, fieldnames=list(rows[0].keys()))
@@ -266,7 +317,8 @@ def main(argv=None):
                 "cpu": float(w[0]),
                 "mem": float(w[1]),
                 "network": float(w[2]),
-                "fitted_on": getattr(jax.devices()[0], "device_kind", "unknown"),
+                "fitted_on": args.fitted_on
+                or getattr(jax.devices()[0], "device_kind", "unknown"),
                 "preset": args.preset,
                 "fit_residual_ms": float(residual),
             }

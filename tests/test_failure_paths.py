@@ -198,3 +198,17 @@ def test_solver_precision_env_knob():
         capture_output=True, text=True, timeout=120,
     )
     assert bad.returncode != 0 and "KEYSTONE_SOLVER_PRECISION" in bad.stderr
+    # Unset → the shipped default: refine mode for the exact solver,
+    # HIGHEST for every other solver-grade matmul.
+    env = {k: v for k, v in __import__("os").environ.items()
+           if k != "KEYSTONE_SOLVER_PRECISION"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import os; os.environ['JAX_PLATFORMS']='cpu';"
+         "from keystone_tpu.parallel import linalg;"
+         "print(linalg.solver_mode(), linalg.PRECISION)"],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert "refine" in out.stdout and "HIGHEST" in out.stdout, (
+        out.stdout, out.stderr,
+    )
