@@ -519,10 +519,17 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
     pca_components = timed("pca_fit_ms", jax.jit(lambda f: compute_pca(f, desc_dim)), flat)
     reduced = (flat @ pca_components).reshape(n_img, -1, desc_dim)
 
+    # Estimator fits are cold-timed (includes XLA compile — honest for a
+    # first-ever run); the _warm_ms re-run is the steady-state cost a
+    # user with a warm persistent compilation cache pays.
     gmm_est = GaussianMixtureModelEstimator(vocab, max_iterations=25, seed=0)
+    gmm_data = ArrayDataset(np.asarray(reduced.reshape(-1, desc_dim)))
     t0 = time.perf_counter()
-    gmm = gmm_est.fit(ArrayDataset(np.asarray(reduced.reshape(-1, desc_dim))))
+    gmm = gmm_est.fit(gmm_data)
     stages["gmm_fit_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    t0 = time.perf_counter()
+    gmm = gmm_est.fit(gmm_data)
+    stages["gmm_fit_warm_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
 
     fv = FisherVector(gmm)
     norm = NormalizeRows()
@@ -545,6 +552,10 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
     model = est.fit(ArrayDataset(xs), ArrayDataset(jnp.asarray(ys)))
     force(model.weights)
     stages["solve_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
+    t0 = time.perf_counter()
+    model = est.fit(ArrayDataset(xs), ArrayDataset(jnp.asarray(ys)))
+    force(model.weights)
+    stages["solve_warm_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
 
     stages["sift_images_per_sec"] = round(n_img / max(stages["sift_ms"], 1e-6) * 1000.0, 1)
     stages["num_images"] = n_img
@@ -665,6 +676,14 @@ WORKLOADS = tuple(_workload_registry())
 def child_main(small: bool, workload: str | None = None) -> int:
     import jax
 
+    # The framework's shipped default: compiled programs persist across
+    # processes, so a workload's second-ever run skips XLA compilation.
+    # Reported in the JSON so a reader knows whether compile-heavy stages
+    # could have hit a warm cache.
+    from keystone_tpu.utils.compilation_cache import enable_persistent_cache
+
+    cache_dir = enable_persistent_cache()
+
     t_init = time.time()
     devices = jax.devices()
     platform = devices[0].platform
@@ -673,6 +692,7 @@ def child_main(small: bool, workload: str | None = None) -> int:
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "backend_init_s": round(time.time() - t_init, 1),
         "small_shapes": small,
+        "compilation_cache": cache_dir,
     }
 
     workloads = _workload_registry()
@@ -775,7 +795,8 @@ def main() -> int:
             if wreport is None:
                 merged[name] = {"error": err[:500]}
             else:
-                for key in ("platform", "device_kind", "backend_init_s", "small_shapes"):
+                for key in ("platform", "device_kind", "backend_init_s",
+                            "small_shapes", "compilation_cache"):
                     merged.setdefault(key, wreport.get(key))
                 merged[name] = wreport.get(name, {"error": "missing from child"})
         time.sleep(5)

@@ -220,6 +220,13 @@ def main(argv: Optional[list] = None) -> int:
             print(f"{name:28s} {entry[-1]}")
         return 0
 
+    # Warm repeat runs: compiled XLA programs persist across processes
+    # (KEYSTONE_COMPILATION_CACHE=off to disable). Enabled only on the
+    # workload path so --list / --help stay jax-free.
+    from .utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     config_cls, run_fn = resolved[args.workload]
     config = build_config(config_cls, args)
     results = run_fn(config)

@@ -134,16 +134,17 @@ def _gram_fn(mesh: Mesh):
     return jax.jit(shard_map(f, mesh=mesh, in_specs=P(axes, None), out_specs=P()))
 
 
-def _gram2_raw(mesh: Mesh, precision: Optional[lax.Precision] = None):
-    """Un-jitted shard_map computing (AᵀA, AᵀB) with one psum each — the
-    shared kernel under gram(), normal_equations_solve and the fused
-    centered solve (one definition, three jit contexts)."""
+def _gram2_raw(mesh: Mesh):
+    """Un-jitted shard_map computing (AᵀA, AᵀB) with one psum each at the
+    solver precision — the shared kernel under gram() and
+    normal_equations_solve. (The fused centered solve keeps its own
+    variant: it also needs column sums in the same pass and a per-mode
+    Gram precision.)"""
     axes = row_axes(mesh)
-    prec = PRECISION if precision is None else precision
 
     def f2(a_local, b_local):
-        ata = lax.psum(jnp.matmul(a_local.T, a_local, precision=prec), axes)
-        atb = lax.psum(jnp.matmul(a_local.T, b_local, precision=prec), axes)
+        ata = lax.psum(mm(a_local.T, a_local), axes)
+        atb = lax.psum(mm(a_local.T, b_local), axes)
         return ata, atb
 
     return shard_map(

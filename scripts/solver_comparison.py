@@ -247,6 +247,10 @@ def main(argv=None):
                     "solver": name, "n": n, "d": d, "k": k,
                     "sparsity": sparsity, "ms": round(ms, 2),
                     "train_mse": round(err, 6),
+                    # Per-row so merged rows from another device keep the
+                    # device count they were measured with (the cost fit
+                    # divides flops/elems by it).
+                    "machines": num_machines,
                 }
             )
             print(rows[-1], flush=True)
@@ -259,6 +263,7 @@ def main(argv=None):
                     "solver": r["solver"], "n": int(r["n"]), "d": int(r["d"]),
                     "k": int(r["k"]), "sparsity": float(r["sparsity"]),
                     "ms": float(r["ms"]), "train_mse": float(r["train_mse"]),
+                    "machines": int(r.get("machines") or num_machines),
                 }
                 if (r["solver"], r["n"], r["d"], r["k"], r["sparsity"]) not in fresh:
                     rows.append(r)
@@ -281,7 +286,8 @@ def main(argv=None):
         for r in rows:
             feats.append(
                 cost_features(
-                    r["solver"], r["n"], r["d"], r["k"], r["sparsity"], num_machines
+                    r["solver"], r["n"], r["d"], r["k"], r["sparsity"],
+                    r.get("machines", num_machines),
                 )
             )
             times.append(r["ms"])

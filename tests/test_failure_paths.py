@@ -212,3 +212,33 @@ def test_solver_precision_env_knob():
     assert "refine" in out.stdout and "HIGHEST" in out.stdout, (
         out.stdout, out.stderr,
     )
+
+
+def test_persistent_compilation_cache_knob(tmp_path, monkeypatch):
+    """enable_persistent_cache honors the env knob: off disables, a path
+    selects the dir, and the dir is created + registered with jax."""
+    import jax
+
+    from keystone_tpu.utils.compilation_cache import enable_persistent_cache
+
+    saved = (
+        jax.config.jax_compilation_cache_dir,
+        jax.config.jax_persistent_cache_min_entry_size_bytes,
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+    )
+    try:
+        monkeypatch.setenv("KEYSTONE_COMPILATION_CACHE", "off")
+        assert enable_persistent_cache() is None
+
+        target = str(tmp_path / "xla-cache")
+        monkeypatch.setenv("KEYSTONE_COMPILATION_CACHE", target)
+        got = enable_persistent_cache()
+        assert got == target
+        import os as _os
+
+        assert _os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+    finally:  # global jax config: restore so later tests don't write a cache
+        jax.config.update("jax_compilation_cache_dir", saved[0])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", saved[1])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", saved[2])
