@@ -132,7 +132,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 and linalg.model_axis_size(mesh) == 1
             )
         if stream:
-            reg = self.reg if self.reg > 0 else 1e-6
+            reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(
+                np.asarray(raw[: min(features.num_examples, 4096)]),
+                features.num_examples,
+            )
             w, mu_a, mu_b = linalg.block_coordinate_descent_streaming(
                 np.asarray(raw),
                 np.asarray(targets.data, np.float32),
@@ -168,7 +171,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         if d_pad != d:
             xc = jnp.pad(xc, ((0, 0), (0, d_pad - d)))
 
-        reg = self.reg if self.reg > 0 else 1e-6  # keep padded blocks PD
+        reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(xc, n)
         if m > 1:
             xc = linalg.prepare_block_sharded(xc, mesh)
             yc = linalg.prepare_block_sharded(yc, mesh, fine_rows=True)
@@ -184,6 +187,27 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a
         )
+
+
+def _scale_aware_reg_floor(x_sample, n: int) -> float:
+    """λ floor for an unregularized BCD solve: 1e-6 of the mean Gram
+    diagonal (≈ 1e-6·n·E[x²]).
+
+    An ABSOLUTE 1e-6 floor is invisible next to Gram entries of O(n): a
+    rank-deficient block (more features than examples) then has condition
+    ~n·E[x²]/1e-6 ≫ fp32's Cholesky limit and the factor silently emits
+    NaNs — the model degrades to chance with no error raised. Relative to
+    the data scale, the floor keeps the factor finite while acting as a
+    minimum-norm tiebreak on the interpolating solution. ``x_sample`` may
+    be a row subset; only E[x²] is needed.
+    """
+    xs = jnp.asarray(x_sample, jnp.float32)
+    # The solvers fit CENTERED data; an uncentered sample with a large
+    # mean would overshoot the centered Gram scale by orders of
+    # magnitude. (Already-centered input makes this a no-op.)
+    xs = xs - jnp.mean(xs, axis=0, keepdims=True)
+    mean_sq = float(jnp.mean(jnp.square(xs)))
+    return max(1e-6 * n * mean_sq, 1e-6)
 
 
 def _round_up(x: int, m: int) -> int:

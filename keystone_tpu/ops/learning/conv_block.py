@@ -202,7 +202,21 @@ class ConvBlockLeastSquaresEstimator(LabelEstimator):
         step = _conv_bcd_step_fn(
             mesh, fz, chunk, self.standardize, fpf, fb, px, py
         )
-        reg = jnp.float32(self.reg if self.reg > 0 else 1e-6)
+        if self.reg > 0:
+            reg = jnp.float32(self.reg)
+        elif self.standardize:
+            # Standardized blocks have Gram diagonal ≈ n (unit variance):
+            # floor λ relative to that scale so a rank-deficient block
+            # stays fp32-Cholesky-finite (an absolute 1e-6 floor leaves
+            # condition ~n/1e-6 and silent NaNs — see block.py's
+            # _scale_aware_reg_floor for the full story).
+            reg = jnp.float32(max(1e-6 * n, 1e-6))
+        else:
+            probe = self.featurizer.apply_arrays(images[: min(n, 256)])
+            probe = probe - jnp.mean(probe, axis=0, keepdims=True)
+            reg = jnp.float32(
+                max(1e-6 * n * float(jnp.mean(jnp.square(probe))), 1e-6)
+            )
         n_f = jnp.float32(n)
         bs = fpf * fb
         w_blocks = [jnp.zeros((bs, k), jnp.float32) for _ in range(nb)]

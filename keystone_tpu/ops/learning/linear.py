@@ -91,6 +91,8 @@ class LinearMapEstimator(LabelEstimator):
             x, y, n, self.reg or 0.0, mesh=mesh,
             gram_precision=gram_precision, refine_steps=refine_steps,
         )
+        if not self.reg:  # singular-risk case only: fail loudly, not NaN
+            linalg.check_finite(w, "LinearMapEstimator (reg=0)")
         return LinearMapper(w, intercept=mu_b, feature_mean=mu_a)
 
 

@@ -274,6 +274,25 @@ def centered_solve_refined(
     return fn(x, y, jnp.float32(n), jnp.float32(reg))
 
 
+def check_finite(w: jnp.ndarray, context: str) -> None:
+    """Raise loudly when a solve produced non-finite weights.
+
+    An unregularized normal-equations solve of a rank-deficient system
+    makes Cholesky emit NaNs that silently flow into garbage predictions
+    (chance-level error with no hint why). The reference failed loudly
+    here (Breeze cholesky throws NotSymmetricPositiveDefinite); match
+    that. Callers gate this on reg==0 — the only singular-risk case — so
+    regularized fits pay no extra device round trip.
+    """
+    if not bool(jnp.isfinite(jnp.sum(w))):
+        raise FloatingPointError(
+            f"{context}: solution contains non-finite values — the normal "
+            "equations are singular (more features than examples, or "
+            "linearly dependent features) and no regularization was "
+            "applied. Pass reg > 0."
+        )
+
+
 def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg=0.0) -> jnp.ndarray:
     """Solve (AᵀA + reg·I) x = Aᵀb by Cholesky (the reference's local solve).
 

@@ -255,6 +255,11 @@ def run_native_resolution(config: ImageNetSiftLcsFVConfig) -> dict:
     from ..data.buckets import bucket_labels, bucketize_dataset, to_bucketed_dataset
 
     start = time.time()
+    if not config.train_location or not config.label_path:
+        raise ValueError(
+            "imagenet workloads need --train-location (tar-of-JPEGs) and "
+            "--label-path (reference: ImageNetSiftLcsFV.scala:75-141)"
+        )
     ds = load_imagenet(config.train_location, config.label_path, resize=None)
     buckets = bucketize_dataset(ds, granularity=32)
     train_buckets = to_bucketed_dataset(buckets)
@@ -292,6 +297,11 @@ def run(config: ImageNetSiftLcsFVConfig) -> dict:
     """End-to-end train + evaluate
     (reference: ImageNetSiftLcsFV.scala:75-146)."""
     start = time.time()
+    if not config.train_location or not config.label_path:
+        raise ValueError(
+            "imagenet workloads need --train-location (tar-of-JPEGs) and "
+            "--label-path (reference: ImageNetSiftLcsFV.scala:75-141)"
+        )
     parsed = load_imagenet(
         config.train_location, config.label_path, resize=config.image_size
     ).to_arrays()

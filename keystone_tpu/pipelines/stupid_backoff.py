@@ -40,10 +40,30 @@ def fit_language_model(lines, n: int = 3) -> StupidBackoffModel:
     return StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
 
 
+def _synthetic_corpus(num_lines: int = 2000, seed: int = 0) -> list:
+    """Zipf-sampled sentences over a small vocabulary — the repo's
+    no-data-provided convention (like mnist_random_fft's synthetic path)
+    so the workload runs end-to-end out of the box."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(500)]
+    ranks = np.arange(1, len(vocab) + 1, dtype=np.float64)
+    p = (1.0 / ranks) / np.sum(1.0 / ranks)
+    return [
+        " ".join(rng.choice(vocab, size=rng.integers(4, 12), p=p))
+        for _ in range(num_lines)
+    ]
+
+
 def run(config: StupidBackoffConfig) -> dict:
     start = time.time()
-    with open(config.train_data) as f:
-        lines = [l for l in f.read().splitlines() if l.strip()]
+    if config.train_data:
+        with open(config.train_data) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+    else:
+        logger.info("no --train-data given: using a synthetic Zipf corpus")
+        lines = _synthetic_corpus()
     model = fit_language_model(lines, config.n)
     logger.info(
         "number of tokens: %d | vocab: %d | ngrams: %d",

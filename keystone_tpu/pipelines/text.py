@@ -81,6 +81,11 @@ def build_newsgroups(config: NewsgroupsConfig, train: TextLabeledData) -> Pipeli
 
 def run_amazon(config: AmazonReviewsConfig) -> dict:
     start = time.time()
+    if not config.train_location:
+        raise ValueError(
+            "amazon-reviews needs --train-location pointing at the Amazon "
+            "reviews JSON (reference: AmazonReviewsPipeline.scala)"
+        )
     train = load_amazon_reviews(config.train_location, config.threshold)
     pipeline = build_amazon(config, train)
     results = {"pipeline": pipeline}
@@ -96,6 +101,11 @@ def run_amazon(config: AmazonReviewsConfig) -> dict:
 
 def run_newsgroups(config: NewsgroupsConfig) -> dict:
     start = time.time()
+    if not config.train_location:
+        raise ValueError(
+            "newsgroups needs --train-location pointing at the 20news "
+            "directory tree (reference: NewsgroupsPipeline.scala)"
+        )
     train = load_newsgroups(config.train_location)
     pipeline = build_newsgroups(config, train)
     results = {"pipeline": pipeline}

@@ -139,6 +139,11 @@ def run(config: SIFTFisherConfig) -> dict:
     """End-to-end train + evaluate
     (reference: VOCSIFTFisher.scala:24-105)."""
     start = time.time()
+    if not config.train_location or not config.label_path:
+        raise ValueError(
+            "voc-sift-fisher needs --train-location (VOC 2007 image tar) "
+            "and --label-path (see examples/images/voc_sift_fisher.sh)"
+        )
     parsed = load_voc(
         config.train_location, config.label_path, resize=config.image_size
     )

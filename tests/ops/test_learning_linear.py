@@ -135,3 +135,35 @@ def test_solver_mode_rejects_typos(monkeypatch):
     monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "fastest")
     with pytest.raises(ValueError, match="KEYSTONE_SOLVER_PRECISION"):
         linalg.solver_mode()
+
+
+def test_block_solver_underdetermined_without_reg_still_learns():
+    """More features than examples with reg=0: the scale-aware λ floor
+    must keep the rank-deficient block solve finite (an absolute 1e-6
+    floor left fp32 Cholesky emitting silent NaNs → chance-level error,
+    the round-3 synthetic-TIMIT bug)."""
+    rng = np.random.default_rng(11)
+    n, d, k = 128, 512, 4  # d > n → every 256-wide block is singular
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=(d, k)).astype(np.float32)
+    y = x @ w_true
+    model = BlockLeastSquaresEstimator(256, num_iter=3, reg=0.0).fit(
+        ArrayDataset(x), ArrayDataset(y)
+    )
+    pred = np.asarray(model.apply_arrays(x))
+    assert np.isfinite(pred).all()
+    # interpolating regime: the minimum-norm-ish solution fits train well
+    rel = np.linalg.norm(pred - y) / np.linalg.norm(y)
+    assert rel < 0.05, rel
+
+
+def test_exact_solver_singular_without_reg_raises():
+    """reg=None on a singular system must fail loudly (the reference's
+    Breeze Cholesky threw), not silently return NaN weights."""
+    import pytest
+
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(32, 64)).astype(np.float32)  # rank < d
+    y = rng.normal(size=(32, 3)).astype(np.float32)
+    with pytest.raises(FloatingPointError, match="singular"):
+        LinearMapEstimator().fit(ArrayDataset(x), ArrayDataset(y))
