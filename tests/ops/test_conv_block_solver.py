@@ -104,3 +104,28 @@ def test_conv_block_solver_learns():
         pred = np.asarray(model.apply_arrays(jnp.asarray(images)))
     acc = (pred.argmax(axis=1) == cls).mean()
     assert acc > 0.8, acc
+
+
+@pytest.mark.parametrize("standardize", [True, False])
+def test_conv_block_solver_reg0_rank_deficient_stays_finite(standardize):
+    """reg=0 with more features per block than examples: the scale-aware
+    λ floor (standardize→n; else probe featurization) must keep the
+    rank-deficient block Cholesky finite — the absolute 1e-6 floor
+    silently emitted NaNs here."""
+    fz = _featurizer(16, seed=4)
+    rng = np.random.default_rng(5)
+    n = 8  # features per block (32) > examples
+    images = rng.random((n, 32, 32, 3)).astype(np.float32)
+    y = rng.normal(size=(n, 2)).astype(np.float32)
+
+    mesh = make_mesh(devices=jax.devices()[:8])
+    with use_mesh(mesh):
+        est = ConvBlockLeastSquaresEstimator(
+            fz, block_size=32, num_iter=2, reg=0.0,
+            standardize=standardize, image_chunk=4,
+        )
+        model = est.fit(ArrayDataset(images), ArrayDataset(y))
+        pred = np.asarray(model.apply_arrays(jnp.asarray(images)))
+    assert np.isfinite(pred).all()
+    rel = np.linalg.norm(pred - y) / np.linalg.norm(y)
+    assert rel < 0.2, rel  # interpolating regime: fits train closely
