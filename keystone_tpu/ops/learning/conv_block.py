@@ -85,10 +85,11 @@ class ConvBlockLeastSquaresEstimator(LabelEstimator):
     rematerialization (featurize → standardize → BCD as one machine).
 
     Equivalent to the pipeline ``FusedConvFeaturizer → StandardScaler →
-    BlockLeastSquaresEstimator(block_size, num_iter, reg)`` (both floor
-    reg=0 to 1e-6 to keep the per-block solves PD; the block update
-    order here is filter-major rather than column-contiguous, same fixed
-    point) but the full feature matrix never exists; each epoch
+    BlockLeastSquaresEstimator(block_size, num_iter, reg)`` (both apply
+    a scale-aware λ floor when reg=0 to keep the per-block solves PD;
+    the block update order here is filter-major rather than
+    column-contiguous, same fixed point) but the full feature matrix
+    never exists; each epoch
     refeaturizes every filter block once. ``block_size`` must correspond
     to a whole number of filters (block_size divisible by the per-filter
     feature count — pool_x·pool_y·2 for the symmetric rectifier).
