@@ -738,7 +738,7 @@ def _run_child(
     return None, f"child rc={proc.returncode}, no JSON. tail: {tail}"
 
 
-def _probe_backend(env: dict, timeout_s: float = 300) -> tuple[bool, str]:
+def _probe_backend(env: dict, timeout_s: float = 120) -> tuple[bool, str]:
     """Cheap check that the default backend initializes at all — a hung
     TPU tunnel would otherwise consume the full benchmark timeout twice."""
     code = "import jax; d = jax.devices(); print('PROBE_OK', d[0].platform, len(d))"
@@ -787,6 +787,17 @@ def main() -> int:
             diagnostics.append(f"probe {attempt + 1}: {info}")
             time.sleep(10)
             continue
+        # Platform token of the PROBE_OK line itself (stdout may carry
+        # init noise; the success check above tolerates it, so must we).
+        probe_platform = info.split("PROBE_OK", 1)[1].split()[0] if "PROBE_OK" in info else ""
+        if probe_platform == "cpu":
+            # Default backend IS the host CPU (no accelerator attached):
+            # full-size shapes would crawl through every per-workload
+            # timeout. Stop probing; with no successful workload the
+            # small-shapes CPU leg below takes over (after a PARTIAL
+            # accelerator success the partial results stand instead).
+            diagnostics.append(f"probe {attempt + 1}: cpu backend ({info})")
+            break
         for name in todo:
             wreport, err = _run_child(
                 dict(os.environ), small=False,
