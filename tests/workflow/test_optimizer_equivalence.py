@@ -1,9 +1,12 @@
 """Randomized optimizer-equivalence invariant: for any randomly composed
-pipeline, executing through the optimizer stack (CSE, dead-branch prune,
-saved-state reuse, node optimization) must produce exactly the results of
-the same computation composed by hand. The reference asserted this shape
-of contract piecewise across its workflow suites; random composition
-covers the interaction space those point tests can't.
+pipeline, executing through the optimizer stack must produce exactly the
+results of the same computation composed by hand. Instrumented coverage:
+CSE fires heavily on the shared structures; the saved-state path fires on
+the second (no-reset) execution of each trial. (Dead-branch pruning has
+its own point tests in test_rules.py — the generator here builds no
+unused limbs.) The reference asserted this contract piecewise across its
+workflow suites; random composition covers rule interactions those point
+tests can't.
 """
 
 import numpy as np
@@ -70,6 +73,12 @@ def test_randomized_optimizer_equivalence():
         expect = reference(xs)
         np.testing.assert_allclose(got, expect, rtol=1e-6, atol=1e-6,
                                    err_msg=f"trial {trial}, ops={ops}")
+        # Second execution WITHOUT resetting PipelineEnv: the saved-state
+        # load rule now splices stored estimator/cacher results back in —
+        # values must be unchanged by that reuse path.
+        again = pipe(ObjectDataset(list(xs))).get().collect()
+        np.testing.assert_allclose(again, expect, rtol=1e-6, atol=1e-6,
+                                   err_msg=f"trial {trial} (reuse), ops={ops}")
 
 
 def test_equivalence_with_explicit_shared_branches_and_gather():
