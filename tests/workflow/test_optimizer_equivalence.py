@@ -93,3 +93,49 @@ def test_equivalence_with_explicit_shared_branches_and_gather():
     got = gathered(ObjectDataset(list(xs))).get().collect()
     expect = [[2 * x + 1 + 5, 3 * (2 * x + 1)] for x in xs]
     np.testing.assert_allclose(got, expect)
+
+
+def test_equivalence_under_auto_caching_optimizer():
+    """The auto-caching optimizer (profiling + Cacher insertion) must be
+    value-neutral: same random pipelines, same results."""
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.rules import auto_caching_optimizer
+
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        PipelineEnv.reset()
+        PipelineEnv.get_or_create().optimizer = auto_caching_optimizer()
+        try:
+            xs = [float(v) for v in rng.integers(-5, 6, size=6)]
+            fit_xs = [float(v) for v in rng.integers(-5, 6, size=5)]
+            data = ObjectDataset(list(fit_xs))
+            depth = int(rng.integers(2, 6))
+
+            ops = []
+            pipe = None
+            for i in range(depth):
+                kind = int(rng.integers(0, 2))
+                if kind == 0 or pipe is None:
+                    a, b = float(rng.integers(1, 4)), float(rng.integers(-3, 4))
+                    t = Affine(a, b)
+                    pipe = t.to_pipeline() if pipe is None else pipe.then(t)
+                    ops.append(("affine", a, b))
+                else:
+                    pipe = pipe.then_estimator(MeanShift(), data)
+                    ops.append(("meanshift", i))
+
+            def reference(values, upto=len(ops)):
+                vals = list(values)
+                for j, op in enumerate(ops[:upto]):
+                    if op[0] == "affine":
+                        vals = [op[1] * v + op[2] for v in vals]
+                    else:
+                        mean = float(np.mean(reference(fit_xs, j)))
+                        vals = [v + mean for v in vals]
+                return vals
+
+            got = pipe(ObjectDataset(list(xs))).get().collect()
+            np.testing.assert_allclose(got, reference(xs), rtol=1e-6,
+                                       atol=1e-6, err_msg=f"trial {trial}")
+        finally:
+            PipelineEnv.reset()
