@@ -242,3 +242,73 @@ def test_persistent_compilation_cache_knob(tmp_path, monkeypatch):
         jax.config.update("jax_compilation_cache_dir", saved[0])
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", saved[1])
         jax.config.update("jax_persistent_cache_min_compile_time_secs", saved[2])
+
+
+def _fake_child_factory(platform, fail_workloads=()):
+    def fake_run_child(env, small, timeout_s, workload=None):
+        import bench
+
+        if workload in fail_workloads:
+            return None, "boom"
+        name = workload or "timit_exact"
+        report = {
+            "platform": platform, "device_kind": platform,
+            "backend_init_s": 0.0, "small_shapes": small,
+            "compilation_cache": None,
+            name: {"fit_ms": 1.0, "wall_s": 0.1},
+        }
+        if workload is None:  # small-shapes fallback child: all workloads
+            for w in bench.WORKLOADS:
+                report[w] = {"fit_ms": 1.0, "wall_s": 0.1}
+        return report, ""
+    return fake_run_child
+
+
+def test_bench_parent_cpu_probe_short_circuits(monkeypatch, capsys):
+    """A cpu default backend must skip the full-size attempts and land on
+    the small-shapes leg (full TIMIT shapes would crawl on a host CPU)."""
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK cpu 8"))
+    monkeypatch.setattr(bench, "_run_child", _fake_child_factory("cpu"))
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["small_shapes"] is True
+    assert any("cpu backend" in d for d in out.get("diagnostics", []))
+
+
+def test_bench_parent_hung_probe_falls_back(monkeypatch, capsys):
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (False, "backend probe hung >120s"))
+    monkeypatch.setattr(bench, "_run_child", _fake_child_factory("cpu"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["small_shapes"] is True
+    assert sum("hung" in d for d in out["diagnostics"]) == 2
+
+
+def test_bench_parent_tpu_runs_full_and_extra_legs(monkeypatch, capsys):
+    """Healthy accelerator probe: every workload child runs full-size and
+    the two TIMIT precision comparison legs are appended."""
+    import json
+
+    import bench
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
+    monkeypatch.setattr(bench, "_run_child", _fake_child_factory("tpu"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out.get("small_shapes") is False
+    for leg in ("timit_exact_highest", "timit_exact_fastmode"):
+        assert leg in out, sorted(out)
+    assert out["workloads_with_errors"] == []
