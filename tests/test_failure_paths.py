@@ -312,3 +312,60 @@ def test_bench_parent_tpu_runs_full_and_extra_legs(monkeypatch, capsys):
     for leg in ("timit_exact_highest", "timit_exact_fastmode"):
         assert leg in out, sorted(out)
     assert out["workloads_with_errors"] == []
+
+
+def test_bench_parent_retries_only_failed_workloads(monkeypatch, capsys):
+    """Attempt 2 re-runs ONLY workloads that errored on attempt 1 (the
+    flaky-tunnel second chance), and surviving errors are recorded."""
+    import json
+
+    import bench
+
+    calls = []
+    inner = _fake_child_factory("tpu")
+
+    def failing_once(env, small, timeout_s, workload=None):
+        calls.append(workload)
+        if workload == "gram_mfu" and calls.count("gram_mfu") == 1:
+            return None, "boom"
+        if workload == "cifar_random_patch":
+            return None, "always down"
+        return inner(env, small, timeout_s, workload)
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
+    monkeypatch.setattr(bench, "_run_child", failing_once)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # gram_mfu recovered on attempt 2; cifar stayed an error
+    assert calls.count("gram_mfu") == 2
+    assert calls.count("timit_exact") == 1 + 2  # attempt 1 + 2 extra legs
+    assert out["workloads_with_errors"] == ["cifar_random_patch"]
+    assert "error" not in out["gram_mfu"]
+
+
+def test_bench_extra_legs_set_precision_modes(monkeypatch, capsys):
+    """The comparison legs must actually flip KEYSTONE_SOLVER_PRECISION
+    (highest, then default) in the child environment."""
+    import json
+
+    import bench
+
+    modes = []
+    inner = _fake_child_factory("tpu")
+
+    def recording(env, small, timeout_s, workload=None):
+        modes.append(env.get("KEYSTONE_SOLVER_PRECISION"))
+        return inner(env, small, timeout_s, workload)
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
+    monkeypatch.setattr(bench, "_run_child", recording)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    assert bench.main() == 0
+    json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # the two extra legs are the only calls that set the knob
+    assert modes.count("highest") == 1 and modes.count("default") == 1
+    assert modes[-2:] == ["highest", "default"]
