@@ -51,11 +51,22 @@ def _to_opencv_layout(desc: np.ndarray) -> np.ndarray:
     return np.roll(d, ORIENT_ROLL, axis=-1).reshape(-1, 128)
 
 
-@pytest.mark.parametrize("seed", [42, 7])
-def test_sift_matches_opencv_fixture(seed):
-    fixture = np.loadtxt(
+def _load_fixture(seed: int) -> np.ndarray:
+    return np.loadtxt(
         os.path.join(FIXTURE_DIR, f"opencv_dsift_seed{seed}.csv"), delimiter=","
     ).astype(np.float32)
+
+
+def _cosines_vs_fixture(desc: np.ndarray, fixture: np.ndarray) -> np.ndarray:
+    mapped = _to_opencv_layout(desc)
+    na = np.linalg.norm(mapped, axis=1) + 1e-9
+    nb = np.linalg.norm(fixture, axis=1) + 1e-9
+    return (mapped * fixture).sum(axis=1) / (na * nb)
+
+
+@pytest.mark.parametrize("seed", [42, 7])
+def test_sift_matches_opencv_fixture(seed):
+    fixture = _load_fixture(seed)
 
     img = _make_image(seed)
     # The fixture image is [0,1]·255-quantized before OpenCV sees it;
@@ -65,10 +76,7 @@ def test_sift_matches_opencv_fixture(seed):
     ours = np.asarray(ext.apply_arrays(jnp.asarray(img_q[None])))[0]
     assert ours.shape == fixture.shape
 
-    mapped = _to_opencv_layout(ours)
-    na = np.linalg.norm(mapped, axis=1) + 1e-9
-    nb = np.linalg.norm(fixture, axis=1) + 1e-9
-    cos = (mapped * fixture).sum(axis=1) / (na * nb)
+    cos = _cosines_vs_fixture(ours, fixture)
 
     # A wrong axis order / orientation roll drops mean cosine below ~0.75
     # (probed); correct implementation sits near 0.98.
@@ -81,9 +89,7 @@ def test_convention_map_is_the_best_one():
     candidate maps — guards against the map silently compensating for a
     future axis bug in the extractor."""
     seed = 42
-    fixture = np.loadtxt(
-        os.path.join(FIXTURE_DIR, f"opencv_dsift_seed{seed}.csv"), delimiter=","
-    ).astype(np.float32)
+    fixture = _load_fixture(seed)
     img = _make_image(seed)
     img_q = (img * 255).astype(np.uint8).astype(np.float32) / 255.0
     ext = SIFTExtractor(step_size=STEP, bin_size=BIN_SIZE, scales=1, scale_step=1)
@@ -109,3 +115,51 @@ def test_convention_map_is_the_best_one():
         f"best map {best} (cos {scores[best]:.3f}) != committed "
         f"({SWAP_XY}, False, {ORIENT_ROLL}) (cos {scores[(SWAP_XY, False, ORIENT_ROLL)]:.3f})"
     )
+
+
+def test_bf16_binning_passes_the_reference_tolerance():
+    """bf16 spatial binning (docs/NEXT_LEVERS.md item 3) must hold the
+    reference's own acceptance gate vs the fp32 build: 99.5% of
+    x512-quantized entries within 1 (VLFeatSuite.scala:47-52), plus the
+    OpenCV-fixture cosine gate. (Full-pyramid bf16 was measured FAILING
+    this gate at 97.5% — the smoother feeds a gradient stencil that
+    amplifies rounding — which is why only the binning conv has a dtype
+    knob.)"""
+    img = _make_image(42)
+    img_q = (img * 255).astype(np.uint8).astype(np.float32) / 255.0
+    batch = jnp.asarray(img_q[None])
+
+    f32 = np.asarray(
+        SIFTExtractor(step_size=STEP, bin_size=BIN_SIZE, scales=1).apply_arrays(batch)
+    )[0]
+    b16 = np.asarray(
+        SIFTExtractor(
+            step_size=STEP, bin_size=BIN_SIZE, scales=1,
+            binning_dtype=jnp.bfloat16,
+        ).apply_arrays(batch)
+    )[0]
+    close = np.abs(b16.astype(np.float64) - f32.astype(np.float64)) <= 1.0
+    assert close.mean() > 0.995, f"within-1 fraction {close.mean():.4f}"
+
+    cos = _cosines_vs_fixture(b16, _load_fixture(42))
+    assert cos.mean() > 0.95, f"mean cosine {cos.mean():.3f}"
+
+
+def test_bf16_binning_masked_path_matches_native():
+    """The production native-resolution path (apply_arrays_masked) under
+    bf16 binning: padded-bucket descriptors must stay within-1 of the
+    SAME extractor's native-size run — the parity the imagenet_native
+    workload relies on if the default ever flips."""
+    ext = SIFTExtractor(scale_step=1, binning_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(3)
+    small, big = 40, 64
+    img = rng.random((small, small)).astype(np.float32)
+    padded = np.pad(img, ((0, big - small), (0, big - small)), mode="edge")
+    desc, valid = ext.apply_arrays_masked(
+        jnp.asarray(padded[None]), jnp.asarray([[small, small]], jnp.int32)
+    )
+    native = np.asarray(ext.apply_arrays(jnp.asarray(img[None])))
+    got = np.asarray(desc)[0][np.asarray(valid)[0]]
+    assert got.shape == native[0].shape
+    frac = (np.abs(got - native[0]) <= 1.0).mean()
+    assert frac > 0.995, frac
