@@ -754,6 +754,18 @@ def _probe_backend(env: dict, timeout_s: float = 120) -> tuple[bool, str]:
     return False, (proc.stderr or proc.stdout or "")[-500:]
 
 
+def _dump_partial(merged: dict, diagnostics: list) -> None:
+    """Crash/deadline insurance: persist progress after every completed
+    leg so an externally-killed bench still leaves an inspectable
+    artifact (the single stdout JSON line only exists if main() finishes)."""
+    try:
+        payload = {"partial": True, "diagnostics": diagnostics, **merged}
+        with open("BENCH_PARTIAL.json", "w") as f:
+            json.dump(payload, f, indent=1)
+    except OSError:
+        pass
+
+
 def main() -> int:
     diagnostics: list[str] = []
     report = None
@@ -810,6 +822,7 @@ def main() -> int:
                             "small_shapes", "compilation_cache"):
                     merged.setdefault(key, wreport.get(key))
                 merged[name] = wreport.get(name, {"error": "missing from child"})
+            _dump_partial(merged, diagnostics)
         time.sleep(5)
     # Same PRNG problem as the headline (which runs the shipped default:
     # refine = fast Gram + 2 residual corrections at HIGHEST). The extra
@@ -828,6 +841,7 @@ def main() -> int:
             leg = (wreport or {}).get("timit_exact", {"error": err[:300]})
             leg["solver_precision"] = label
             merged[key] = leg
+            _dump_partial(merged, diagnostics)
 
     if any(isinstance(merged.get(n), dict) and "error" not in merged[n] for n in WORKLOADS):
         report = merged

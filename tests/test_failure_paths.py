@@ -295,7 +295,7 @@ def test_bench_parent_hung_probe_falls_back(monkeypatch, capsys):
     assert sum("hung" in d for d in out["diagnostics"]) == 2
 
 
-def test_bench_parent_tpu_runs_full_and_extra_legs(monkeypatch, capsys):
+def test_bench_parent_tpu_runs_full_and_extra_legs(monkeypatch, capsys, tmp_path):
     """Healthy accelerator probe: every workload child runs full-size and
     the two TIMIT precision comparison legs are appended."""
     import json
@@ -306,12 +306,16 @@ def test_bench_parent_tpu_runs_full_and_extra_legs(monkeypatch, capsys):
                         lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
     monkeypatch.setattr(bench, "_run_child", _fake_child_factory("tpu"))
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.chdir(tmp_path)  # partial dump lands outside the repo
     assert bench.main() == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out.get("small_shapes") is False
     for leg in ("timit_exact_highest", "timit_exact_fastmode"):
         assert leg in out, sorted(out)
     assert out["workloads_with_errors"] == []
+    # deadline insurance: every completed leg persisted incrementally
+    partial = json.loads(open("BENCH_PARTIAL.json").read())
+    assert partial["partial"] is True and "timit_exact_fastmode" in partial
 
 
 def test_bench_parent_retries_only_failed_workloads(monkeypatch, capsys):
