@@ -754,14 +754,18 @@ def _probe_backend(env: dict, timeout_s: float = 120) -> tuple[bool, str]:
     return False, (proc.stderr or proc.stdout or "")[-500:]
 
 
-def _dump_partial(merged: dict, diagnostics: list) -> None:
+def _dump_partial(payload: dict) -> None:
     """Crash/deadline insurance: persist progress after every completed
     leg so an externally-killed bench still leaves an inspectable
-    artifact (the single stdout JSON line only exists if main() finishes)."""
+    artifact (the single stdout JSON line only exists if main() finishes).
+    Atomic replace — a kill mid-write must not destroy the previous good
+    snapshot; finalized with partial=False on a completed run so a stale
+    file can't masquerade as a later run's progress."""
     try:
-        payload = {"partial": True, "diagnostics": diagnostics, **merged}
-        with open("BENCH_PARTIAL.json", "w") as f:
+        tmp = "BENCH_PARTIAL.json.tmp"
+        with open(tmp, "w") as f:
             json.dump(payload, f, indent=1)
+        os.replace(tmp, "BENCH_PARTIAL.json")
     except OSError:
         pass
 
@@ -822,7 +826,7 @@ def main() -> int:
                             "small_shapes", "compilation_cache"):
                     merged.setdefault(key, wreport.get(key))
                 merged[name] = wreport.get(name, {"error": "missing from child"})
-            _dump_partial(merged, diagnostics)
+            _dump_partial({"partial": True, "diagnostics": diagnostics, **merged})
         time.sleep(5)
     # Same PRNG problem as the headline (which runs the shipped default:
     # refine = fast Gram + 2 residual corrections at HIGHEST). The extra
@@ -841,7 +845,7 @@ def main() -> int:
             leg = (wreport or {}).get("timit_exact", {"error": err[:300]})
             leg["solver_precision"] = label
             merged[key] = leg
-            _dump_partial(merged, diagnostics)
+            _dump_partial({"partial": True, "diagnostics": diagnostics, **merged})
 
     if any(isinstance(merged.get(n), dict) and "error" not in merged[n] for n in WORKLOADS):
         report = merged
@@ -899,6 +903,7 @@ def main() -> int:
     if diagnostics:
         result["diagnostics"] = diagnostics
     print(json.dumps(result))
+    _dump_partial({"partial": False, **result})
     return 0
 
 

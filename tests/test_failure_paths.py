@@ -264,12 +264,14 @@ def _fake_child_factory(platform, fail_workloads=()):
     return fake_run_child
 
 
-def test_bench_parent_cpu_probe_short_circuits(monkeypatch, capsys):
+def test_bench_parent_cpu_probe_short_circuits(monkeypatch, capsys, tmp_path):
     """A cpu default backend must skip the full-size attempts and land on
     the small-shapes leg (full TIMIT shapes would crawl on a host CPU)."""
     import json
 
     import bench
+
+    monkeypatch.chdir(tmp_path)
 
     monkeypatch.setattr(bench, "_probe_backend",
                         lambda env, timeout_s=120: (True, "PROBE_OK cpu 8"))
@@ -280,10 +282,12 @@ def test_bench_parent_cpu_probe_short_circuits(monkeypatch, capsys):
     assert any("cpu backend" in d for d in out.get("diagnostics", []))
 
 
-def test_bench_parent_hung_probe_falls_back(monkeypatch, capsys):
+def test_bench_parent_hung_probe_falls_back(monkeypatch, capsys, tmp_path):
     import json
 
     import bench
+
+    monkeypatch.chdir(tmp_path)
 
     monkeypatch.setattr(bench, "_probe_backend",
                         lambda env, timeout_s=120: (False, "backend probe hung >120s"))
@@ -313,17 +317,21 @@ def test_bench_parent_tpu_runs_full_and_extra_legs(monkeypatch, capsys, tmp_path
     for leg in ("timit_exact_highest", "timit_exact_fastmode"):
         assert leg in out, sorted(out)
     assert out["workloads_with_errors"] == []
-    # deadline insurance: every completed leg persisted incrementally
+    # deadline insurance: legs persist incrementally; a COMPLETED run
+    # finalizes the artifact with partial=False so a stale file can't
+    # masquerade as a later run's progress.
     partial = json.loads(open("BENCH_PARTIAL.json").read())
-    assert partial["partial"] is True and "timit_exact_fastmode" in partial
+    assert partial["partial"] is False and "timit_exact_fastmode" in partial
 
 
-def test_bench_parent_retries_only_failed_workloads(monkeypatch, capsys):
+def test_bench_parent_retries_only_failed_workloads(monkeypatch, capsys, tmp_path):
     """Attempt 2 re-runs ONLY workloads that errored on attempt 1 (the
     flaky-tunnel second chance), and surviving errors are recorded."""
     import json
 
     import bench
+
+    monkeypatch.chdir(tmp_path)
 
     calls = []
     inner = _fake_child_factory("tpu")
@@ -349,12 +357,14 @@ def test_bench_parent_retries_only_failed_workloads(monkeypatch, capsys):
     assert "error" not in out["gram_mfu"]
 
 
-def test_bench_extra_legs_set_precision_modes(monkeypatch, capsys):
+def test_bench_extra_legs_set_precision_modes(monkeypatch, capsys, tmp_path):
     """The comparison legs must actually flip KEYSTONE_SOLVER_PRECISION
     (highest, then default) in the child environment."""
     import json
 
     import bench
+
+    monkeypatch.chdir(tmp_path)
 
     modes = []
     inner = _fake_child_factory("tpu")
