@@ -39,6 +39,9 @@ a machine-readable line): the parent process runs the actual benchmark in
 a child subprocess; on backend-init failure or timeout it retries once,
 then falls back to an 8-virtual-device CPU mesh with reduced shapes and
 explicit ``extrapolated`` marking, and always prints one JSON line.
+Completed legs are additionally persisted to ``BENCH_PARTIAL.json``
+(atomic replace; finalized with ``partial: false``) so an externally
+killed run still leaves an inspectable artifact.
 """
 
 from __future__ import annotations
