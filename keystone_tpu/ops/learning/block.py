@@ -161,6 +161,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         xc = (x - mu_a) * mask
         yc = (y - mu_b) * mask
 
+        # The reg floor must see the REAL data statistics: computed here,
+        # before zero-row masking dilution (first n rows only) and before
+        # zero-column padding, either of which undershoots E[x²] and with
+        # it the intended 1e-6 of the mean Gram diagonal.
+        reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(xc[:n], n)
+
         # Pad the feature dim to a whole number of blocks (zero columns are
         # inert: their Gram rows/cols are zero and λ keeps the solve PD).
         # On a 2-D (data, model) mesh each model group needs a whole number
@@ -170,8 +176,6 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         d_pad = _round_up(d, block * m)
         if d_pad != d:
             xc = jnp.pad(xc, ((0, 0), (0, d_pad - d)))
-
-        reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(xc, n)
         if m > 1:
             xc = linalg.prepare_block_sharded(xc, mesh)
             yc = linalg.prepare_block_sharded(yc, mesh, fine_rows=True)

@@ -419,6 +419,44 @@ def test_refinement_recovers_ill_conditioned_accuracy(mesh):
     assert e2 < 1e-4
 
 
+def test_refine_guard_falls_back_to_highest_on_stalled_refinement(mesh):
+    """ADVICE r3 (medium): IR with a bad fast-Gram factor can stall and
+    silently return weights worse than a HIGHEST solve. The guard tracks
+    the true residual norm and redoes the solve from a HIGHEST-precision
+    Gram (same compiled program, lax.cond) when refinement fails to halve
+    it. Host CPU ignores matmul precision flags, so the fast Gram is
+    corrupted through the _TEST_GRAM_PERTURB seam instead."""
+    a = rand((160, 10))
+    b = rand((160, 3), seed=9)
+    a64, b64 = a.astype(np.float64), b.astype(np.float64)
+    ac, bc = a64 - a64.mean(0), b64 - b64.mean(0)
+    expect = np.linalg.solve(ac.T @ ac + 0.1 * np.eye(10), ac.T @ bc)
+    try:
+        linalg._TEST_GRAM_PERTURB = 100.0
+        with use_mesh(mesh):
+            A = linalg.prepare_row_sharded(a)
+            B = linalg.prepare_row_sharded(b)
+            # Control: the corrupted Gram with no refinement produces
+            # garbage (proves the seam corrupts), no guard to rescue it.
+            w_bad, _, _ = linalg.centered_solve_refined(
+                A, B, 160, 0.1, gram_precision=jax.lax.Precision.DEFAULT,
+                refine_steps=0,
+            )
+            # Guarded refine path: IR stalls against the corrupted factor,
+            # the guard must detect it and return the HIGHEST-Gram solve.
+            w, _, _ = linalg.centered_solve_refined(
+                A, B, 160, 0.1, gram_precision=jax.lax.Precision.DEFAULT,
+                refine_steps=2,
+            )
+    finally:
+        linalg._TEST_GRAM_PERTURB = 0.0
+    bad_err = np.linalg.norm(np.asarray(w_bad) - expect) / np.linalg.norm(expect)
+    guard_err = np.linalg.norm(np.asarray(w) - expect) / np.linalg.norm(expect)
+    assert bad_err > 0.2, bad_err  # seam really corrupted the fast solve
+    np.testing.assert_allclose(np.asarray(w), expect, rtol=1e-4, atol=1e-5)
+    assert guard_err < 1e-3 * bad_err, (bad_err, guard_err)
+
+
 def test_centered_solve_refined_with_row_padding(mesh):
     a = rand((61, 6))  # 61 not divisible by 8 → zero-padded rows
     b = rand((61, 2), seed=5)
