@@ -1,0 +1,273 @@
+"""Golden parity against the REFERENCE'S OWN committed test fixtures.
+
+Every other golden test in this repo validates against scipy/sklearn/
+OpenCV oracles or generated archives; this module reads the fixtures the
+reference itself ships and validates against them, with tolerances no
+looser than the reference's own suites — the only way to catch spec-level
+divergence (channel order, conv anchoring, GMM floors, label-map
+conventions) that an independently generated oracle could share.
+
+Fixture → reference suite map:
+  images/gantrycrane.png + convolved.gantrycrane.csv
+      → ConvolverSuite.scala "convolutions should match scipy"
+        (CSV produced by src/test/python/images/pyconv.py:
+        scipy.signal.convolve(img, arange(27).reshape(3,3,3), 'valid'))
+  gmm_data.txt → GaussianMixtureModelSuite.scala "GMM Two Centers
+        dataset 3" (centers 0, variances {(1,25),(25,1)}, weights .5)
+  images/voc_codebook/{means,variances}.csv + priors
+      → utils/external/EncEvalSuite.scala (GaussianMixtureModel.load)
+  aMat.csv / bMat.csv (+ aMat-1class) → BlockWeightedLeastSquaresSuite
+        (zero-gradient checks at tol 1e-2 / 1e-1)
+  images/imagenet/n15075141.tar + imagenet-test-labels
+      → loaders/ImageNetLoaderSuite.scala
+  images/voc/voctest.tar + voclabels.csv → loaders/VOCLoaderSuite.scala
+
+Skips cleanly if the reference tree is absent (public CI).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+REF = "/root/reference/src/test/resources"
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference fixtures not available"
+)
+
+
+def _ref(*parts: str) -> str:
+    return os.path.join(REF, *parts)
+
+
+# ------------------------------------------------------------- convolver
+
+
+def test_convolver_matches_reference_scipy_golden():
+    """reference: ConvolverSuite.scala:104-140 — our Convolver must
+    reproduce the committed scipy convolution of gantrycrane.png
+    exactly (the reference asserts image equality, not approximate)."""
+    from PIL import Image
+
+    from keystone_tpu.ops.images.core import Convolver, pack_filters
+
+    img = np.array(Image.open(_ref("images", "gantrycrane.png")))
+    assert img.shape == (264, 400, 3)
+
+    rows = np.loadtxt(_ref("images", "convolved.gantrycrane.csv"), delimiter=",")
+    h = int(rows[:, 0].max()) + 1
+    w = int(rows[:, 1].max()) + 1
+    golden = np.zeros((h, w))
+    golden[rows[:, 0].astype(int), rows[:, 1].astype(int)] = rows[:, 2]
+
+    # pyconv.py computes a TRUE convolution (flip in x, y, AND channel):
+    # our Convolver correlates, so hand it the fully flipped kernel.
+    k1 = np.arange(27, dtype=np.float32).reshape(3, 3, 3)
+    filt = k1[::-1, ::-1, ::-1][None]
+    conv = Convolver(pack_filters(filt), 3, normalize_patches=False)
+    out = np.asarray(conv.apply_arrays(jnp.asarray(img[None], jnp.float32)))[
+        0, :, :, 0
+    ]
+
+    assert out.shape == golden.shape
+    # All quantities are integer-valued and < 2^24, exactly representable
+    # in float32 — match to rounding noise, like the reference's equals().
+    np.testing.assert_allclose(out, golden, rtol=0, atol=1e-2)
+
+
+# ------------------------------------------------------------------ gmm
+
+
+def test_gmm_fit_matches_mllib_dataset3_expectations():
+    """reference: GaussianMixtureModelSuite.scala:64-119 'dataset 3' —
+    fit k=2 on gmm_data.txt, same tolerances (0.5 / 2.0 / 0.05)."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.gmm import GaussianMixtureModelEstimator
+
+    data = np.loadtxt(_ref("gmm_data.txt"))
+    assert data.shape[1] == 2
+    est = GaussianMixtureModelEstimator(
+        2, min_cluster_size=1, seed=0, stop_tolerance=0.0, max_iterations=30
+    )
+    gmm = est.fit(ArrayDataset(data.astype(np.float32)))
+
+    means = np.asarray(gmm.means, np.float64)        # (d, k)
+    variances = np.asarray(gmm.variances, np.float64)
+    weights = np.asarray(gmm.weights, np.float64)
+
+    np.testing.assert_allclose(means, 0.0, atol=0.5)
+    # Components in either order: variance columns {(1,25), (25,1)}.
+    v = variances.T  # (k, d)
+    order1 = np.allclose(v, [[1.0, 25.0], [25.0, 1.0]], atol=2.0)
+    order2 = np.allclose(v, [[25.0, 1.0], [1.0, 25.0]], atol=2.0)
+    assert order1 or order2, v
+    np.testing.assert_allclose(weights, 0.5, atol=0.05)
+
+
+def test_voc_codebook_loads_and_encodes():
+    """reference: EncEvalSuite.scala:15-41 — the committed VOC GMM
+    codebook must load (reference layout: (dim, centers) columns) and
+    drive a Fisher encoding to finite values. (The suite's exact FV-sum
+    check needs images/feats.csv, which the reference does not ship.)"""
+    from keystone_tpu.ops.images.fisher import FisherVector
+    from keystone_tpu.ops.learning.gmm import GaussianMixtureModel
+
+    gmm = GaussianMixtureModel.load(
+        _ref("images", "voc_codebook", "means.csv"),
+        _ref("images", "voc_codebook", "variances.csv"),
+        _ref("images", "voc_codebook", "priors"),
+    )
+    d, k = gmm.means.shape
+    assert gmm.variances.shape == (d, k)
+    assert gmm.weights.shape == (k,)
+    np.testing.assert_allclose(float(jnp.sum(gmm.weights)), 1.0, atol=1e-3)
+    assert float(jnp.min(gmm.variances)) > 0.0
+
+    rng = np.random.default_rng(0)
+    descs = rng.normal(size=(2, 7, d)).astype(np.float32) * np.sqrt(
+        np.asarray(gmm.variances).mean()
+    )
+    fv = np.asarray(FisherVector(gmm).apply_arrays(jnp.asarray(descs)))
+    assert fv.shape == (2, d, 2 * k)
+    assert np.isfinite(fv).all()
+
+
+# ------------------------------------------------------- weighted solver
+
+
+def _load_ab(a_name: str, b_name: str):
+    a = np.loadtxt(_ref(a_name), delimiter=",").astype(np.float32)
+    b = np.loadtxt(_ref(b_name), delimiter=",").astype(np.float32)
+    return a, b.reshape(a.shape[0], -1)
+
+
+def _weighted_gradient(a, y, lam, mw, w, b):
+    """reference: BlockWeightedLeastSquaresSuite.scala:19-61
+    computeGradient — per-example weights are negWt=(1-mw)/n everywhere
+    except posWt=negWt+mw/n_c in the example's own class column;
+    gradient = Aᵀ(Wts ⊙ (A·x + b − y)) + λ·x."""
+    a = a.astype(np.float64)
+    y = y.astype(np.float64)
+    n, k = y.shape
+    cls = np.argmax(y, axis=1)
+    counts = np.bincount(cls, minlength=k)
+    neg = (1.0 - mw) / n
+    wts = np.full((n, k), neg)
+    pos = neg + mw / np.maximum(counts[cls], 1)
+    wts[np.arange(n), cls] = pos
+    resid = (a @ w + b - y) * wts
+    return a.T @ resid + lam * w
+
+
+@pytest.mark.parametrize("block_size,tol", [(4, 1e-2), (5, 1e-1)])
+def test_block_weighted_solver_zero_gradient_on_reference_fixture(
+    block_size, tol
+):
+    """reference: BlockWeightedLeastSquaresSuite.scala:142-166 (bs=4,
+    tol 1e-2) and :188-223 (features not divisible by blockSize, tol
+    1e-1), on the reference's own aMat/bMat."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    a, y = _load_ab("aMat.csv", "bMat.csv")
+    lam, mw = 0.1, 0.3
+    est = BlockWeightedLeastSquaresEstimator(
+        block_size, num_iter=10, reg=lam, mixture_weight=mw
+    )
+    model = est.fit(ArrayDataset(a), ArrayDataset(y))
+
+    d = a.shape[1]
+    w = np.asarray(model.weights, np.float64)[:d]
+    if model.feature_mean is not None:
+        # Model predicts (x − μ)·W + b; fold μ into the intercept to
+        # match the reference's x·W + b form.
+        b = np.asarray(model.intercept, np.float64) - (
+            np.asarray(model.feature_mean, np.float64) @ w
+        )
+    else:
+        b = np.asarray(model.intercept, np.float64)
+
+    g = _weighted_gradient(a, y, lam, mw, w, b)
+    assert np.linalg.norm(g.ravel()) == pytest.approx(0.0, abs=tol)
+
+
+def test_block_weighted_solver_single_class_fixture():
+    """reference: BlockWeightedLeastSquaresSuite.scala:168-186 — the
+    1-class fixture must fit without error and produce finite weights."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.weighted import (
+        BlockWeightedLeastSquaresEstimator,
+    )
+
+    a, y = _load_ab("aMat-1class.csv", "bMat-1class.csv")
+    est = BlockWeightedLeastSquaresEstimator(4, num_iter=3, reg=0.1,
+                                             mixture_weight=0.3)
+    model = est.fit(ArrayDataset(a), ArrayDataset(y))
+    assert np.isfinite(np.asarray(model.weights)).all()
+
+
+def test_exact_solver_closed_form_on_reference_fixture():
+    """VERDICT r3 item 3: the exact solver on aMat/bMat vs the float64
+    closed-form centered ridge solution."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+
+    a, y = _load_ab("aMat.csv", "bMat.csv")
+    lam = 0.1
+    model = LinearMapEstimator(reg=lam).fit(ArrayDataset(a), ArrayDataset(y))
+
+    a64, y64 = a.astype(np.float64), y.astype(np.float64)
+    ac = a64 - a64.mean(axis=0)
+    yc = y64 - y64.mean(axis=0)
+    expect = np.linalg.solve(
+        ac.T @ ac + lam * np.eye(a.shape[1]), ac.T @ yc
+    )
+    np.testing.assert_allclose(
+        np.asarray(model.weights, np.float64), expect, rtol=1e-4, atol=1e-5
+    )
+
+
+# ---------------------------------------------------------------- loaders
+
+
+def test_imagenet_loader_on_reference_tar():
+    """reference: loaders/ImageNetLoaderSuite.scala — 5 images, all
+    label 12, filenames starting n15075141, from the real archive +
+    label map."""
+    from keystone_tpu.data.loaders.imagenet import load_imagenet
+
+    ds = load_imagenet(
+        _ref("images", "imagenet"), _ref("images", "imagenet-test-labels")
+    )
+    recs = ds.collect()
+    assert len(recs) == 5
+    assert {r["label"] for r in recs} == {12}
+    assert all(
+        os.path.basename(r["filename"]).startswith("n15075141") for r in recs
+    )
+    shapes = {np.asarray(r["image"]).shape for r in recs}
+    assert all(len(s) == 3 and s[2] == 3 for s in shapes)
+
+
+def test_voc_loader_on_reference_tar():
+    """reference: loaders/VOCLoaderSuite.scala — 10 images; 000104.jpg
+    is multi-label {14, 19}; 13 labels total, 9 distinct."""
+    from keystone_tpu.data.loaders.voc import load_voc
+
+    ds = load_voc(
+        _ref("images", "voc"), _ref("images", "voclabels.csv")
+    )
+    recs = ds.collect()
+    assert len(recs) == 10
+
+    monitor = [r for r in recs if r["filename"].endswith("000104.jpg")]
+    assert len(monitor) == 1
+    assert set(monitor[0]["labels"]) == {14, 19}
+
+    all_labels = [l for r in recs for l in r["labels"]]
+    assert len(all_labels) == 13
+    assert len(set(all_labels)) == 9
