@@ -31,8 +31,15 @@ Also measured (reported as extra keys on the same JSON line):
     solve) of the flagship SIFT+LCS+FisherVector pipeline (reference:
     pipelines/images/imagenet/ImageNetSiftLcsFV.scala:75-141), with an
     OOM reduction ladder.
-  - imagenet_native: native-resolution (size-bucketed, masked) SIFT+LCS
-    featurization throughput at ≥10k mixed-size images.
+  - imagenet_native: native-resolution featurization throughput at ≥10k
+    mixed-size images through the streaming path (fused per-bucket-shape
+    SIFT+LCS+PCA+FV, uint8 uploads, prefetch pipelining) with a stage
+    breakdown.
+  - imagenet_flagship: the flagship END TO END at reference scale —
+    ≥50k images, 1000 classes, reference hyperparameters, top-5 held-out
+    error (device-generated learnable images; ingest measured apart).
+  - ingest: tar-of-JPEG → device-ready batches through the native OpenMP
+    libjpeg kernel; thread-scaling curve + decode-featurize overlap.
 
 Robustness contract (this file must NEVER exit non-zero without printing
 a machine-readable line): the parent process runs the actual benchmark in
@@ -568,96 +575,166 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
 
 
 def _bench_imagenet_native(small: bool) -> dict:
-    """Native-resolution FEATURIZATION (the dominant stage) through the
-    Pipeline ops at ≥10k mixed-size images (round-2 verdict item 7's
-    bench leg): size-bucketed images → MaskedExtractor SIFT+LCS, one XLA
-    computation per bucket. The post-featurization stages (PCA/GMM/FV/
-    solve) are timed by the sibling imagenet_fv workload; the
-    native-resolution END-TO-END correctness path is exercised by
-    tests/pipelines/test_imagenet_native.py. Buckets are featurized
-    incrementally under a time budget; an early stop is marked and the
-    remainder extrapolated PER PIXEL (buckets process smallest-first, so
-    a per-image rate would undershoot the unmeasured larger sizes)."""
-    import jax
-    import jax.numpy as jnp
+    """Native-resolution flagship featurization at ≥10k MIXED-size images
+    through the streaming path (r3 verdict item 2: the r3 per-bucket loop
+    measured 9.1 img/s — dominated by per-dispatch latency, float32
+    uploads, and per-op bucket passes, not MXU time). Now: ONE fused XLA
+    computation per bucket shape (SIFT+LCS → Hellinger → PCA → FV →
+    normalize, both branches), uint8 uploads, prefetch-2 pipelining —
+    with a stage breakdown so a regression is attributable. Image sizes
+    are drawn uniformly (not a fixed menu) so the bucketizer's
+    granularity grid is what bounds the compile count."""
     import numpy as np
 
     from keystone_tpu.data.buckets import bucketize_images
-    from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
-    from keystone_tpu.ops.images.native import MaskedExtractor
-    from keystone_tpu.ops.images.lcs import LCSExtractor
-    from keystone_tpu.ops.images.sift import SIFTExtractor
-    from keystone_tpu.ops.stats.core import SignedHellingerMapper
+    from keystone_tpu.pipelines.imagenet_streaming import StreamingFlagship
 
     n_img = 64 if small else 10_000
     max_rows = 16 if small else 64
-    sizes = (64, 96) if small else (192, 224, 256, 288)
-    budget_s = 20.0 if small else 420.0
+    lo, hi = (48, 96) if small else (176, 288)
     rng = np.random.default_rng(0)
 
-    # Synthetic mixed-size records; generation kept cheap by building each
-    # size group as one block of float32.
-    recs = []
-    per = n_img // len(sizes)
-    for s in sizes:
-        block = (rng.random((per, s, s, 3), dtype=np.float32) * 255.0)
-        for i in range(per):
-            recs.append({"image": block[i], "label": int(rng.integers(0, 1000))})
-    buckets = bucketize_images(recs, granularity=32, max_rows=max_rows)
-
-    pix, gray, hell = PixelScaler(), GrayScaler(), SignedHellingerMapper()
-    sift_op = MaskedExtractor(
-        SIFTExtractor(scale_step=1),
-        pre=lambda x: gray.apply_arrays(pix.apply_arrays(x)),
-        post=hell.apply_arrays,
-    )
-    lcs_op = MaskedExtractor(LCSExtractor(stride=4, stride_start=16, sub_patch_size=6))
-
-    def force(ds):
-        for leaf in jax.tree_util.tree_leaves(ds.data):
-            float(jnp.sum(leaf))
-
-    done_imgs = 0
-    done_pixels = 0
     t0 = time.perf_counter()
-    sift_descs = 0
-    done_idx = 0
-    for b in buckets:
-        bd = b.to_dataset()
-        out_s = sift_op.apply_batch(bd)
-        out_l = lcs_op.apply_batch(bd)
-        force(out_s)
-        force(out_l)
-        done_imgs += len(b)
-        done_pixels += int(b.dims.astype(np.int64).prod(axis=1).sum())
-        sift_descs += int(np.asarray(out_s.data["valid"]).sum())
-        done_idx += 1
-        if time.perf_counter() - t0 > budget_s:
-            break
-    featurize_s = time.perf_counter() - t0
-    ips = done_imgs / featurize_s
+    recs = []
+    for i in range(n_img):
+        x = int(rng.integers(lo, hi + 1))
+        y = int(rng.integers(lo, hi + 1))
+        img = rng.integers(0, 256, (x, y, 3), dtype=np.uint8)
+        recs.append({"image": img, "label": int(rng.integers(0, 1000))})
+    gen_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    buckets = bucketize_images(recs, granularity=32, max_rows=max_rows)
+    bucketize_s = time.perf_counter() - t0
+    shapes = {b.bucket_shape for b in buckets}
+
+    fs = StreamingFlagship()
+    t0 = time.perf_counter()
+    fs.fit_codebooks(
+        ({"image": b.images, "dims": b.dims} for b in buckets[:: max(1, len(buckets) // 4)][:4]),
+        per_image=32,
+    )
+    codebook_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rows = fs.encode_buckets(
+        ({"image": b.images, "dims": b.dims} for b in buckets), prefetch=2
+    )
+    encode_s = time.perf_counter() - t0
+
+    return {
+        "num_images": n_img,
+        "num_buckets": len(buckets),
+        "num_bucket_shapes": len(shapes),
+        "bucket_max_rows": max_rows,
+        "size_range": [lo, hi],
+        "host_gen_s": round(gen_s, 1),
+        "bucketize_s": round(bucketize_s, 1),
+        "codebook_fit_s": round(codebook_s, 1),
+        "encode_s": round(encode_s, 1),
+        "featurize_images_per_sec": round(n_img / max(encode_s, 1e-9), 2),
+        "fv_dim_combined": int(rows.shape[1]),
+        "pipeline": "uint8 buckets -> fused SIFT+LCS+PCA+FV per bucket "
+                    "shape, prefetch-2 pipelined (imagenet_streaming)",
+    }
+
+
+def _bench_flagship_50k(small: bool) -> dict:
+    """The flagship END TO END at reference scale and config (r3 verdict
+    item 4): ≥50k images, 1000 classes, λ=6e-5, mixtureWeight=0.25,
+    descDim=64, vocabSize=16, BCD 4096, top-5 held-out error (reference:
+    ImageNetSiftLcsFV.scala:146-167). Images are device-generated with
+    planted class structure (host ingest is the ingest leg's job), so
+    this measures the framework's full device pipeline: codebook fit →
+    fused featurize+encode → weighted solve → predict."""
+    from keystone_tpu.pipelines.imagenet_streaming import run_flagship_ondevice
+
+    if small:
+        return run_flagship_ondevice(
+            num_train=96, num_test=32, num_classes=8, image_size=64, batch=16
+        )
+    ladder = [(50_000, 5_000, 256, 64), (50_000, 5_000, 256, 32),
+              (25_000, 2_500, 256, 32), (12_500, 1_250, 192, 32)]
+    last_err = None
+    for n_train, n_test, size, batch in ladder:
+        try:
+            out = run_flagship_ondevice(
+                num_train=n_train, num_test=n_test, num_classes=1_000,
+                image_size=size, batch=batch, progress_s=60.0,
+            )
+            if (n_train, n_test, size, batch) != ladder[0]:
+                out["extrapolated"] = True
+                out["reduced_from"] = {"num_train": ladder[0][0],
+                                       "image_size": ladder[0][2]}
+                if last_err:
+                    out["reduction_reason"] = last_err[:200]
+            return out
+        except Exception as e:
+            if "RESOURCE_EXHAUSTED" not in str(e).upper():
+                raise
+            last_err = f"{type(e).__name__}: {e}"
+    raise RuntimeError(f"flagship OOM at every ladder rung: {last_err}")
+
+
+def _bench_ingest(small: bool) -> dict:
+    """Host ingest: tar-of-JPEG → decoded device-ready batches through
+    the native OpenMP libjpeg kernel (r3 verdict item 5; reference:
+    loaders/ImageLoaderUtils.scala:133-211). Reports a thread-scaling
+    curve and, on an accelerator, the rate with decode overlapping
+    device SIFT featurization — the number that answers 'can this host
+    feed the chip?'."""
+    import os
+
+    from keystone_tpu.data.ingest import build_jpeg_tar_fixture, measure_ingest
+
+    n = 512 if small else 10_000
+    fixture = os.path.join(
+        os.path.expanduser("~/.cache/keystone_tpu"),
+        f"ingest_fixture_{n}.tar",
+    )
+    t0 = time.perf_counter()
+    build_jpeg_tar_fixture(fixture, n, size=256)
+    build_s = time.perf_counter() - t0
+
+    ncpu = os.cpu_count() or 1
+    curve = {}
+    for threads in sorted({1, max(1, ncpu // 2), ncpu}):
+        curve[f"threads_{threads}"] = measure_ingest(fixture, threads=threads)
 
     out = {
-        "num_images_total": n_img,
-        "num_images_featurized": done_imgs,
-        "num_buckets": len(buckets),
-        "bucket_max_rows": max_rows,
-        "featurize_images_per_sec": round(ips, 2),
-        "featurize_s_measured": round(featurize_s, 1),
-        "valid_sift_descriptors": sift_descs,
-        "pipeline": "size buckets -> MaskedExtractor(SIFT|LCS), per-bucket XLA",
+        "num_images": n,
+        "fixture_build_s": round(build_s, 1),
+        "host_cpus": ncpu,
+        "scaling": curve,
+        "images_per_sec_decode": curve[f"threads_{ncpu}"].get(
+            "images_per_sec_decode"
+        ),
     }
-    if done_imgs < n_img:
-        # Buckets run smallest-size-first; extrapolate the remainder by
-        # its pixel count, not its image count.
-        rem_pixels = sum(
-            int(b.dims.astype(np.int64).prod(axis=1).sum())
-            for b in buckets[done_idx:]
-        )
-        pps = done_pixels / featurize_s
-        out["extrapolated"] = True
-        out["featurize_full_extrapolated_s"] = round(
-            featurize_s + rem_pixels / pps, 1
+
+    # Overlap leg: decode feeding device SIFT featurization (skipped on
+    # the CPU fallback where "device" work would fight decode for cores).
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        import jax.numpy as jnp
+
+        from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+        from keystone_tpu.ops.images.sift import SIFTExtractor
+
+        pix, gray = PixelScaler(), GrayScaler()
+        sift = SIFTExtractor(scale_step=1)
+
+        @jax.jit
+        def feat(images):
+            g = gray.apply_arrays(pix.apply_arrays(images))
+            return jnp.sum(sift.apply_arrays(g))
+
+        def featurize(images):
+            return float(feat(jnp.asarray(images)))
+
+        out["overlapped"] = measure_ingest(
+            fixture, threads=ncpu, featurize=featurize,
+            max_images=1024 if small else 4096,
         )
     return out
 
@@ -670,6 +747,8 @@ def _workload_registry() -> dict:
         "cifar_random_patch": _bench_cifar_random_patch,
         "imagenet_fv": _bench_imagenet_fv,
         "imagenet_native": _bench_imagenet_native,
+        "imagenet_flagship": _bench_flagship_50k,
+        "ingest": _bench_ingest,
     }
 
 
@@ -773,6 +852,36 @@ def _dump_partial(payload: dict) -> None:
         pass
 
 
+def _load_best_onchip_run() -> dict | None:
+    """The relay watchdog (scripts/tpu_relay_watchdog.sh) captures a full
+    on-chip bench whenever the relay is healthy mid-round. If this run
+    had to fall back to CPU, that capture is the round's best silicon
+    evidence — attach it (with file provenance) rather than losing it."""
+    path = os.environ.get(
+        "KEYSTONE_ONCHIP_CAPTURE", "docs/measurements/r4_onchip_bench.json"
+    )
+    try:
+        with open(path) as f:
+            text = f.read()
+        for line in text.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                payload = json.loads(line)
+                if payload.get("platform") == "cpu":
+                    return None  # a CPU capture adds nothing
+                return {
+                    "source": path,
+                    "captured_mtime": time.strftime(
+                        "%Y-%m-%d %H:%M:%S UTC",
+                        time.gmtime(os.path.getmtime(path)),
+                    ),
+                    "result": payload,
+                }
+    except (OSError, json.JSONDecodeError):
+        pass
+    return None
+
+
 def main() -> int:
     diagnostics: list[str] = []
     report = None
@@ -789,23 +898,43 @@ def main() -> int:
         # Cholesky factorizations at solver precision + the featurize
         # stages; give it room before the ladder gets blamed.
         "imagenet_fv": 1500.0,
+        # 55k images × (SIFT+LCS+PCA+FV) + 1000-class solve, end to end.
+        "imagenet_flagship": 3600.0,
+        "ingest": 1200.0,
     }
     merged: dict = {}
-    for attempt in range(2):
+    # Relay-health watchdog (r3 verdict item 1): the r3 driver bench hit a
+    # dead relay once at end-of-round and fell straight to CPU. Now the
+    # probe retries on a schedule across a window (the relay can come back
+    # when its parent restarts it) before any fallback is considered.
+    probe_window_s = float(os.environ.get("KEYSTONE_BENCH_PROBE_WINDOW", 1500))
+    probe_interval_s = float(os.environ.get("KEYSTONE_BENCH_PROBE_INTERVAL", 120))
+    deadline = time.time() + probe_window_s
+    attempt = 0
+    run_rounds = 0
+    while True:
         # Only (re)run workloads with no successful result yet, so a flaky
-        # tunnel failure on attempt 1 gets its second chance even when the
-        # other workloads already succeeded.
+        # tunnel failure on round 1 gets its second chance even when the
+        # other workloads already succeeded. Two full rounds max — a
+        # persistently erroring workload must not eat the probe window.
         todo = [
             n for n in WORKLOADS
             if not isinstance(merged.get(n), dict) or "error" in merged[n]
         ]
-        if not todo:
+        if not todo or run_rounds >= 2:
             break
+        attempt += 1
         ok, info = _probe_backend(dict(os.environ))
         if not ok:
-            diagnostics.append(f"probe {attempt + 1}: {info}")
-            time.sleep(10)
+            diagnostics.append(f"probe {attempt}: {info}")
+            if time.time() >= deadline:
+                diagnostics.append(
+                    f"probe window exhausted after {probe_window_s:.0f}s"
+                )
+                break
+            time.sleep(probe_interval_s)
             continue
+        run_rounds += 1
         # Platform token of the PROBE_OK line itself (stdout may carry
         # init noise; the success check above tolerates it, so must we).
         probe_platform = info.split("PROBE_OK", 1)[1].split()[0] if "PROBE_OK" in info else ""
@@ -815,7 +944,7 @@ def main() -> int:
             # timeout. Stop probing; with no successful workload the
             # small-shapes CPU leg below takes over (after a PARTIAL
             # accelerator success the partial results stand instead).
-            diagnostics.append(f"probe {attempt + 1}: cpu backend ({info})")
+            diagnostics.append(f"probe {attempt}: cpu backend ({info})")
             break
         for name in todo:
             wreport, err = _run_child(
@@ -905,6 +1034,14 @@ def main() -> int:
     }
     if diagnostics:
         result["diagnostics"] = diagnostics
+    if report.get("platform") == "cpu":
+        # Relay-outage insurance (r3: the round's official artifact was a
+        # CPU fallback while real on-chip numbers sat in docs/): stamp the
+        # best on-chip run this round's watchdog captured, with
+        # provenance, so the driver artifact carries the silicon evidence.
+        best = _load_best_onchip_run()
+        if best is not None:
+            result["best_onchip_run"] = best
     print(json.dumps(result))
     _dump_partial({"partial": False, **result})
     return 0

@@ -127,6 +127,15 @@ void ks_decode_jpeg_batch(const unsigned char* const* bufs,
   }
 }
 
+// Cap the decode pool (bench scaling curves; 0 = library default).
+void ks_set_threads(int n) {
+#ifdef _OPENMP
+  if (n > 0) omp_set_num_threads(n);
+#else
+  (void)n;
+#endif
+}
+
 // Probe: returns 1 and fills (height=rows, width=cols) without full decode.
 int ks_jpeg_dims(const unsigned char* buf, long long len, int* rows, int* cols) {
   jpeg_decompress_struct cinfo;

@@ -1,0 +1,42 @@
+"""Host-ingest utility: fixture builder + native decode measurement."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.ingest import build_jpeg_tar_fixture, measure_ingest
+from keystone_tpu import native
+
+
+def test_fixture_build_is_cached(tmp_path):
+    p = str(tmp_path / "fix.tar")
+    build_jpeg_tar_fixture(p, 8, size=64)
+    mtime = os.path.getmtime(p)
+    build_jpeg_tar_fixture(p, 8, size=64)  # second call must reuse
+    assert os.path.getmtime(p) == mtime
+
+
+@pytest.mark.skipif(native.load() is None, reason="native lib not built")
+def test_measure_ingest_decodes_all(tmp_path):
+    p = str(tmp_path / "fix.tar")
+    build_jpeg_tar_fixture(p, 12, size=64)
+    out = measure_ingest(p, resize=(64, 64), batch=5)
+    assert out["images"] == 12
+    assert out["images_per_sec_decode"] > 0
+
+
+@pytest.mark.skipif(native.load() is None, reason="native lib not built")
+def test_measure_ingest_overlap_path(tmp_path):
+    p = str(tmp_path / "fix.tar")
+    build_jpeg_tar_fixture(p, 10, size=64)
+    seen = []
+
+    def featurize(images):
+        seen.append(np.asarray(images).shape)
+        return None
+
+    out = measure_ingest(p, resize=(64, 64), batch=4, featurize=featurize)
+    assert out["images"] == 10
+    assert sum(s[0] for s in seen) == 10
+    assert "images_per_sec_overlapped" in out

@@ -1,0 +1,92 @@
+"""Streaming flagship: fused per-bucket encode must agree with the
+Pipeline-API ops it fuses, and the end-to-end on-device run must learn."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.buckets import bucketize_images
+from keystone_tpu.pipelines.imagenet import ImageNetSiftLcsFVConfig
+from keystone_tpu.pipelines.imagenet_streaming import (
+    StreamingFlagship,
+    run_flagship_ondevice,
+)
+
+
+@pytest.fixture(scope="module")
+def fitted():
+    rng = np.random.default_rng(0)
+    recs = [
+        {"image": rng.integers(0, 256, (s, s, 3), dtype=np.uint8)}
+        for s in (48, 48, 64, 64, 64, 80)
+    ]
+    buckets = bucketize_images(recs, granularity=16, max_rows=4)
+    fs = StreamingFlagship(ImageNetSiftLcsFVConfig(desc_dim=16, vocab_size=4))
+    fs.fit_codebooks(
+        ({"image": b.images, "dims": b.dims} for b in buckets), per_image=16
+    )
+    return fs, buckets
+
+
+def test_encode_buckets_row_count_and_width(fitted):
+    fs, buckets = fitted
+    rows = fs.encode_buckets(
+        ({"image": b.images, "dims": b.dims} for b in buckets)
+    )
+    n = sum(len(b) for b in buckets)
+    # combined width: 2 branches × descDim × 2·vocab
+    assert rows.shape == (n, 2 * 16 * 2 * 4)
+    assert np.isfinite(rows).all()
+    # normalized rows: unit L2 per branch half after final NormalizeRows
+    norms = np.linalg.norm(rows, axis=1)
+    assert np.all(norms > 0.1) and np.all(norms < 2.1)
+
+
+def test_encode_matches_unfused_ops(fitted):
+    """The fused per-bucket kernel must equal the op-by-op composition
+    (MaskedExtractor → PCA project → FisherVector.apply_arrays_masked →
+    norms) it replaces."""
+    from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.ops.stats.core import (
+        NormalizeRows,
+        SignedHellingerMapper,
+    )
+
+    fs, buckets = fitted
+    b = buckets[0]
+    fused = np.asarray(
+        fs._encode_bucket(
+            jnp.asarray(b.images), jnp.asarray(b.dims),
+            fs.codebooks.sift_pca, fs.codebooks.lcs_pca,
+        )
+    )
+
+    pix, gray, hell, norm = (
+        PixelScaler(), GrayScaler(), SignedHellingerMapper(), NormalizeRows()
+    )
+    x = jnp.asarray(b.images, jnp.float32)
+    g = gray.apply_arrays(pix.apply_arrays(x))
+    sd, sv = fs._sift.apply_arrays_masked(g, jnp.asarray(b.dims))
+    sd = hell.apply_arrays(sd)
+    enc = fs.codebooks.sift_fv.apply_arrays_masked(
+        sd @ fs.codebooks.sift_pca, sv
+    )
+    flat = enc.reshape(enc.shape[0], -1)
+    expect_sift = np.asarray(
+        norm.apply_arrays(hell.apply_arrays(norm.apply_arrays(flat)))
+    )
+    half = fused.shape[1] // 2
+    np.testing.assert_allclose(fused[:, :half], expect_sift, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_flagship_ondevice_learns_planted_classes():
+    out = run_flagship_ondevice(
+        num_train=64, num_test=16, num_classes=4, image_size=48, batch=16
+    )
+    # 4 classes, top-5 window ≥ k: must be well below the ~0% chance
+    # ceiling — planted templates are separable, so expect near-zero.
+    assert out["top5_err_percent"] <= 25.0
+    assert out["encode_images_per_sec"] > 0
+    assert out["fv_dim_combined"] == 4096
