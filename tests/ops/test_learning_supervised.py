@@ -127,6 +127,66 @@ def test_weighted_bcd_matches_numpy_reference():
     np.testing.assert_allclose(np.asarray(model.intercept), b_ref, rtol=1e-3, atol=1e-3)
 
 
+def test_weighted_bcd_woodbury_path_matches_dense_path():
+    """The shared-factor Woodbury solve (auto-picked when the per-class
+    update rank is small vs the block size — the flagship's 1000-class
+    regime) must agree with the per-class dense Cholesky path to
+    solver-grade accuracy."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.learning.weighted import _weighted_bcd
+
+    rng = np.random.default_rng(7)
+    n, d, C = 160, 96, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    cls = rng.integers(0, C, size=n)
+    y = np.full((n, C), -1.0, dtype=np.float32)
+    y[np.arange(n), cls] = 1.0
+
+    counts = np.bincount(cls, minlength=C).astype(np.int64)
+    order = np.argsort(cls, kind="stable")
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    m = int(counts.max())
+    xs = np.concatenate([x[order], np.zeros((m, d), np.float32)])
+    onehot = np.zeros((n, C), np.float32)
+    onehot[np.arange(n), cls] = 1.0
+
+    args = (
+        jnp.asarray(x), jnp.asarray(xs), jnp.asarray(y), jnp.asarray(onehot),
+        jnp.asarray(offsets), jnp.asarray(counts.astype(np.float32)),
+        jnp.float32(0.2), jnp.float32(0.25), 1, d, m, 2,
+    )
+    w_dense, jm_dense = _weighted_bcd(*args, "dense")
+    w_wood, jm_wood = _weighted_bcd(*args, "woodbury")
+    np.testing.assert_allclose(np.asarray(w_wood), np.asarray(w_dense),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(jm_wood), np.asarray(jm_dense),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_weighted_bcd_auto_woodbury_matches_numpy_reference():
+    """At a flagship-like shape (block ≫ class counts) the estimator
+    auto-selects Woodbury; the result must still match the independent
+    numpy oracle."""
+    rng = np.random.default_rng(11)
+    n, d, C = 180, 384, 6  # max class count ~39 ≪ 384/6 → auto-Woodbury
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    cls = rng.integers(0, C, size=n)
+    y = np.full((n, C), -1.0, dtype=np.float32)
+    y[np.arange(n), cls] = 1.0
+
+    est = BlockWeightedLeastSquaresEstimator(block_size=384, num_iter=2,
+                                             reg=0.3, mixture_weight=0.25)
+    model = est.fit(ArrayDataset(x), ArrayDataset(y))
+    w_ref, b_ref = numpy_weighted_reference(
+        x.astype(np.float64), y.astype(np.float64), 0.3, 0.25, 2
+    )
+    np.testing.assert_allclose(np.asarray(model.weights)[:d], w_ref,
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(model.intercept), b_ref,
+                               rtol=2e-3, atol=2e-3)
+
+
 def test_weighted_bcd_classifies():
     rng = np.random.default_rng(4)
     n, d, C = 300, 6, 3
