@@ -122,9 +122,13 @@ def test_meta_solver_prediction_matches_measured_sweep():
         if picked not in times:
             continue  # picked solver wasn't measured at this shape
         fastest = min(times.values())
-        assert times[picked] <= 2.0 * fastest, (
+        argmin = min(times, key=times.get)
+        # r3 verdict item 7: model-argmin must equal measured-argmin on
+        # every sweep row (a 5% band absorbs measurement noise on ties).
+        assert picked == argmin or times[picked] <= 1.05 * fastest, (
             f"at (n={n}, d={d}, k={k}, sp={sparsity}) picked {picked} "
-            f"({times[picked]:.0f} ms) vs fastest {fastest:.0f} ms: {times}"
+            f"({times[picked]:.0f} ms) vs fastest {argmin} "
+            f"({fastest:.0f} ms): {times}"
         )
 
 
@@ -147,3 +151,23 @@ def test_measured_constants_committed_and_sane():
     with open(cost_mod.MEASURED_CONSTANTS_PATH) as f:
         payload = json.load(f)
     assert "fitted_on" in payload
+
+
+def test_measured_constants_physically_plausible():
+    """r3 verdict item 7: the fitted weights may not imply a machine
+    faster than first principles (r3's unbounded fit implied 2e16 flop/s
+    — 100x v5e peak), and the committed per-row residuals must be under
+    the 25% band the fit model claims."""
+    w = cost_mod.measured_tpu_weights()
+    if w is None:
+        pytest.skip("tpu_cost_constants.json not committed yet")
+    fp = cost_mod.tpu_weights()
+    assert w.cpu >= fp.cpu, (w.cpu, fp.cpu)
+    assert w.mem >= fp.mem, (w.mem, fp.mem)
+    assert w.network >= fp.network, (w.network, fp.network)
+    with open(cost_mod.MEASURED_CONSTANTS_PATH) as f:
+        payload = json.load(f)
+    per_row = payload.get("per_row_rel_residual", {})
+    assert per_row, "refit must report per-row residuals"
+    worst = max(per_row.values())
+    assert worst < 0.25, per_row
