@@ -219,13 +219,18 @@ TIMIT_WIDE_BASELINE_MS = 580_555.0  # reference csv:26 — Block, d=16384
 
 def _bench_timit_wide_block(small: bool) -> dict:
     """Block-coordinate-descent solve at the reference's WIDEST measured
-    TIMIT point: d=16384 features, block 1024, the shape where the
+    TIMIT point — d=16384, block 1024, FULL n=2.2M, the shape where the
     reference's 16-node block solver took 580,555 ms at 35.73% train
-    error (reference: scripts/solver-comparisons-final.csv:26). The full
-    (2.2M, 16384) matrix is 144 GB — beyond one chip's HBM and this
-    host's RAM — so n is scaled to fit and the BCD cost's exact
-    linearity in n (fixed per-block Gram work per row) marks the
-    extrapolation."""
+    error (reference: scripts/solver-comparisons-final.csv:26).
+
+    The full (2.2M, 16384) matrix is 144 GB — beyond HBM and host RAM —
+    so feature blocks are REMATERIALIZED: generated on device (seeded
+    PRNG) inside each BCD update via
+    ``block_coordinate_descent_rematerialized``; only one (n, 1024)
+    panel plus the (n, k) predictions are ever resident (~10.5 GB at
+    full n). r3 verdict item 6: a measured number, no extrapolation
+    flag. OOM ladder halves n (marked) if a smaller-HBM chip needs it.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -233,26 +238,27 @@ def _bench_timit_wide_block(small: bool) -> dict:
     from keystone_tpu.parallel import linalg
     from keystone_tpu.parallel.mesh import get_mesh
 
-    full_n, d, k = 2_200_000, 16_384, 138
-    n, bs = (8_192, 1024) if small else (100_000, 1024)
-    if small:
-        d = 4_096
+    full_n, full_d, k, bs = 2_200_000, 16_384, 138, 1024
+    n, d = (8_192, 4_096) if small else (full_n, full_d)
     mesh = get_mesh()
+    num_blocks = d // bs
+    key = jax.random.PRNGKey(7)
+
+    def block_fn(b, row_offset, rows):
+        kk = jax.random.fold_in(jax.random.fold_in(key, b), row_offset)
+        return jax.random.normal(kk, (rows, bs), jnp.float32)
 
     while True:
         try:
-            key = jax.random.PRNGKey(7)
-            ka, kb = jax.random.split(key)
-            x = jax.random.normal(ka, (n, d), dtype=jnp.float32)
-            y = jax.random.normal(kb, (n, k), dtype=jnp.float32)
-            float(jnp.sum(x[-1]) + jnp.sum(y[-1]))
-
-            xs = linalg.prepare_row_sharded(x, mesh)
+            ndev = mesh.devices.size
+            n_pad = ((n + ndev - 1) // ndev) * ndev
+            y = jax.random.normal(jax.random.PRNGKey(3), (n_pad, k), jnp.float32)
             ys = linalg.prepare_row_sharded(y, mesh)
 
             def fit():
-                return linalg.block_coordinate_descent(
-                    xs, ys, reg=1e-2, num_epochs=1, block_size=bs, mesh=mesh
+                return linalg.block_coordinate_descent_rematerialized(
+                    block_fn, ys, reg=1e-2, num_epochs=1, block_size=bs,
+                    num_blocks=num_blocks, mesh=mesh,
                 )
 
             ms = _timed(fit) * 1000.0  # shared warmup+median-of-3 timer
@@ -263,15 +269,21 @@ def _bench_timit_wide_block(small: bool) -> dict:
             n //= 2
 
     out = {"fit_ms": round(ms, 2), "shape": [n, d, k], "block_size": bs,
-           "num_epochs": 1}
-    # BCD cost per epoch ≈ Σ_blocks n·bs·(bs+k) = n·d·(bs+k) — linear in
-    # BOTH n and d at fixed block size.
-    scale = (full_n / n) * (16_384 / d)
-    out["fit_ms_extrapolated_full_shape"] = round(ms * scale, 2)
-    out["extrapolated"] = True
-    out["vs_reference_16node_block"] = round(
-        TIMIT_WIDE_BASELINE_MS / (ms * scale), 2
-    )
+           "num_epochs": 1,
+           "mode": "rematerialized (feature blocks generated on device; "
+                   "144 GB matrix never exists)"}
+    if (n, d) == (full_n, full_d):
+        out["extrapolated"] = False
+        out["vs_reference_16node_block"] = round(TIMIT_WIDE_BASELINE_MS / ms, 2)
+    else:
+        # BCD cost per epoch ≈ Σ_blocks n·bs·(bs+k) = n·d·(bs+k) — linear
+        # in BOTH n and d at fixed block size.
+        scale = (full_n / n) * (full_d / d)
+        out["fit_ms_extrapolated_full_shape"] = round(ms * scale, 2)
+        out["extrapolated"] = True
+        out["vs_reference_16node_block"] = round(
+            TIMIT_WIDE_BASELINE_MS / (ms * scale), 2
+        )
     return out
 
 
