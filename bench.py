@@ -634,7 +634,29 @@ def _bench_imagenet_native(small: bool) -> dict:
     )
     encode_s = time.perf_counter() - t0
 
+    # SIFT bf16-binning A/B (r3 verdict item 8): same codebooks, same
+    # bucket subset, binning convs in bf16 vs fp32 — the accuracy gate
+    # already passes (tests/ops/test_sift_opencv_fixture.py); this is the
+    # throughput side of the default decision, meaningful on TPU only
+    # (precision flags are no-ops on host CPU).
+    ab = {}
+    sub = buckets[: max(1, len(buckets) // 8)]
+    import jax.numpy as jnp
+
+    fs_bf16 = StreamingFlagship(sift_binning_dtype=jnp.bfloat16)
+    fs_bf16.adopt_codebooks(fs.codebooks)
+    for label, f in (("fp32", fs), ("bf16_binning", fs_bf16)):
+        f.encode_buckets(  # warm the compile cache for this subset
+            ({"image": b.images, "dims": b.dims} for b in sub[:1])
+        )
+        t0 = time.perf_counter()
+        f.encode_buckets(({"image": b.images, "dims": b.dims} for b in sub))
+        ab[f"{label}_s"] = round(time.perf_counter() - t0, 2)
+    ab["speedup_bf16"] = round(ab["fp32_s"] / max(ab["bf16_binning_s"], 1e-9), 3)
+    ab["subset_images"] = sum(len(b) for b in sub)
+
     return {
+        "sift_binning_ab": ab,
         "num_images": n_img,
         "num_buckets": len(buckets),
         "num_bucket_shapes": len(shapes),

@@ -73,14 +73,20 @@ class FlagshipCodebooks:
 class StreamingFlagship:
     """Fused-per-bucket SIFT+LCS+FV featurizer (see module docstring)."""
 
-    def __init__(self, config: Optional[ImageNetSiftLcsFVConfig] = None):
+    def __init__(self, config: Optional[ImageNetSiftLcsFVConfig] = None,
+                 sift_binning_dtype=None):
         self.config = config or ImageNetSiftLcsFVConfig()
         c = self.config
         self._pix = PixelScaler()
         self._gray = GrayScaler()
         self._hell = SignedHellingerMapper()
         self._norm = NormalizeRows()
-        self._sift = SIFTExtractor(scale_step=c.sift_scale_step)
+        # binning_dtype=bfloat16 runs the 8-orientation spatial-binning
+        # convs (the bulk of SIFT's conv work) in bf16 — passes the
+        # reference's 99.5%-within-1 gate (docs/PERFORMANCE.md); default
+        # decided by the bench's on-chip A/B.
+        self._sift = SIFTExtractor(scale_step=c.sift_scale_step,
+                                   binning_dtype=sift_binning_dtype)
         self._lcs = LCSExtractor(
             stride=c.lcs_stride, stride_start=c.lcs_border,
             sub_patch_size=c.lcs_patch,
@@ -165,6 +171,13 @@ class StreamingFlagship:
         # stale cache would silently combine new PCA args with old GMMs.
         self._encode_jit = jax.jit(self._encode_bucket)
         return self.codebooks
+
+    def adopt_codebooks(self, codebooks: FlagshipCodebooks) -> None:
+        """Share already-fitted codebooks (e.g. an A/B twin with a
+        different extractor precision); rebuilds the encode jit for the
+        same staleness reason as fit_codebooks."""
+        self.codebooks = codebooks
+        self._encode_jit = jax.jit(self._encode_bucket)
 
     def _encode_bucket(self, images, dims, sift_pca, lcs_pca):
         """Phase B kernel: ONE XLA computation from padded images to
