@@ -103,6 +103,11 @@ WORKLOADS: Dict[str, Tuple[str, str, str, Dict[str, Any], str]] = {
         "imagenet", "ImageNetSiftLcsFVConfig", "run_native_resolution", {},
         "ImageNet SIFT+LCS+FV with per-image native-resolution featurization",
     ),
+    "imagenet-native-streaming": (
+        "imagenet_streaming", "ImageNetSiftLcsFVConfig",
+        "run_native_resolution_streaming", {},
+        "Native-resolution flagship via the fused streaming path (at-scale)",
+    ),
     "amazon-reviews": (
         "text", "AmazonReviewsConfig", "run_amazon", {},
         "Amazon reviews n-gram logistic/LBFGS text pipeline",

@@ -81,6 +81,32 @@ def test_encode_matches_unfused_ops(fitted):
                                atol=2e-5)
 
 
+import os
+
+
+@pytest.mark.skipif(
+    not os.path.isdir("/root/reference/src/test/resources"),
+    reason="reference fixtures not available",
+)
+def test_streaming_runner_on_reference_tar():
+    """run_native_resolution_streaming over the reference's real
+    tar-of-JPEG archive: native sizes, real label map, end-to-end."""
+    from keystone_tpu.pipelines.imagenet_streaming import (
+        run_native_resolution_streaming,
+    )
+
+    cfg = ImageNetSiftLcsFVConfig(
+        train_location="/root/reference/src/test/resources/images/imagenet",
+        label_path="/root/reference/src/test/resources/images/imagenet-test-labels",
+        desc_dim=8, vocab_size=3, num_classes=13, solver_block_size=64,
+    )
+    out = run_native_resolution_streaming(cfg)
+    assert out["num_train"] == 5
+    assert out["fv_dim_combined"] == 2 * 8 * 2 * 3
+    assert out["train_top5_err_percent"] <= 100.0
+    assert np.isfinite(out["train_top5_err_percent"])
+
+
 def test_flagship_ondevice_learns_planted_classes():
     out = run_flagship_ondevice(
         num_train=64, num_test=16, num_classes=4, image_size=48, batch=16
