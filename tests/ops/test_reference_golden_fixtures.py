@@ -231,6 +231,42 @@ def test_exact_solver_closed_form_on_reference_fixture():
     )
 
 
+def test_lda_on_iris_matches_published_eigenvectors():
+    """reference: LinearDiscriminantAnalysisSuite.scala:13-38 — LDA(2)
+    on standardized iris.data must reproduce the published discriminant
+    directions (±sign) at the reference's 1e-4 tolerance."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.lda import LinearDiscriminantAnalysis
+    from keystone_tpu.ops.stats.core import StandardScaler
+
+    rows = []
+    labels = []
+    name_to_label = {"Iris-setosa": 1, "Iris-versicolor": 2, "Iris-virginica": 3}
+    with open(_ref("iris.data")) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(",")
+            rows.append([float(v) for v in parts[:-1]])
+            labels.append(name_to_label[parts[-1]])
+    x = np.asarray(rows, np.float32)
+    y = np.asarray(labels)
+    assert x.shape == (150, 4)
+
+    scaled = StandardScaler().fit(ArrayDataset(x)).apply_batch(ArrayDataset(x))
+    model = LinearDiscriminantAnalysis(2).fit(scaled, ArrayDataset(y))
+    w = np.asarray(model.weights, np.float64)  # (4, 2), unit columns
+
+    major = np.array([-0.1498, -0.1482, 0.8511, 0.4808])
+    minor = np.array([0.0095, 0.3272, -0.5748, 0.75])
+    for col, expect in ((w[:, 0], major), (w[:, 1], minor)):
+        ok = np.allclose(col, expect, atol=1e-4) or np.allclose(
+            -col, expect, atol=1e-4
+        )
+        assert ok, (col, expect)
+
+
 # ---------------------------------------------------------------- loaders
 
 
