@@ -205,6 +205,7 @@ class StreamingFlagship:
         buckets: Iterable[Dict[str, np.ndarray]],
         prefetch: int = 2,
         on_rows: Optional[Callable[[np.ndarray, Dict], None]] = None,
+        mesh=None,
     ) -> Optional[np.ndarray]:
         """Phase B driver: pipelined featurize+encode over host buckets.
 
@@ -215,23 +216,57 @@ class StreamingFlagship:
         directly into a solver's accumulator); without it the full
         (n, fv_dim) matrix is returned — at descDim=64, vocabSize=16
         that is 16 KB/image, ~0.8 GB for 50k images, host-resident.
+
+        With ``mesh`` given, each bucket's rows are sharded over the
+        mesh's data axis (rows zero-padded to the shard count with
+        full-bucket dims; pad outputs are dropped at the gather) and the
+        fused encode runs as one GSPMD computation — the data-parallel
+        featurize path for multi-chip.
         """
         assert self.codebooks is not None, "fit_codebooks first"
         staged: List[Tuple[jnp.ndarray, jnp.ndarray, Dict]] = []
         out_rows: List[np.ndarray] = []
         pending: List[Tuple[jnp.ndarray, Dict]] = []
         it = iter(buckets)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.mesh import row_axes, row_shard_count
+
+            ndev = row_shard_count(mesh)
+            axes = row_axes(mesh)
+
+            def shard(b):
+                img = np.ascontiguousarray(b["image"])
+                dims = np.asarray(b["dims"])
+                pad = (-len(dims)) % ndev
+                if pad:
+                    img = np.concatenate(
+                        [img, np.zeros((pad,) + img.shape[1:], img.dtype)]
+                    )
+                    dims = np.concatenate(
+                        [dims, np.tile(np.asarray(img.shape[1:3], dims.dtype),
+                                       (pad, 1))]
+                    )
+                img_s = jax.device_put(
+                    img, NamedSharding(mesh, P(axes, None, None, None))
+                )
+                dims_s = jax.device_put(dims, NamedSharding(mesh, P(axes, None)))
+                return img_s, dims_s
+        else:
+            def shard(b):
+                return (
+                    jax.device_put(np.ascontiguousarray(b["image"])),
+                    jax.device_put(np.asarray(b["dims"])),
+                )
 
         def stage_next() -> bool:
             try:
                 b = next(it)
             except StopIteration:
                 return False
-            staged.append((
-                jax.device_put(np.ascontiguousarray(b["image"])),
-                jax.device_put(np.asarray(b["dims"])),
-                b,
-            ))
+            img_s, dims_s = shard(b)
+            staged.append((img_s, dims_s, b))
             return True
 
         def drain_one():

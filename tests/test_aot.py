@@ -1,0 +1,17 @@
+"""AOT warm-compile utility: compiles the declared flagship shapes."""
+
+from keystone_tpu.pipelines.imagenet import ImageNetSiftLcsFVConfig
+from keystone_tpu.utils.aot import warm_flagship
+
+
+def test_warm_flagship_compiles_declared_shapes(tmp_path, monkeypatch):
+    # Point the persistent cache somewhere disposable so the test leaves
+    # no shared state.
+    monkeypatch.setenv("KEYSTONE_COMPILATION_CACHE", str(tmp_path / "cache"))
+    out = warm_flagship(
+        ImageNetSiftLcsFVConfig(desc_dim=8, vocab_size=2),
+        bucket_shapes=((2, 48, 48),),
+        solver_shapes=((32, 32, 4),),
+    )
+    assert "encode_2x48x48_s" in out and out["encode_2x48x48_s"] >= 0
+    assert "solve_32x32x4_s" in out
