@@ -65,11 +65,15 @@ def weighted_intercept(jlm, joint_means, w):
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def __init__(self, block_size: int, num_iter: int, reg: float,
-                 mixture_weight: float):
+                 mixture_weight: float, solve_path: str = "auto"):
         self.block_size = block_size
         self.num_iter = num_iter
         self.reg = reg
         self.mixture_weight = mixture_weight
+        # "auto" (flop-crossover Woodbury/dense choice) | "dense" |
+        # "woodbury" — the explicit forms exist for A/B measurement.
+        assert solve_path in ("auto", "dense", "woodbury"), solve_path
+        self.solve_path = solve_path
 
     @property
     def weight(self) -> int:
@@ -109,7 +113,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
             jnp.asarray(counts.astype(np.float32)),
             jnp.float32(self.reg),
             jnp.float32(self.mixture_weight),
-            num_blocks, bs, m, self.num_iter,
+            num_blocks, bs, m, self.num_iter, self.solve_path,
         )
 
         jlm = joint_label_means(counts, n, self.mixture_weight)
