@@ -231,6 +231,67 @@ def test_exact_solver_closed_form_on_reference_fixture():
     )
 
 
+def _gantrycrane_bgr() -> np.ndarray:
+    """The reference loads images in BGR channel order
+    (utils/images/Image.scala:23-30); flip PIL's RGB to match."""
+    from PIL import Image
+
+    rgb = np.array(Image.open(_ref("images", "gantrycrane.png")))
+    return rgb[..., ::-1].astype(np.float32)
+
+
+def test_lcs_matches_matlab_golden_sums():
+    """reference: LCSExtractorSuite.scala:10-28 — MATLAB golden sums on
+    gantrycrane.png. The reference (double pipeline) asserts 1e-8; this
+    float32 pipeline lands at ~5e-6 relative — pure f32 accumulation
+    distance on a 3e7 sum, asserted at 1e-5."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.lcs import LCSExtractor
+
+    lcs = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
+    d = np.asarray(
+        lcs.apply_arrays(jnp.asarray(_gantrycrane_bgr()[None]))
+    )[0].astype(np.float64)
+    first = d[0].sum()  # our rows = the reference's keypoint columns
+    full = d.sum()
+    assert abs(first - 3.786557667540610e3) / 3.786557667540610e3 < 1e-5
+    assert abs(full - 3.171963632855949e7) / 3.171963632855949e7 < 1e-5
+
+
+def test_hog_matches_matlab_golden_sums():
+    """reference: HogExtractorSuite.scala:10-38 — voc-release5 MATLAB
+    sums at binSize 50 (their tol 1e-8; f32 here → 1e-5) and binSize 8
+    (their own tol is already 1e-4 'error a bit higher'; f32 → 5e-4)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.hog import HogExtractor
+
+    scaled = jnp.asarray((_gantrycrane_bgr() / 255.0)[None])
+    s50 = float(np.asarray(HogExtractor(bin_size=50).apply_arrays(scaled)).sum())
+    assert abs(s50 - 59.2162514) / 59.2162514 < 1e-5
+    s8 = float(np.asarray(HogExtractor(bin_size=8).apply_arrays(scaled)).sum())
+    assert abs(s8 - 4.5775269e3) / 4.5775269e3 < 5e-4
+
+
+def test_daisy_matches_matlab_golden_sums():
+    """reference: DaisyExtractorSuite.scala:11-31 — MATLAB golden sums;
+    this implementation meets the reference's own tolerances (1e-7 full
+    sum, 1e-5 first keypoint) despite the ground-up cascaded-blur
+    redesign."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.ops.images.core import GrayScaler
+    from keystone_tpu.ops.images.daisy import DaisyExtractor
+
+    gray = GrayScaler().apply_arrays(jnp.asarray(_gantrycrane_bgr()[None]))
+    d = np.asarray(DaisyExtractor().apply_arrays(gray))[0].astype(np.float64)
+    first = d[0].sum()
+    full = d.sum()
+    assert abs(first - 55.127217737738533) / 55.127217737738533 < 1e-5
+    assert abs(full - 3.240635661296463e5) / 3.240635661296463e5 < 1e-7
+
+
 def test_lda_on_iris_matches_published_eigenvectors():
     """reference: LinearDiscriminantAnalysisSuite.scala:13-38 — LDA(2)
     on standardized iris.data must reproduce the published discriminant
