@@ -292,6 +292,25 @@ def test_daisy_matches_matlab_golden_sums():
     assert abs(full - 3.240635661296463e5) / 3.240635661296463e5 < 1e-7
 
 
+def test_sift_scale_step_descriptor_counts_on_reference_jpeg():
+    """reference: nodes/images/external/SIFTExtractorSuite.scala — on its
+    000012.jpg, scaleStep=0 must produce more descriptors than
+    scaleStep=1 (finer scale sampling → more valid keypoints)."""
+    import jax.numpy as jnp
+    from PIL import Image
+
+    from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+    from keystone_tpu.ops.images.sift import SIFTExtractor
+
+    rgb = np.array(Image.open(_ref("images", "000012.jpg")))
+    bgr = jnp.asarray(rgb[..., ::-1].astype(np.float32)[None])
+    gray = GrayScaler().apply_arrays(PixelScaler().apply_arrays(bgr))
+
+    n1 = np.asarray(SIFTExtractor(scale_step=1).apply_arrays(gray)).shape[1]
+    n0 = np.asarray(SIFTExtractor(scale_step=0).apply_arrays(gray)).shape[1]
+    assert n1 < n0, (n1, n0)
+
+
 def test_lda_on_iris_matches_published_eigenvectors():
     """reference: LinearDiscriminantAnalysisSuite.scala:13-38 — LDA(2)
     on standardized iris.data must reproduce the published discriminant
