@@ -179,6 +179,49 @@ class StreamingFlagship:
         self.codebooks = codebooks
         self._encode_jit = jax.jit(self._encode_bucket)
 
+    # ------------------------------------------------------- persistence
+
+    def save(self, path: str, model=None) -> None:
+        """Persist config + fitted codebooks (+ optionally the trained
+        linear model) — the streaming path's FittedPipeline.save analog
+        (reference: workflow/FittedPipeline.scala:10-22 'may be written
+        to and from disk'). Arrays pickle as host numpy."""
+        import pickle
+
+        assert self.codebooks is not None, "fit_codebooks first"
+        cb = self.codebooks
+        payload = {
+            "config": self.config,
+            "codebooks": {
+                "sift_pca": np.asarray(cb.sift_pca),
+                "lcs_pca": np.asarray(cb.lcs_pca),
+                "sift_gmm": _gmm_arrays(cb.sift_fv.gmm),
+                "lcs_gmm": _gmm_arrays(cb.lcs_fv.gmm),
+            },
+            "model": model,
+        }
+        with open(path, "wb") as f:
+            pickle.dump(payload, f)
+
+    @classmethod
+    def load(cls, path: str) -> Tuple["StreamingFlagship", object]:
+        """Returns (flagship ready to encode, saved model or None)."""
+        import pickle
+
+        from ..ops.learning.gmm import GaussianMixtureModel
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        fs = cls(payload["config"])
+        cb = payload["codebooks"]
+        fs.adopt_codebooks(FlagshipCodebooks(
+            sift_pca=jnp.asarray(cb["sift_pca"]),
+            sift_fv=FisherVector(GaussianMixtureModel(*cb["sift_gmm"])),
+            lcs_pca=jnp.asarray(cb["lcs_pca"]),
+            lcs_fv=FisherVector(GaussianMixtureModel(*cb["lcs_gmm"])),
+        ))
+        return fs, payload.get("model")
+
     def _encode_bucket(self, images, dims, sift_pca, lcs_pca):
         """Phase B kernel: ONE XLA computation from padded images to
         normalized combined FV rows (N, 2·D·2K). The GMM parameters ride
@@ -302,6 +345,14 @@ class StreamingFlagship:
 # bench's ingest leg; this isolates the framework's device pipeline the
 # way BASELINE.md's solver table isolates the reference's solvers).
 # ---------------------------------------------------------------------------
+
+
+def _gmm_arrays(gmm) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    return (
+        np.asarray(gmm.means),
+        np.asarray(gmm.variances),
+        np.asarray(gmm.weights),
+    )
 
 
 def run_native_resolution_streaming(

@@ -128,6 +128,21 @@ def test_streaming_runner_on_reference_tar():
     assert np.isfinite(out["train_top5_err_percent"])
 
 
+def test_save_load_roundtrip_preserves_encoding(fitted, tmp_path):
+    """save/load (the streaming FittedPipeline analog) must reproduce
+    identical encodings from the restored codebooks."""
+    fs, buckets = fitted
+    b = buckets[0]
+    before = fs.encode_buckets([{"image": b.images, "dims": b.dims}])
+
+    path = str(tmp_path / "flagship.pkl")
+    fs.save(path, model={"note": "anything picklable rides along"})
+    fs2, model = StreamingFlagship.load(path)
+    assert model == {"note": "anything picklable rides along"}
+    after = fs2.encode_buckets([{"image": b.images, "dims": b.dims}])
+    np.testing.assert_allclose(after, before, rtol=1e-6, atol=1e-7)
+
+
 def test_flagship_ondevice_learns_planted_classes():
     out = run_flagship_ondevice(
         num_train=64, num_test=16, num_classes=4, image_size=48, batch=16
