@@ -667,9 +667,11 @@ def _bench_imagenet_native(small: bool) -> dict:
     fs_bf16 = StreamingFlagship(sift_binning_dtype=jnp.bfloat16)
     fs_bf16.adopt_codebooks(fs.codebooks)
     for label, f in (("fp32", fs), ("bf16_binning", fs_bf16)):
-        f.encode_buckets(  # warm the compile cache for this subset
-            ({"image": b.images, "dims": b.dims} for b in sub[:1])
-        )
+        # Warm EVERY bucket shape in the subset for BOTH twins before
+        # timing — the fp32 twin is already warm from the main pass, so
+        # an unwarmed bf16 twin would pay its XLA compiles inside the
+        # timed leg and bias the A/B toward fp32.
+        f.encode_buckets(({"image": b.images, "dims": b.dims} for b in sub))
         t0 = time.perf_counter()
         f.encode_buckets(({"image": b.images, "dims": b.dims} for b in sub))
         ab[f"{label}_s"] = round(time.perf_counter() - t0, 2)
@@ -964,7 +966,11 @@ def main() -> int:
     # when its parent restarts it) before any fallback is considered.
     probe_window_s = float(os.environ.get("KEYSTONE_BENCH_PROBE_WINDOW", 1500))
     probe_interval_s = float(os.environ.get("KEYSTONE_BENCH_PROBE_INTERVAL", 120))
-    deadline = time.time() + probe_window_s
+    # The retry window counts PROBE-FAILURE time only, anchored at the
+    # first failed probe — anchoring at process start would let round-1
+    # workload runtime (hours at flagship scale) consume the whole
+    # window and leave a mid-round relay death with zero retries.
+    deadline = None
     attempt = 0
     run_rounds = 0
     while True:
@@ -982,6 +988,8 @@ def main() -> int:
         ok, info = _probe_backend(dict(os.environ))
         if not ok:
             diagnostics.append(f"probe {attempt}: {info}")
+            if deadline is None:
+                deadline = time.time() + probe_window_s
             if time.time() >= deadline:
                 diagnostics.append(
                     f"probe window exhausted after {probe_window_s:.0f}s"
@@ -989,6 +997,7 @@ def main() -> int:
                 break
             time.sleep(probe_interval_s)
             continue
+        deadline = None  # healthy again: a later outage gets a fresh window
         run_rounds += 1
         # Platform token of the PROBE_OK line itself (stdout may carry
         # init noise; the success check above tolerates it, so must we).

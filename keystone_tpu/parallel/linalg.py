@@ -530,9 +530,14 @@ def _linear_row_index(axes, mesh: Mesh):
     return idx
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=16)
 def _bcd_remat_fn(mesh: Mesh, num_epochs: int, block_size: int,
                   num_blocks: int, block_fn):
+    """Cache is keyed on ``block_fn`` IDENTITY: pass a module-level or
+    otherwise long-lived callable for cache hits — a closure re-created
+    per call recompiles every time. Bounded (not maxsize=None like the
+    shape-keyed caches above) precisely because per-call closures would
+    otherwise pin compiled executables forever."""
     axes = row_axes(mesh)
 
     def per_device(y_local, reg):

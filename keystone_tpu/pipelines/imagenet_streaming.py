@@ -85,6 +85,7 @@ class StreamingFlagship:
         # convs (the bulk of SIFT's conv work) in bf16 — passes the
         # reference's 99.5%-within-1 gate (docs/PERFORMANCE.md); default
         # decided by the bench's on-chip A/B.
+        self._sift_binning_dtype = sift_binning_dtype
         self._sift = SIFTExtractor(scale_step=c.sift_scale_step,
                                    binning_dtype=sift_binning_dtype)
         self._lcs = LCSExtractor(
@@ -192,6 +193,12 @@ class StreamingFlagship:
         cb = self.codebooks
         payload = {
             "config": self.config,
+            # The extractor precision is part of the model: features a
+            # persisted solver was trained on must reproduce on load.
+            "sift_binning_dtype": (
+                None if self._sift_binning_dtype is None
+                else np.dtype(self._sift_binning_dtype).name
+            ),
             "codebooks": {
                 "sift_pca": np.asarray(cb.sift_pca),
                 "lcs_pca": np.asarray(cb.lcs_pca),
@@ -212,7 +219,11 @@ class StreamingFlagship:
 
         with open(path, "rb") as f:
             payload = pickle.load(f)
-        fs = cls(payload["config"])
+        dtype_name = payload.get("sift_binning_dtype")
+        fs = cls(
+            payload["config"],
+            sift_binning_dtype=None if dtype_name is None else jnp.dtype(dtype_name),
+        )
         cb = payload["codebooks"]
         fs.adopt_codebooks(FlagshipCodebooks(
             sift_pca=jnp.asarray(cb["sift_pca"]),
@@ -445,7 +456,7 @@ def _synth_images(key, labels, size: int):
     return jnp.clip(jax.vmap(template)(labels) + noise, 0.0, 255.0)
 
 
-def synth_batch_fn(flagship: StreamingFlagship, size: int, num_classes: int):
+def synth_batch_fn(flagship: StreamingFlagship, size: int):
     """Returns jit(fn)(key, labels) → (N, fv_dim): generation fuses INTO
     the encode computation — one dispatch, no image crosses the link."""
 
@@ -495,7 +506,7 @@ def run_flagship_ondevice(
     t["codebook_fit_s"] = round(time.perf_counter() - t0, 1)
 
     # Phase B: device-generated encode, one dispatch per batch.
-    enc = synth_batch_fn(fs, image_size, num_classes)
+    enc = synth_batch_fn(fs, image_size)
     labels_all = rng.integers(0, num_classes, num_train + num_test)
     feats = np.empty((num_train + num_test, fs.codebooks.fv_dim), np.float32)
     t0 = time.perf_counter()

@@ -29,14 +29,16 @@ while true; do
     echo "[$(stamp)] relay port open; confirming with jax probe" >> "$LOG"
     if timeout 300 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'; print(d[0].device_kind)" >> "$LOG" 2>&1; then
       echo "[$(stamp)] TPU healthy — running full bench" >> "$LOG"
-      if timeout 7200 python bench.py > "$OUT.tmp" 2>> "$LOG"; then
+      timeout 7200 python bench.py > "$OUT.tmp" 2>> "$LOG"
+      rc=$?
+      if [ "$rc" = 0 ]; then
         mv "$OUT.tmp" "$OUT"
         echo "[$(stamp)] bench captured -> $OUT" >> "$LOG"
         exit 0
       fi
       # Bench failed (relay may have died mid-run) — keep polling; a
       # watchdog that stops on the first failure defeats its purpose.
-      echo "[$(stamp)] bench FAILED (rc=$?); continuing to poll" >> "$LOG"
+      echo "[$(stamp)] bench FAILED (rc=$rc); continuing to poll" >> "$LOG"
     else
       echo "[$(stamp)] port open but jax probe failed/hung" >> "$LOG"
     fi
