@@ -29,3 +29,17 @@ python bench.py 2>&1 | tee /tmp/bench_full.log | tail -2 || echo "bench failed (
 
 echo "=== artifacts ==="
 ls -la scripts/solver-comparisons-tpu.csv keystone_tpu/ops/learning/tpu_cost_constants.json 2>/dev/null
+
+cat <<'NOTES'
+=== r4 decision checklist (docs/NEXT_LEVERS.md) ===
+1. BENCH JSON imagenet_native.sift_binning_ab.speedup_bf16 >= 1.1
+   -> flip SIFTExtractor binning_dtype default to bfloat16 and record
+      the number in docs/PERFORMANCE.md.
+2. imagenet_fv.solve_warm_ms vs solve_dense_warm_ms -> the Woodbury
+   speedup claim; solve_path_rel_diff should be ~1e-4 or smaller.
+3. timit_wide_block.extrapolated must be false (full n=2.2M remat BCD).
+4. imagenet_flagship.top5_err_percent + end_to_end_fit_s at 50k/1000
+   classes -> the flagship at-scale row for PERFORMANCE.md.
+5. Copy the bench line into docs/measurements/ (the watchdog does this
+   automatically when it ran the capture).
+NOTES
