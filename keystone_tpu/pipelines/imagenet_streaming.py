@@ -439,6 +439,27 @@ def run_native_resolution_streaming(
         ),
         "fv_dim_combined": int(fs.codebooks.fv_dim),
     })
+
+    if cfg.test_location:
+        # Held-out evaluation, same contract as the Pipeline flagship
+        # (reference: ImageNetSiftLcsFV.scala:138-141 TEST error).
+        ds_t = load_imagenet(cfg.test_location, cfg.label_path, resize=None)
+        buckets_t = bucketize_dataset(ds_t, granularity=granularity,
+                                      max_rows=max_rows)
+        for b in buckets_t:
+            if b.images.dtype != np.uint8:
+                b.images = np.clip(b.images, 0, 255).astype(np.uint8)
+        labels_t = bucket_labels(buckets_t)
+        feats_t = fs.encode_buckets(
+            ({"image": b.images, "dims": b.dims} for b in buckets_t),
+            prefetch=2,
+        )
+        scores_t = model.apply_batch(ArrayDataset(feats_t))
+        topk_t = _TopK(min(5, cfg.num_classes)).apply_batch(scores_t)
+        t["num_test"] = int(feats_t.shape[0])
+        t["test_top5_err_percent"] = round(
+            top_k_err_percent(np.asarray(topk_t.data), labels_t), 2
+        )
     return t
 
 

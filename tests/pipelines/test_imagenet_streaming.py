@@ -118,6 +118,9 @@ def test_streaming_runner_on_reference_tar():
 
     cfg = ImageNetSiftLcsFVConfig(
         train_location="/root/reference/src/test/resources/images/imagenet",
+        # Reuse the train archive as the held-out split to exercise the
+        # test-evaluation path (5 images, same labels).
+        test_location="/root/reference/src/test/resources/images/imagenet",
         label_path="/root/reference/src/test/resources/images/imagenet-test-labels",
         desc_dim=8, vocab_size=3, num_classes=13, solver_block_size=64,
     )
@@ -126,6 +129,9 @@ def test_streaming_runner_on_reference_tar():
     assert out["fv_dim_combined"] == 2 * 8 * 2 * 3
     assert out["train_top5_err_percent"] <= 100.0
     assert np.isfinite(out["train_top5_err_percent"])
+    assert out["num_test"] == 5
+    # Test split == train split here, so held-out error must match train.
+    assert out["test_top5_err_percent"] == out["train_top5_err_percent"]
 
 
 def test_save_load_roundtrip_preserves_encoding(fitted, tmp_path):
