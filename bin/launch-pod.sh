@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Per-host pod-slice launcher — the analog of the reference's cluster
+# launch recipe (reference: EC2.md:19-29, bin/keystone-ec2.sh): run the
+# SAME command on every host of a TPU pod slice and the hosts coordinate
+# into one global device mesh. Runbook: docs/MULTIHOST.md.
+#
+# Cloud TPU pod slice (coordination auto-detected by the JAX runtime):
+#   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --worker=all \
+#     --command="cd keystone-tpu && bin/launch-pod.sh timit --num-cosines 4"
+#
+# Manual cluster (no auto-detection — set the coordination triplet):
+#   KEYSTONE_COORDINATOR=host0:9911 KEYSTONE_NUM_HOSTS=4 KEYSTONE_HOST_ID=$i \
+#     bin/launch-pod.sh <workload> [--flag value ...]
+#
+# Sanity check first (prints REHEARSAL_OK per host):
+#   bin/launch-pod.sh --rehearse
+set -euo pipefail
+
+here="$(cd "$(dirname "$0")/.." && pwd)"
+
+if [[ "${1:-}" == "--rehearse" ]]; then
+  shift
+  # Same installed-vs-source fallback run-pipeline.sh gives every other
+  # entry: an uninstalled checkout must still pass the pre-flight check.
+  if ! python -c "import keystone_tpu" 2>/dev/null; then
+    export PYTHONPATH="$here${PYTHONPATH:+:$PYTHONPATH}"
+  fi
+  exec python "$here/scripts/multihost_rehearsal.py" "$@"
+fi
+
+# run-pipeline.sh handles OMP caps + install-vs-source import; the flag
+# below makes the CLI call distributed_init() before any device use.
+export KEYSTONE_DISTRIBUTED=1
+exec "$here/bin/run-pipeline.sh" "$@"

@@ -12,9 +12,12 @@
 set -euo pipefail
 
 here="$(cd "$(dirname "$0")/.." && pwd)"
-# The package is run from source (no install step); make it importable
-# from any working directory.
-export PYTHONPATH="$here${PYTHONPATH:+:$PYTHONPATH}"
+# Prefer the installed package (`pip install -e . --no-build-isolation`,
+# see pyproject.toml); fall back to source-tree PYTHONPATH so the script
+# still works on an uninstalled checkout.
+if ! python -c "import keystone_tpu" 2>/dev/null; then
+  export PYTHONPATH="$here${PYTHONPATH:+:$PYTHONPATH}"
+fi
 
 # Same policy as the reference: min(32, physical cores / 2), because the
 # OpenMP host kernels (SIFT/GMM/ingest) oversubscribe past that.

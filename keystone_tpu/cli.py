@@ -225,6 +225,16 @@ def main(argv: Optional[list] = None) -> int:
             print(f"{name:28s} {entry[-1]}")
         return 0
 
+    # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;
+    # runbook: docs/MULTIHOST.md): join the pod's distributed runtime
+    # BEFORE any device use so every host sees the global device set.
+    import os as _os
+
+    if _os.environ.get("KEYSTONE_DISTRIBUTED"):
+        from .parallel.mesh import distributed_init
+
+        distributed_init()
+
     # Warm repeat runs: compiled XLA programs persist across processes
     # (KEYSTONE_COMPILATION_CACHE=off to disable). Enabled only on the
     # workload path so --list / --help stay jax-free.

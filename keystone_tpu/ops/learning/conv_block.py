@@ -258,7 +258,7 @@ class ConvBlockLeastSquaresEstimator(LabelEstimator):
 # Bounded: each entry pins a featurizer's device arrays + a compiled
 # executable, and the key includes a featurizer *instance* — unbounded
 # growth would leak repeatedly-built pipelines.
-@functools.lru_cache(maxsize=8)
+@linalg.mode_cached(maxsize=8)
 def _conv_bcd_step_fn(
     mesh: Mesh,
     featurizer: FusedConvFeaturizer,

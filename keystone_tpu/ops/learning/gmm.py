@@ -77,7 +77,7 @@ class GaussianMixtureModel(BatchTransformer):
         return GaussianMixtureModel(means, variances, weights)
 
 
-@jax.jit
+@linalg.mode_jit
 def _gmm_log_likelihood(x, means, variances, weights):
     """Per-sample per-cluster log-likelihood. means/vars here are (k, d)."""
     d = x.shape[1]
@@ -96,7 +96,7 @@ def _gmm_log_likelihood(x, means, variances, weights):
     return log_norm - sq_mahal
 
 
-@jax.jit
+@linalg.mode_jit
 def _gmm_posteriors(x, means, variances, weights, weight_threshold):
     llh = _gmm_log_likelihood(x, means, variances, weights)
     llh = llh - jnp.max(llh, axis=1, keepdims=True)
@@ -173,7 +173,7 @@ class GaussianMixtureModelEstimator(Estimator):
         )
 
 
-@functools.partial(jax.jit, static_argnums=(5,))
+@functools.partial(linalg.mode_jit, static_argnums=(5,))
 def _gmm_em(x, means0, vars0, weights0, var_lb, max_iterations, tol,
             weight_threshold, min_cluster_size):
     n = x.shape[0]

@@ -183,7 +183,7 @@ class KernelRidgeRegression(LabelEstimator):
         return KernelBlockLinearMapper(x, w, gamma, num_train=n, block_size=bs)
 
 
-@functools.lru_cache(maxsize=None)
+@linalg.mode_cached()
 def _krr_fit(mesh: Mesh, bs: int):
     axes = row_axes(mesh)
     ndev = row_shard_count(mesh)
@@ -268,7 +268,7 @@ class KernelBlockLinearMapper(BatchTransformer):
         return out[:m]
 
 
-@functools.lru_cache(maxsize=None)
+@linalg.mode_cached()
 def _ring_kernel_apply(mesh: Mesh):
     axes = row_axes(mesh)
     nd = mesh.shape[DATA_AXIS]

@@ -84,7 +84,7 @@ def _kmeanspp_init(x: np.ndarray, k: int, seed: int) -> np.ndarray:
     return x[centers]
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@functools.partial(linalg.mode_jit, static_argnums=(2,))
 def _lloyd(x, means0, max_iterations, tol):
     n = x.shape[0]
 

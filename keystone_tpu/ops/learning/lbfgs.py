@@ -86,7 +86,7 @@ class DenseLBFGSEstimator(LabelEstimator):
                             feature_mean=mu_a if self.fit_intercept else None)
 
 
-@functools.partial(jax.jit, static_argnums=(7, 8, 9))
+@functools.partial(linalg.mode_jit, static_argnums=(7, 8, 9))
 def _lbfgs_least_squares(x, y, mu_a, mu_b, mask, n, reg,
                          num_iterations, memory_size, tol):
     d, k = x.shape[1], y.shape[1]
@@ -174,8 +174,11 @@ def _sparse_lbfgs_host(mat, y, reg, num_iterations, memory_size, tol):
     by a callback over the most recently evaluated gradient (scipy's own
     gtol tests the inf-norm; bounding ‖g‖₂ through √(d·k)·max|gᵢ| made
     early stopping unreachable at realistic d·k). The callback raises
-    StopIteration, which scipy treats as clean termination (status 99,
-    current iterate returned).
+    StopIteration: scipy >= 1.11 treats that as clean termination
+    (status 99, current iterate returned); on older scipy the exception
+    propagates out of ``minimize``, so it is caught here and the last
+    accepted iterate (recorded by the callback before raising) is
+    returned — identical result either way.
     """
     from scipy.optimize import minimize
 
@@ -183,6 +186,7 @@ def _sparse_lbfgs_host(mat, y, reg, num_iterations, memory_size, tol):
     k = y.shape[1]
     mat_t = mat.T.tocsr()  # one-time CSC→CSR so Xᵀr is also a fast product
     last_grad_norm = [np.inf]  # written by value_and_grad, read by callback
+    last_xk = [None]  # pre-raise snapshot for the scipy<1.11 escape path
 
     def value_and_grad(w_flat):
         w = w_flat.reshape(d, k)
@@ -196,24 +200,29 @@ def _sparse_lbfgs_host(mat, y, reg, num_iterations, memory_size, tol):
         # The last gradient the line search evaluated is at (or adjacent
         # to) the accepted iterate xk — close enough for a stop test.
         if last_grad_norm[0] <= tol:
+            last_xk[0] = np.array(xk, copy=True)
             raise StopIteration
 
-    res = minimize(
-        value_and_grad,
-        np.zeros(d * k),
-        jac=True,
-        method="L-BFGS-B",
-        callback=stop_on_grad_norm,
-        options={
-            "maxiter": num_iterations,
-            "maxcor": memory_size,
-            # The callback owns the gradient stop; disable scipy's
-            # inf-norm gtol and the ftol flat-step stop (the previous
-            # device solver had neither).
-            "gtol": 0.0,
-            "ftol": 0.0,
-            # keep line-search probes bounded at huge nnz
-            "maxls": 20,
-        },
-    )
-    return res.x.reshape(d, k)
+    try:
+        res = minimize(
+            value_and_grad,
+            np.zeros(d * k),
+            jac=True,
+            method="L-BFGS-B",
+            callback=stop_on_grad_norm,
+            options={
+                "maxiter": num_iterations,
+                "maxcor": memory_size,
+                # The callback owns the gradient stop; disable scipy's
+                # inf-norm gtol and the ftol flat-step stop (the previous
+                # device solver had neither).
+                "gtol": 0.0,
+                "ftol": 0.0,
+                # keep line-search probes bounded at huge nnz
+                "maxls": 20,
+            },
+        )
+        w_flat = res.x
+    except StopIteration:  # scipy < 1.11: the callback's stop propagates
+        w_flat = last_xk[0]
+    return w_flat.reshape(d, k)

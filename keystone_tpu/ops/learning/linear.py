@@ -83,9 +83,9 @@ class LinearMapEstimator(LabelEstimator):
         if mode == "refine":
             gram_precision, refine_steps = jax.lax.Precision.DEFAULT, 2
         else:
-            # The mode's own precision, not the import-time PRECISION —
-            # bench legs flip the env var after import and must get the
-            # Gram speed they asked for.
+            # The mode's own precision, read per call — bench legs flip
+            # the env var after import and must get the Gram speed they
+            # asked for.
             gram_precision, refine_steps = linalg.precision_for_mode(mode), 0
         w, mu_a, mu_b = linalg.centered_solve_refined(
             x, y, n, self.reg or 0.0, mesh=mesh,

@@ -57,7 +57,7 @@ class LogisticRegressionEstimator(LabelEstimator):
         return LinearMapper(w)
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
+@functools.partial(linalg.mode_jit, static_argnums=(5, 6, 7, 8))
 def _lbfgs_softmax(x, y, mask, n, reg, num_classes,
                    num_iterations, memory_size, tol):
     d = x.shape[1]

@@ -49,7 +49,7 @@ class NaiveBayesEstimator(LabelEstimator):
         return NaiveBayesModel(pi, theta)
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
+@functools.partial(linalg.mode_jit, static_argnums=(3,))
 def _nb_fit(x, y, mask, num_classes, lam):
     onehot = jax.nn.one_hot(y, num_classes, dtype=x.dtype) * mask[:, None]
     class_counts = jnp.sum(onehot, axis=0)                  # (k,)

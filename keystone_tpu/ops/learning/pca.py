@@ -81,7 +81,7 @@ class BatchPCATransformer(Transformer):
                 # (zero rows stay zero under a right-multiply).
                 out = jnp.einsum(
                     "ncd,dk->nck", jnp.asarray(dataset.data["desc"]),
-                    self.components, precision=linalg.PRECISION,
+                    self.components, precision=linalg.precision(),
                 )
                 return ArrayDataset(
                     {"desc": out, "valid": dataset.data["valid"]},
@@ -92,7 +92,7 @@ class BatchPCATransformer(Transformer):
                 out = linalg.mm(x, self.components)
             else:  # uniform (n, cols, d) stack: one batched einsum on the MXU
                 out = jnp.einsum(
-                    "ncd,dk->nck", x, self.components, precision=linalg.PRECISION
+                    "ncd,dk->nck", x, self.components, precision=linalg.precision()
                 )
             return ArrayDataset(out, dataset.num_examples)
         return dataset.map(self.apply)
@@ -117,7 +117,7 @@ class PCAEstimator(Estimator, CostModel):
         return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
 
 
-@jax.jit
+@linalg.mode_jit
 def _pca_svd(x):
     mu = jnp.mean(x, axis=0)
     _, _, vt = jnp.linalg.svd(x - mu, full_matrices=False)
@@ -156,7 +156,7 @@ class DistributedPCAEstimator(Estimator, CostModel):
         return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
 
 
-@jax.jit
+@linalg.mode_jit
 def _centered_eig_components(r, sa, n):
     mu = sa / n
     cov = linalg.mm(r.T, r) - n * jnp.outer(mu, mu)
@@ -193,7 +193,7 @@ def _approximate_pca(x, l, q, seed):
     return _approx_pca_jit(x, jax.random.PRNGKey(seed), l, q)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
+@functools.partial(linalg.mode_jit, static_argnums=(2, 3))
 def _approx_pca_jit(x, key, l, q):
     mu = jnp.mean(x, axis=0)
     a = x - mu

@@ -59,7 +59,7 @@ def weighted_intercept(jlm, joint_means, w):
     (reference: BlockWeightedLeastSquares.scala:318,
     PerClassWeightedLeastSquares.scala:122 finalB)."""
     return jnp.asarray(jlm, jnp.float32) - jnp.einsum(
-        "cd,dc->c", joint_means, w, precision=linalg.PRECISION
+        "cd,dc->c", joint_means, w, precision=linalg.precision()
     )
 
 
@@ -69,10 +69,23 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         self.block_size = block_size
         self.num_iter = num_iter
         self.reg = reg
+        if not 0.0 <= mixture_weight <= 1.0:
+            raise ValueError(f"mixture_weight must be in [0, 1], got {mixture_weight}")
         self.mixture_weight = mixture_weight
         # "auto" (flop-crossover Woodbury/dense choice) | "dense" |
         # "woodbury" — the explicit forms exist for A/B measurement.
         assert solve_path in ("auto", "dense", "woodbury"), solve_path
+        # Woodbury's C diagonal divides by mw and mw·(1−mw): at either
+        # endpoint the rank-update system is singular (inf/NaN weights)
+        # where the dense path just loses its class/population term
+        # gracefully — so the endpoints always take the dense path.
+        if not 0.0 < mixture_weight < 1.0:
+            if solve_path == "woodbury":
+                raise ValueError(
+                    "solve_path='woodbury' requires 0 < mixture_weight < 1 "
+                    f"(got {mixture_weight}); use 'dense' or 'auto'"
+                )
+            solve_path = "dense"
         self.solve_path = solve_path
 
     @property
@@ -121,7 +134,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(w, block_size=bs, intercept=b)
 
 
-@functools.partial(jax.jit, static_argnums=(8, 9, 10, 11, 12))
+@functools.partial(linalg.mode_jit, static_argnums=(8, 9, 10, 11, 12))
 def _weighted_bcd(x, xs, y, onehot, offsets, counts, reg, mw,
                   num_blocks, bs, m, num_iter, force_path="auto"):
     n, d_pad = x.shape
@@ -321,6 +334,8 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         self.block_size = block_size
         self.num_iter = num_iter
         self.reg = reg
+        if not 0.0 <= mixture_weight <= 1.0:
+            raise ValueError(f"mixture_weight must be in [0, 1], got {mixture_weight}")
         self.mixture_weight = mixture_weight
 
     @property
@@ -355,7 +370,7 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(w, block_size=bs, intercept=b)
 
 
-@functools.partial(jax.jit, static_argnums=(6, 7, 8))
+@functools.partial(linalg.mode_jit, static_argnums=(6, 7, 8))
 def _pcwls_fit(x, y, onehot, counts, reg, mw, num_blocks, bs, num_iter):
     n, d_pad = x.shape
     num_classes = y.shape[1]

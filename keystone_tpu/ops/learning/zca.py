@@ -55,7 +55,7 @@ class ZCAWhitenerEstimator(Estimator):
         return ZCAWhitener(whitener, means)
 
 
-@jax.jit
+@linalg.mode_jit
 def _zca_fit(mat, eps):
     means = jnp.mean(mat, axis=0)
     centered = mat - means

@@ -54,10 +54,13 @@ _PRECISION_MODES = {
 
 
 def solver_mode() -> str:
-    """The KEYSTONE_SOLVER_PRECISION mode, read per call (so tests and
-    bench legs can flip it without re-importing the module). The global
-    ``PRECISION``/``mm`` stay fixed at import; only the exact
-    normal-equations solver consults this dynamically."""
+    """The KEYSTONE_SOLVER_PRECISION mode, read PER CALL — one lifetime
+    for the whole knob (r4 verdict item 8: an import-frozen ``PRECISION``
+    global meant flipping the env mid-process changed the exact solver
+    but silently not BCD/kernel/TSQR matmuls). Every solver-grade matmul
+    reads this at trace time, and every compiled-function cache in this
+    package keys on it (``mode_jit`` / the ``_*_fn`` factories), so a
+    flip re-traces instead of silently reusing the old precision."""
     import os
 
     name = os.environ.get("KEYSTONE_SOLVER_PRECISION", "refine").lower()
@@ -78,13 +81,71 @@ def _solver_precision() -> lax.Precision:
     return _PRECISION_MODES[solver_mode()]
 
 
-PRECISION = _solver_precision()
+def precision() -> lax.Precision:
+    """Current solver-grade matmul precision (per-call read; use inside
+    traced code for einsums that can't route through ``mm``)."""
+    return _solver_precision()
 
 
 def mm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Solver-grade matmul (precision set once at import from
-    KEYSTONE_SOLVER_PRECISION; see note above)."""
-    return jnp.matmul(a, b, precision=PRECISION)
+    """Solver-grade matmul at the CURRENT KEYSTONE_SOLVER_PRECISION mode
+    (read at trace time; mode-keyed compilation caches make the read
+    effective even after a mid-process flip)."""
+    return jnp.matmul(a, b, precision=_solver_precision())
+
+
+def mode_jit(fn=None, **jit_kwargs):
+    """``jax.jit`` whose compiled-executable cache is ALSO keyed on the
+    solver-precision mode: the wrapped function re-traces (and ``mm``
+    re-reads the mode) when KEYSTONE_SOLVER_PRECISION changes
+    mid-process. Use for any jitted function that transitively calls
+    ``mm``/``precision`` — a plain ``jax.jit`` would silently replay the
+    executable compiled under the old mode."""
+    def deco(f):
+        jitted: dict = {}
+
+        def fresh_callable():
+            # jax's jit cache keys on the underlying callable OBJECT:
+            # jax.jit(f) twice shares one trace cache, so each mode needs
+            # a distinct pass-through callable or the first mode's traces
+            # would be replayed under every later mode.
+            def g(*args, **kwargs):
+                return f(*args, **kwargs)
+
+            return g
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            mode = solver_mode()
+            if mode not in jitted:
+                jitted[mode] = jax.jit(fresh_callable(), **jit_kwargs)
+            return jitted[mode](*args, **kwargs)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def _mode_cached(maxsize=None):
+    """``functools.lru_cache`` that additionally keys on the
+    solver-precision mode, so a mid-process KEYSTONE_SOLVER_PRECISION
+    flip builds fresh compiled functions instead of replaying ones traced
+    under the old mode. Positional-args-only (every factory here is)."""
+    def deco(f):
+        @functools.lru_cache(maxsize=maxsize)
+        def cached(mode, *args):
+            return f(*args)
+
+        @functools.wraps(f)
+        def wrapper(*args):
+            return cached(solver_mode(), *args)
+
+        return wrapper
+
+    return deco
+
+
+mode_cached = _mode_cached  # public name for other modules' compiled-fn factories
 
 
 def _row_sharded(mesh: Mesh, a: jnp.ndarray) -> jnp.ndarray:
@@ -124,7 +185,7 @@ def prepare_row_sharded(a, mesh: Optional[Mesh] = None) -> jnp.ndarray:
 # a multi-second tax per solver call. Cache keyed on (mesh, static config).
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _gram_fn(mesh: Mesh):
     axes = row_axes(mesh)
 
@@ -155,7 +216,7 @@ def _gram2_raw(mesh: Mesh):
     )
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _gram2_fn(mesh: Mesh):
     return jax.jit(_gram2_raw(mesh))
 
@@ -176,7 +237,7 @@ def gram(
     return _gram2_fn(mesh)(a, b)
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _centered_solve_fused_fn(
     mesh: Mesh,
     gram_precision: lax.Precision,
@@ -206,13 +267,14 @@ def _centered_solve_fused_fn(
     contracts the error by ~cond(Gram)·ε_gram per step, so on badly
     conditioned systems the steps can stall or diverge and the refined
     weights would silently be WORSE than a HIGHEST-precision solve. The
-    true residual norm is therefore tracked across steps (one extra
-    2·n·d·k pass to measure the final iterate), the best iterate kept,
-    and — still inside the same compiled program, via ``lax.cond`` — the
-    whole solve is redone from a HIGHEST-precision Gram whenever
-    refinement failed to at least halve the initial residual. Healthy IR
-    shrinks it by orders of magnitude, so the fallback branch compiles
-    always but executes only on conditioning failures.
+    FINAL iterate's true residual norm is therefore measured (one extra
+    2·n·d·k pass) and — still inside the same compiled program, via
+    ``lax.cond`` — the whole solve is redone from a HIGHEST-precision
+    Gram whenever that final residual is not at least half the initial
+    one (r4 advisor: judging on the best norm across steps let a
+    halve-then-diverge trajectory return a bad final iterate). Healthy
+    IR shrinks the residual by orders of magnitude, so the fallback
+    branch compiles always but executes only on conditioning failures.
 
     ``gram_perturb`` is a TEST SEAM: a deterministic rank-one corruption
     of the fast Gram, letting tests exercise the guard on backends where
@@ -279,16 +341,17 @@ def _centered_solve_fused_fn(
             return r, jnp.linalg.norm(r)
 
         # Healthy IR returns the final iterate exactly as before; the
-        # tracked minimum residual norm exists only to DECIDE failure
-        # (near convergence fp32 residual norms sit at the roundoff floor
-        # and don't rank iterates reliably, so they must not pick the
-        # returned iterate on the healthy path).
+        # FINAL residual norm decides failure (r4 advisor: judging on the
+        # best norm across steps let a trajectory that halved the
+        # residual on step 1 then diverged pass the guard while the
+        # returned final iterate was worse than the unrefined solve).
+        # Near convergence fp32 residual norms sit at the roundoff floor;
+        # the `floor` term below keeps that noise from firing the guard.
         r, n0 = resid(w)
-        best_n = n0
+        final_n = n0
         for _ in range(refine_steps):
             w = w + jax.scipy.linalg.cho_solve(factor, r)
-            r, rn = resid(w)
-            best_n = jnp.minimum(rn, best_n)
+            r, final_n = resid(w)
         if not guarded:
             return w, mu_a, mu_b
 
@@ -305,7 +368,7 @@ def _centered_solve_fused_fn(
         # data, or backends where DEFAULT==HIGHEST), refinement cannot
         # halve noise and the guard must not fire — the solve is done.
         floor = 1e-5 * (jnp.linalg.norm(atb_c) + reg * jnp.linalg.norm(w))
-        failed = (best_n > 0.5 * n0) & (n0 > floor)
+        failed = (final_n > 0.5 * n0) & (n0 > floor)
         w_final = lax.cond(failed, highest_fallback, lambda _: w, None)
         return w_final, mu_a, mu_b
 
@@ -330,7 +393,7 @@ def centered_solve_refined(
     """
     mesh = mesh or get_mesh()
     if gram_precision is None:
-        gram_precision = PRECISION
+        gram_precision = _solver_precision()
     fn = _centered_solve_fused_fn(
         mesh, gram_precision, int(refine_steps), resid_precision,
         float(_TEST_GRAM_PERTURB),
@@ -376,7 +439,7 @@ def solve_spd(ata: jnp.ndarray, atb: jnp.ndarray, reg=0.0) -> jnp.ndarray:
     return jax.scipy.linalg.cho_solve(factor, atb)
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _normal_equations_fn(mesh: Mesh):
     gram_raw = _gram2_raw(mesh)
 
@@ -418,7 +481,7 @@ def tsqr_r(a: jnp.ndarray, mesh: Optional[Mesh] = None) -> jnp.ndarray:
     return _tsqr_fn(mesh)(a)
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _tsqr_fn(mesh: Mesh):
     axes = row_axes(mesh)
 
@@ -480,7 +543,7 @@ def block_coordinate_descent(
     return fn(a, y, jnp.asarray(reg, dtype=a.dtype))
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
     axes = row_axes(mesh)
 
@@ -530,7 +593,7 @@ def _linear_row_index(axes, mesh: Mesh):
     return idx
 
 
-@functools.lru_cache(maxsize=16)
+@_mode_cached(maxsize=16)
 def _bcd_remat_fn(mesh: Mesh, num_epochs: int, block_size: int,
                   num_blocks: int, block_fn):
     """Cache is keyed on ``block_fn`` IDENTITY: pass a module-level or
@@ -604,7 +667,7 @@ def block_coordinate_descent_rematerialized(
 # -------------------------------------------------------------- streaming BCD
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _bcd_stream_step_fn(mesh: Mesh):
     axes = row_axes(mesh)
 
@@ -794,7 +857,7 @@ def block_coordinate_descent_2d(
     return fn(a, y, jnp.asarray(reg, dtype=a.dtype))
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _bcd2d_fn(mesh: Mesh, num_epochs: int, block_size: int):
     raxes = row_axes(mesh)
     all_axes = raxes + (MODEL_AXIS,)
@@ -856,7 +919,7 @@ def _bcd2d_fn(mesh: Mesh, num_epochs: int, block_size: int):
     )
 
 
-@functools.lru_cache(maxsize=None)
+@_mode_cached()
 def _apply_2d_fn(mesh: Mesh):
     raxes = row_axes(mesh)
 

@@ -109,29 +109,71 @@ def make_hybrid_mesh(
                 (REPLICA_AXIS, DATA_AXIS))
 
 
-def distributed_init() -> None:
+def distributed_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
     """Multi-host entry point: initialize the JAX distributed runtime (the
-    launcher calls this once per host before any device use).
+    launcher calls this once per host before any device use; the pod-slice
+    runbook is docs/MULTIHOST.md — the analog of the reference's
+    EC2.md:19-29 cluster recipe).
 
-    ``jax.distributed.initialize`` auto-detects SLURM / GKE-TPU / Cloud-TPU
-    cluster environments on its own, so no env gate here: when a cluster
-    environment is detected, an init failure is a real error and
-    propagates; with no cluster detected (plain single host) the failed
-    auto-detection is expected and swallowed."""
+    Explicit coordination (args, or KEYSTONE_COORDINATOR /
+    KEYSTONE_NUM_HOSTS / KEYSTONE_HOST_ID env — what bin/launch-pod.sh
+    sets) takes precedence; otherwise ``jax.distributed.initialize``
+    auto-detects SLURM / GKE-TPU / Cloud-TPU cluster environments on its
+    own. When a cluster environment is detected or explicitly configured,
+    an init failure is a real error and propagates; with no cluster
+    detected (plain single host) the failed auto-detection is expected
+    and swallowed."""
     import os
+
+    coordinator_address = coordinator_address or os.environ.get("KEYSTONE_COORDINATOR")
+    if num_processes is None and os.environ.get("KEYSTONE_NUM_HOSTS"):
+        num_processes = int(os.environ["KEYSTONE_NUM_HOSTS"])
+    if process_id is None and os.environ.get("KEYSTONE_HOST_ID"):
+        process_id = int(os.environ["KEYSTONE_HOST_ID"])
+    explicit = coordinator_address is not None
+    given = {
+        "KEYSTONE_COORDINATOR": coordinator_address,
+        "KEYSTONE_NUM_HOSTS": num_processes,
+        "KEYSTONE_HOST_ID": process_id,
+    }
+    if any(v is not None for v in given.values()) and any(
+        v is None for v in given.values()
+    ):
+        # A partial manual-cluster config (any one or two of the triplet)
+        # must fail loudly with the actionable message: swallowing the
+        # host-id half would run this host uncoordinated on 1/N of the
+        # data, and the coordinator-only half would surface as an opaque
+        # version-dependent jax init error.
+        missing = sorted(k for k, v in given.items() if v is None)
+        raise ValueError(
+            f"partial manual-cluster config: {missing} unset — set all of "
+            "KEYSTONE_COORDINATOR/KEYSTONE_NUM_HOSTS/KEYSTONE_HOST_ID "
+            "(docs/MULTIHOST.md) or none"
+        )
 
     cluster_signals = (
         "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
         "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
     )
-    in_cluster = any(v in os.environ for v in cluster_signals)
+    in_cluster = explicit or any(v in os.environ for v in cluster_signals)
     try:
         if jax.distributed.is_initialized():
             return
     except AttributeError:
         pass  # older jax without is_initialized
     try:
-        jax.distributed.initialize()
+        if explicit:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        else:
+            jax.distributed.initialize()
     except Exception:
         # A JaxRuntimeError here subclasses RuntimeError, so no blanket
         # RuntimeError catch: in a cluster an init failure must propagate —

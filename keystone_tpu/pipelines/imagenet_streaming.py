@@ -112,10 +112,13 @@ class StreamingFlagship:
         lcs_desc, lcs_valid = self._lcs.apply_arrays_masked(images_f32, dims)
         return (sift_desc, sift_valid), (lcs_desc, lcs_valid)
 
-    def _sample_descriptors(self, images, dims, per_image: int):
+    def _sample_descriptors(self, images, dims, per_image: int, key):
         """Fused featurize + on-device uniform sample of ``per_image``
         valid descriptors per image per branch (Gumbel top-k over the
-        validity mask — no host-side ragged indexing)."""
+        validity mask — no host-side ragged indexing). ``key`` is
+        per-bucket (r4 advisor: deriving it from the fixed config seed in
+        here made every bucket of a given shape pick descriptors at
+        identical image positions — a correlated codebook sample)."""
         x = images.astype(jnp.float32)
         (sd, sv), (ld, lv) = self._branch_descriptors(x, dims)
 
@@ -129,7 +132,6 @@ class StreamingFlagship:
             ok = jnp.take_along_axis(valid, idx, axis=1)    # guards npad<take
             return picked.reshape(n * take, d), ok.reshape(n * take)
 
-        key = jax.random.PRNGKey(self.config.seed)
         ks, kl = jax.random.split(key)
         s_flat, s_ok = sample(sd, sv, ks)
         l_flat, l_ok = sample(ld, lv, kl)
@@ -146,10 +148,13 @@ class StreamingFlagship:
         c = self.config
         per_image = per_image or 64
         s_parts, l_parts = [], []
-        for b in sample_buckets:
+        base_key = jax.random.PRNGKey(c.seed)
+        for i, b in enumerate(sample_buckets):
             img = jax.device_put(np.asarray(b["image"]))
             dims = jax.device_put(np.asarray(b["dims"]))
-            s_flat, s_ok, l_flat, l_ok = self._sample_jit(img, dims, per_image)
+            s_flat, s_ok, l_flat, l_ok = self._sample_jit(
+                img, dims, per_image, jax.random.fold_in(base_key, i)
+            )
             s_parts.append(np.asarray(s_flat)[np.asarray(s_ok) > 0])
             l_parts.append(np.asarray(l_flat)[np.asarray(l_ok) > 0])
         s_samples = jnp.asarray(np.concatenate(s_parts, axis=0))

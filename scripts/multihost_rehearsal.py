@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Multi-host rehearsal: one process per host, real cross-process collectives.
+
+The executable sanity check of the multi-host launch path
+(docs/MULTIHOST.md; the reference's cluster recipe analog —
+/root/reference/EC2.md:19-29). Each process:
+
+  1. calls ``distributed_init`` (explicit coordinator, or auto-detect on a
+     real pod slice),
+  2. builds the global 1-D data mesh over every device of every host,
+  3. assembles a process-local shard of a known global matrix,
+  4. runs ``linalg.gram`` — the shard_map + psum allreduce under every
+     exact solver — so the collective actually crosses process boundaries,
+  5. checks the result against the closed form and prints
+     ``REHEARSAL_OK rel_err=...``.
+
+On a TPU pod slice (one process per host, auto-detected coordination):
+    python scripts/multihost_rehearsal.py
+
+As the 2-process CPU rehearsal (what tests/parallel/test_multihost.py
+runs; 4 virtual devices per process → an 8-device global mesh):
+    python scripts/multihost_rehearsal.py \
+        --coordinator 127.0.0.1:9911 --num-hosts 2 --host-id $i \
+        --virtual-devices 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (omit on a real pod: auto-detect)")
+    ap.add_argument("--num-hosts", type=int, default=None)
+    ap.add_argument("--host-id", type=int, default=None)
+    ap.add_argument("--virtual-devices", type=int, default=0,
+                    help=">0: CPU rehearsal with this many virtual devices per process")
+    args = ap.parse_args()
+
+    if args.virtual_devices:
+        # Must land before any backend init, and the TPU dial-trigger env
+        # must not leak into a CPU rehearsal process.
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags +
+                f" --xla_force_host_platform_device_count={args.virtual_devices}"
+            ).strip()
+
+    from keystone_tpu.parallel.mesh import distributed_init, make_mesh
+
+    distributed_init(args.coordinator, args.num_hosts, args.host_id)
+
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (backend init ordering)
+    import numpy as np
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.parallel import linalg
+
+    n_local = len(jax.local_devices())
+    n_global = len(jax.devices())
+    print(f"host {jax.process_index()}/{jax.process_count()}: "
+          f"{n_local} local / {n_global} global devices", flush=True)
+    if args.num_hosts is not None:
+        assert jax.process_count() == args.num_hosts, (
+            jax.process_count(), args.num_hosts)
+        assert n_global == n_local * args.num_hosts, (n_global, n_local)
+
+    mesh = make_mesh(devices=jax.devices())
+
+    # Known global matrix, assembled shard-by-shard on whichever process
+    # owns the shard (no single host ever holds the whole thing — the
+    # multi-host data layout of SURVEY §2.9).
+    n, d = 8 * n_global, 16
+    full = np.arange(n * d, dtype=np.float32).reshape(n, d) % 23 / 23.0
+    sharding = NamedSharding(mesh, P("data", None))
+    x = jax.make_array_from_callback((n, d), sharding, lambda idx: full[idx])
+
+    ata, _ = linalg.gram(x, mesh=mesh)  # shard_map + psum across processes
+    got = np.asarray(ata.addressable_data(0), np.float64)
+    want = full.T.astype(np.float64) @ full
+    rel = float(np.linalg.norm(got - want) / np.linalg.norm(want))
+    assert rel < 1e-5, f"cross-process gram wrong: rel_err={rel:.3e}"
+    print(f"REHEARSAL_OK rel_err={rel:.2e}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -343,3 +343,31 @@ def test_sparse_lbfgs_at_amazon_feature_width():
     base = np.mean(y[:4096] ** 2)
     mse = np.mean((pred - y[:4096]) ** 2)
     assert mse < 0.5 * base, f"mse {mse} vs baseline {base}"
+
+
+def test_weighted_mixture_weight_endpoints_guarded():
+    """r4 advisor: Woodbury's C diagonal divides by mw and mw(1-mw), so
+    the endpoints must force the dense path (auto) or raise (explicit),
+    and out-of-range values must raise in BOTH weighted estimators."""
+    import pytest
+
+    from keystone_tpu.ops.learning.weighted import (
+        BlockWeightedLeastSquaresEstimator,
+        PerClassWeightedLeastSquaresEstimator,
+    )
+
+    for mw in (0.0, 1.0):
+        est = BlockWeightedLeastSquaresEstimator(
+            16, num_iter=1, reg=0.1, mixture_weight=mw)
+        assert est.solve_path == "dense"
+        with pytest.raises(ValueError, match="woodbury"):
+            BlockWeightedLeastSquaresEstimator(
+                16, num_iter=1, reg=0.1, mixture_weight=mw,
+                solve_path="woodbury")
+    for bad in (-0.1, 1.5):
+        with pytest.raises(ValueError, match="mixture_weight"):
+            BlockWeightedLeastSquaresEstimator(
+                16, num_iter=1, reg=0.1, mixture_weight=bad)
+        with pytest.raises(ValueError, match="mixture_weight"):
+            PerClassWeightedLeastSquaresEstimator(
+                16, num_iter=1, reg=0.1, mixture_weight=bad)

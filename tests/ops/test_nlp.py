@@ -154,9 +154,60 @@ def test_corenlp_extractor_entity_tagging():
 
     ext = CoreNLPFeatureExtractor(orders=[1])
     out = ext.apply("Yesterday we visited Paris together.")
-    assert ENTITY_TAG in out          # mid-sentence proper noun replaced
+    assert "LOCATION" in out          # gazetteer proper noun typed
     assert "paris" not in out
     assert "yesterday" in out         # sentence-initial word kept
+    # Unknown mid-sentence proper noun falls back to the generic tag.
+    out2 = ext.apply("Yesterday we visited Qozvix together.")
+    assert ENTITY_TAG in out2 and "qozvix" not in out2
+
+
+def test_corenlp_reference_suite_parity():
+    """The reference's OWN committed test expectations
+    (CoreNLPFeatureExtractorSuite.scala:10-63): lemmatization of its five
+    words, entity-type substitution on its exact sentence, and the
+    1-2-3-gram emission contract."""
+    from keystone_tpu.ops.nlp.corenlp import CoreNLPFeatureExtractor
+
+    ext = CoreNLPFeatureExtractor(orders=[1, 2, 3])
+
+    tokens = set(ext.apply("jumping snakes lakes oceans hunted"))
+    for lemma in ("jump", "snake", "lake", "ocean", "hunt"):
+        assert lemma in tokens, lemma
+    for raw in ("jumping", "snakes", "lakes", "oceans", "hunted"):
+        assert raw not in tokens, raw
+
+    tokens = set(ext.apply("John likes cake and he lives in Florida"))
+    assert "PERSON" in tokens and "LOCATION" in tokens
+    assert "john" not in tokens and "florida" not in tokens
+
+    tokens = set(ext.apply("a b c d"))
+    for gram in ("a", "b", "c", "d", "a b", "b c", "c d", "a b c", "b c d"):
+        assert gram in tokens, gram
+
+
+def test_corenlp_lemma_gold_fixture_agreement():
+    """r4 verdict item 9: measured agreement against the committed lemma
+    gold (tests/fixtures/corenlp_lemma_gold.json — curated to mirror
+    Stanford Morphology / CoreNLP lemmatizer behavior on common English
+    inflections, anchored on the reference suite's committed
+    expectations; CoreNLP itself — a JVM dependency — cannot run in this
+    environment, so the gold is hand-curated with that provenance stated
+    rather than machine-generated). Target: >= 95% agreement."""
+    import json
+    import os
+
+    from keystone_tpu.ops.nlp.corenlp import lemmatize
+
+    path = os.path.join(os.path.dirname(__file__), "..", "fixtures",
+                        "corenlp_lemma_gold.json")
+    with open(path) as f:
+        gold = json.load(f)
+    assert len(gold) >= 300  # a real corpus-scale sample, not a toy list
+    misses = {w: (lemmatize(w), g) for w, g in gold.items()
+              if lemmatize(w) != g}
+    agreement = 1.0 - len(misses) / len(gold)
+    assert agreement >= 0.95, (agreement, dict(sorted(misses.items())[:20]))
 
 
 def test_lemmatize_rules():
@@ -167,3 +218,16 @@ def test_lemmatize_rules():
     assert lemmatize("children") == "child"
     assert lemmatize("walked") == "walk"
     assert lemmatize("glasses") == "glass"
+
+
+def test_corenlp_ambiguous_sentence_initial_names_not_tagged():
+    """'Mark the boxes carefully.' — a gazetteer name that is also a
+    common English word must NOT be entity-tagged on sentence-initial
+    capitalization alone (mid-sentence capitalization still tags it)."""
+    from keystone_tpu.ops.nlp.corenlp import CoreNLPFeatureExtractor
+
+    ext = CoreNLPFeatureExtractor(orders=[1])
+    out = ext.apply("Mark the boxes carefully.")
+    assert "mark" in out and "PERSON" not in out
+    out2 = ext.apply("We told Mark about it.")
+    assert "PERSON" in out2 and "mark" not in out2

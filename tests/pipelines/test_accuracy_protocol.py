@@ -1,0 +1,125 @@
+"""Accuracy-parity protocol, executable part (r4 verdict item 3).
+
+The full flagship path — real-JPEG ingest → SIFT/LCS → PCA/GMM/FV →
+weighted solve → top-k → evaluator — runs end-to-end on the reference's
+OWN committed archives (reference: src/test/resources/images/imagenet/
+n15075141.tar + imagenet-test-labels, images/voc/voctest.tar +
+voclabels.csv — the same fixtures ImageNetLoaderSuite/VOCLoaderSuite
+use), and the encoded Fisher-vector rows for the real ImageNet JPEGs are
+pinned as committed regression goldens. The protocol for full-scale
+"equal top-5" is docs/ACCURACY.md; these tests are its every-CI
+instantiation at committed-fixture scale.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+REF = "/root/reference/src/test/resources"
+FIXTURES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "fixtures")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference resources not present"
+)
+
+
+def _ref(*parts):
+    return os.path.join(REF, *parts)
+
+
+def test_imagenet_real_tar_flagship_end_to_end():
+    """The flagship driver on the reference's real ImageNet archive:
+    5 real JPEGs of synset n15075141 (label 12). Exercises real-JPEG
+    decode through the full dual-branch encode + 13-class weighted solve
+    + top-5; with train == test the true class must be in every top-5."""
+    from keystone_tpu.pipelines.imagenet import ImageNetSiftLcsFVConfig, run
+
+    results = run(ImageNetSiftLcsFVConfig(
+        train_location=_ref("images", "imagenet"),
+        test_location=_ref("images", "imagenet"),
+        label_path=_ref("images", "imagenet-test-labels"),
+        desc_dim=8,
+        vocab_size=2,
+        num_pca_samples=400,
+        num_gmm_samples=400,
+        num_classes=13,
+        image_size=(96, 96),
+        solver_block_size=32,
+        lcs_border=16,
+        reg=1e-3,
+    ))
+    assert results["test_error_percent"] == 0.0, results["test_error_percent"]
+
+
+def test_voc_real_tar_fit_and_score():
+    """The VOC SIFT+Fisher driver on the reference's real voctest.tar
+    (10 real photos, 9 distinct classes, one multi-label image — the
+    VOCLoaderSuite fixture): fit-and-score must separate the training
+    images nearly perfectly at committed-fixture scale. MAP here is a
+    REGRESSION number: a drop means the image path's numerics moved."""
+    from keystone_tpu.pipelines.voc import SIFTFisherConfig, run
+
+    results = run(SIFTFisherConfig(
+        train_location=_ref("images", "voc"),
+        test_location=_ref("images", "voc"),
+        label_path=_ref("images", "voclabels.csv"),
+        desc_dim=8,
+        vocab_size=3,
+        num_pca_samples=800,
+        num_gmm_samples=800,
+        image_size=(96, 96),
+        solver_block_size=32,
+        reg=1e-3,
+    ))
+    # train == test on 10 images with huge FV width: near-memorization on
+    # every class that HAS positives. 11 of the 20 VOC classes are absent
+    # from the fixture and contribute AP 0, so the all-class MAP tops out
+    # at 9/20 = 0.45 — evaluate over the present classes.
+    aps = np.asarray(results["per_class_ap"])
+    present = aps > 0.0
+    assert present.sum() == 9, aps
+    assert float(aps[present].mean()) >= 0.9, aps
+    assert results["test_map"] >= 0.4, results
+
+
+def test_imagenet_real_fv_rows_match_committed_golden():
+    """Committed regression golden: the fused streaming encoder's FV rows
+    for the 5 REAL ImageNet JPEGs under a fixed seed/config
+    (tests/fixtures/imagenet_real_fv_golden.json, generated on the
+    8-virtual-device CPU mesh). Tolerances are direction+magnitude (not
+    bitwise) so a TPU run passes while a real numeric regression fails —
+    the tolerance style of the reference's VLFeatSuite.scala:47-52."""
+    from keystone_tpu.data.buckets import bucketize_images
+    from keystone_tpu.data.loaders.imagenet import load_imagenet
+    from keystone_tpu.pipelines.imagenet import ImageNetSiftLcsFVConfig
+    from keystone_tpu.pipelines.imagenet_streaming import StreamingFlagship
+
+    ds = load_imagenet(
+        _ref("images", "imagenet"), _ref("images", "imagenet-test-labels"),
+        resize=(128, 128),  # one static shape -> one bucket -> stable order
+    )
+    recs = sorted(ds.collect(), key=lambda r: r["filename"])
+    buckets = bucketize_images(recs, granularity=32, max_rows=8)
+    assert len(buckets) == 1
+
+    fs = StreamingFlagship(ImageNetSiftLcsFVConfig(
+        desc_dim=8, vocab_size=2, seed=0
+    ))
+    fs.fit_codebooks(
+        ({"image": b.images, "dims": b.dims} for b in buckets), per_image=64
+    )
+    rows = np.asarray(fs.encode_buckets(
+        ({"image": b.images, "dims": b.dims} for b in buckets)
+    ), np.float64)
+
+    path = os.path.join(FIXTURES, "imagenet_real_fv_golden.json")
+    golden = np.asarray(json.load(open(path))["rows"], np.float64)
+    assert rows.shape == golden.shape, (rows.shape, golden.shape)
+    for i, (got, want) in enumerate(zip(rows, golden)):
+        cos = float(got @ want / (np.linalg.norm(got) * np.linalg.norm(want)))
+        norm_ratio = float(np.linalg.norm(got) / np.linalg.norm(want))
+        assert cos > 0.99, (i, cos)
+        assert 0.95 < norm_ratio < 1.05, (i, norm_ratio)

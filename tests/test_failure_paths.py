@@ -175,43 +175,79 @@ def test_streaming_threshold_env_override(monkeypatch):
     assert block_mod._host_streaming_threshold_bytes() == 123
 
 
-def test_solver_precision_env_knob():
-    """KEYSTONE_SOLVER_PRECISION resolves at import; invalid values raise
+def test_solver_precision_env_knob(monkeypatch):
+    """KEYSTONE_SOLVER_PRECISION is read per call; invalid values raise
     (a typo'd 'fast mode' must not silently run 6-pass)."""
-    import subprocess
-    import sys
+    import jax.numpy as jnp
 
-    code = (
-        "import os; os.environ['JAX_PLATFORMS']='cpu';"
-        "from keystone_tpu.parallel import linalg; print(linalg.PRECISION)"
-    )
-    for value, expect in (("default", "DEFAULT"), ("highest", "HIGHEST")):
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            env={**__import__("os").environ, "KEYSTONE_SOLVER_PRECISION": value},
-            capture_output=True, text=True, timeout=120,
-        )
-        assert expect in out.stdout, (value, out.stdout, out.stderr)
-    bad = subprocess.run(
-        [sys.executable, "-c", code],
-        env={**__import__("os").environ, "KEYSTONE_SOLVER_PRECISION": "bf16"},
-        capture_output=True, text=True, timeout=120,
-    )
-    assert bad.returncode != 0 and "KEYSTONE_SOLVER_PRECISION" in bad.stderr
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "default")
+    assert linalg.precision() == jax.lax.Precision.DEFAULT
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "highest")
+    assert linalg.precision() == jax.lax.Precision.HIGHEST
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "bf16")
+    with pytest.raises(ValueError, match="KEYSTONE_SOLVER_PRECISION"):
+        linalg.solver_mode()
     # Unset → the shipped default: refine mode for the exact solver,
     # HIGHEST for every other solver-grade matmul.
-    env = {k: v for k, v in __import__("os").environ.items()
-           if k != "KEYSTONE_SOLVER_PRECISION"}
-    out = subprocess.run(
-        [sys.executable, "-c",
-         "import os; os.environ['JAX_PLATFORMS']='cpu';"
-         "from keystone_tpu.parallel import linalg;"
-         "print(linalg.solver_mode(), linalg.PRECISION)"],
-        env=env, capture_output=True, text=True, timeout=120,
-    )
-    assert "refine" in out.stdout and "HIGHEST" in out.stdout, (
-        out.stdout, out.stderr,
-    )
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    assert linalg.solver_mode() == "refine"
+    assert linalg.precision() == jax.lax.Precision.HIGHEST
+
+
+def test_solver_precision_flips_mid_process(monkeypatch):
+    """r4 verdict item 8 'Done' criterion: one lifetime for the precision
+    knob. Flipping KEYSTONE_SOLVER_PRECISION mid-process must flow into
+    (a) ``mm`` itself, (b) the lru-cached compiled-fn factories (mode in
+    the cache key — distinct executables per mode, cache hits within a
+    mode), and (c) ``mode_jit``-wrapped solver entry points (re-trace on
+    flip). Verified structurally via the lowered HLO (numeric checks
+    can't see precision on the CPU backend, where every matmul is fp32)."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel import linalg
+    from keystone_tpu.parallel.mesh import make_mesh
+
+    a = jnp.ones((8, 4))
+    b = jnp.ones((4, 4))
+
+    # (a) mm reads the mode at trace time. Fresh jit instances per lower:
+    # a SINGLE jax.jit object would replay its cached trace across the
+    # flip — which is exactly why every jitted mm caller must go through
+    # mode_jit (part c) rather than bare jax.jit.
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "highest")
+    assert "HIGHEST" in jax.jit(lambda p, q: linalg.mm(p, q)).lower(a, b).as_text().upper()
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "default")
+    assert "HIGHEST" not in jax.jit(lambda p, q: linalg.mm(p, q)).lower(a, b).as_text().upper()
+
+    # (b) factory caches key on the mode: distinct per mode, hit within.
+    mesh = make_mesh(devices=jax.devices()[:8])
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "highest")
+    f_hi = linalg._gram_fn(mesh)
+    assert "HIGHEST" in f_hi.lower(a).as_text().upper()
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "default")
+    f_def = linalg._gram_fn(mesh)
+    assert f_def is not f_hi
+    assert "HIGHEST" not in f_def.lower(a).as_text().upper()
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "highest")
+    assert linalg._gram_fn(mesh) is f_hi
+
+    # (c) mode_jit re-traces on a flip (and caches within a mode).
+    traces = []
+
+    @linalg.mode_jit
+    def probe(x):
+        traces.append(linalg.solver_mode())
+        return linalg.mm(x, x)
+
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "highest")
+    probe(b)
+    probe(b)
+    assert traces == ["highest"]
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "default")
+    probe(b)
+    assert traces == ["highest", "default"]
 
 
 def test_persistent_compilation_cache_knob(tmp_path, monkeypatch):
@@ -283,14 +319,14 @@ def test_bench_parent_cpu_probe_short_circuits(monkeypatch, capsys, tmp_path):
 
 
 def test_bench_parent_hung_probe_falls_back(monkeypatch, capsys, tmp_path):
-    """Probe window exhausted (set to 0 here) → CPU fallback with the
-    hung-probe and window-exhausted diagnostics recorded."""
+    """Deadline exhausted (set to 0 here) → the insurance leg's results
+    stand, with the hung-probe and deadline diagnostics recorded."""
     import json
 
     import bench
 
     monkeypatch.chdir(tmp_path)
-    monkeypatch.setenv("KEYSTONE_BENCH_PROBE_WINDOW", "0")
+    monkeypatch.setenv("KEYSTONE_BENCH_DEADLINE", "0")
 
     monkeypatch.setattr(bench, "_probe_backend",
                         lambda env, timeout_s=120: (False, "backend probe hung >120s"))
@@ -300,7 +336,89 @@ def test_bench_parent_hung_probe_falls_back(monkeypatch, capsys, tmp_path):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["small_shapes"] is True
     assert any("hung" in d for d in out["diagnostics"])
-    assert any("window exhausted" in d for d in out["diagnostics"])
+    assert any("deadline exhausted" in d for d in out["diagnostics"])
+
+
+def test_bench_parent_insurance_runs_before_waiting(monkeypatch, capsys, tmp_path):
+    """r4 verdict item 1: on a failed first probe the CPU insurance leg
+    runs BEFORE any probe retries/sleeps, so the artifact exists no
+    matter when an external kill lands."""
+    import json
+
+    import bench
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("KEYSTONE_BENCH_DEADLINE", "3600")
+
+    order = []
+
+    def probe_then_cpu(env, timeout_s=120):
+        # Hung first probe (forces insurance), then a healthy host-cpu
+        # probe so the waiting loop terminates deterministically.
+        order.append("probe")
+        if order.count("probe") == 1:
+            return False, "backend probe hung >120s"
+        return True, "PROBE_OK cpu 8"
+
+    inner = _fake_child_factory("cpu")
+
+    def recording_child(env, small, timeout_s, workload=None):
+        order.append("insurance" if small else f"full:{workload}")
+        # The insurance child env must be dial-proof and virtual-meshed.
+        if small:
+            assert "PALLAS_AXON_POOL_IPS" not in env
+            assert env["JAX_PLATFORMS"] == "cpu"
+            assert "xla_force_host_platform_device_count" in env["XLA_FLAGS"]
+            assert env["KEYSTONE_BENCH_CHILD_PARTIAL"].endswith("BENCH_PARTIAL.json")
+        return inner(env, small, timeout_s, workload)
+
+    monkeypatch.setattr(bench, "_probe_backend", probe_then_cpu)
+    monkeypatch.setattr(bench, "_run_child", recording_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert order[0] == "probe"
+    assert order[1] == "insurance"  # before any retry probe
+    assert out["small_shapes"] is True
+
+
+def test_bench_dead_relay_yields_artifact(tmp_path):
+    """r4 verdict item 1 'Done' criterion, run for real: with the relay
+    dead (dial target blackholed), a deadline-bounded `python bench.py`
+    prints one JSON line with a measured headline AND leaves a fresh
+    finalized BENCH_PARTIAL.json — well inside `timeout 1200`."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import time as _time
+
+    env = dict(os.environ)
+    # The axon sitecustomize dials the relay whenever this is set; a
+    # non-routable target reproduces the dead-relay hang (or an instant
+    # failure — either way the probe must fail and insurance must run).
+    env["PALLAS_AXON_POOL_IPS"] = "10.255.255.1"
+    env.pop("JAX_PLATFORMS", None)  # conftest forces cpu; the bench probe must see the (dead) accelerator path
+    env["KEYSTONE_BENCH_DEADLINE"] = "150"
+    env["KEYSTONE_BENCH_PROBE_TIMEOUT"] = "10"
+    env["KEYSTONE_BENCH_PROBE_INTERVAL"] = "2"
+    env["KEYSTONE_BENCH_WORKLOADS"] = "timit_exact"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    t0 = _time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=420,
+    )
+    wall = _time.monotonic() - t0
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, proc.stdout[-2000:]
+    out = json.loads(lines[-1])
+    assert out["value"] is not None  # insurance headline actually measured
+    assert out["platform"] == "cpu"
+    partial = json.loads((tmp_path / "BENCH_PARTIAL.json").read_text())
+    assert partial["partial"] is False
+    assert wall < 400, wall
 
 
 def test_bench_parent_probe_retries_within_window(monkeypatch, capsys, tmp_path):
@@ -312,7 +430,7 @@ def test_bench_parent_probe_retries_within_window(monkeypatch, capsys, tmp_path)
     import bench
 
     monkeypatch.chdir(tmp_path)
-    monkeypatch.setenv("KEYSTONE_BENCH_PROBE_WINDOW", "3600")
+    monkeypatch.setenv("KEYSTONE_BENCH_DEADLINE", "3600")
 
     calls = []
 
@@ -416,3 +534,26 @@ def test_bench_extra_legs_set_precision_modes(monkeypatch, capsys, tmp_path):
     # the two extra legs are the only calls that set the knob
     assert modes.count("highest") == 1 and modes.count("default") == 1
     assert modes[-2:] == ["highest", "default"]
+
+
+def test_dryrun_perturbation_makes_legs_fail():
+    """r4 verdict item 4 'Done' criterion: a seeded numeric perturbation
+    must make dryrun legs report non-ok — proving the MULTICHIP artifact
+    certifies numeric correctness, not just that sharded code executes."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    env["KEYSTONE_DRYRUN_PERTURB"] = "1000.0"
+    proc = subprocess.run(
+        [sys.executable, "-c", "import __graft_entry__ as g; g.dryrun_multichip(2)"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode != 0, proc.stdout[-1500:]
+    out = proc.stdout + proc.stderr
+    assert "DRYRUN_LEGS" in out, out[-1500:]
+    assert out.count("FAIL") >= 5, out[-1500:]  # most legs carry invariants
+    assert "rel_err" in out, out[-1500:]
