@@ -96,3 +96,39 @@ def test_packaging_console_entry_point_resolves():
     pkg_data = cfg["tool"]["setuptools"]["package-data"]
     assert "src/*.cpp" in pkg_data["keystone_tpu.native"]
     assert "tpu_cost_constants.json" in pkg_data["keystone_tpu.ops.learning"]
+
+
+def test_cli_distributed_hook_calls_init_before_workload(monkeypatch, capsys):
+    """KEYSTONE_DISTRIBUTED=1 (what bin/launch-pod.sh exports) must make
+    the CLI call distributed_init BEFORE the workload runs — on a real
+    pod, touching devices before joining the distributed runtime is the
+    regression this pins, so the ORDER is asserted, not just the call."""
+    from keystone_tpu.parallel import mesh as mesh_mod
+    from keystone_tpu.pipelines import mnist_random_fft as wl_mod
+
+    order = []
+    monkeypatch.setattr(mesh_mod, "distributed_init",
+                        lambda *a, **k: order.append("init"))
+    monkeypatch.setattr(wl_mod, "run",
+                        lambda config: order.append("workload") or {})
+    monkeypatch.setenv("KEYSTONE_DISTRIBUTED", "1")
+    rc = main(["mnist-random-fft", "--num-ffts", "1", "--block-size", "256"])
+    assert rc == 0 and order == ["init", "workload"]
+    capsys.readouterr()
+
+
+def test_launch_pod_rehearse_smoke():
+    """bin/launch-pod.sh --rehearse resolves the rehearsal script with the
+    installed-vs-source import fallback (argparse --help exits 0 without
+    touching any backend)."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
+    proc = subprocess.run(
+        [os.path.join(repo, "bin", "launch-pod.sh"), "--rehearse", "--help"],
+        capture_output=True, text=True, timeout=120, env=env, cwd="/tmp",
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "coordinator" in proc.stdout
