@@ -34,8 +34,12 @@ void error_exit(j_common_ptr cinfo) {
 void silent_output(j_common_ptr) {}
 
 // Decode one JPEG into an RGB byte buffer. Returns false on any error.
+// min_x/min_y (>0): the caller's resample target — decode is DCT-domain
+// scaled to the smallest 1/2^k size still >= the target in both dims, so
+// IDCT + memory traffic scale with output pixels, not source pixels (the
+// bilinear resample that follows eats the remaining gap). 0 disables.
 bool decode_rgb(const unsigned char* buf, long long len, std::vector<unsigned char>& rgb,
-                int& width, int& height) {
+                int& width, int& height, int min_x, int min_y) {
   jpeg_decompress_struct cinfo;
   ErrorMgr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
@@ -52,6 +56,18 @@ bool decode_rgb(const unsigned char* buf, long long len, std::vector<unsigned ch
     return false;
   }
   cinfo.out_color_space = JCS_RGB;
+  if (min_x > 0 && min_y > 0) {
+    // ceil division: libjpeg's scaled output is ceil(dim/denom)
+    // (jdiv_round_up), so floor would reject valid just-under-2^k sizes
+    for (int d = 8; d >= 2; d /= 2) {
+      if ((int)((cinfo.image_height + d - 1) / d) >= min_x &&
+          (int)((cinfo.image_width + d - 1) / d) >= min_y) {
+        cinfo.scale_num = 1;
+        cinfo.scale_denom = d;
+        break;
+      }
+    }
+  }
   jpeg_start_decompress(&cinfo);
   width = cinfo.output_width;
   height = cinfo.output_height;
@@ -111,7 +127,7 @@ void ks_decode_jpeg_batch(const unsigned char* const* bufs,
     ok[i] = 0;
     float* dst = out + (size_t)i * out_x * out_y * 3;
     std::memset(dst, 0, sizeof(float) * (size_t)out_x * out_y * 3);
-    if (!decode_rgb(bufs[i], lens[i], rgb, w, h)) continue;
+    if (!decode_rgb(bufs[i], lens[i], rgb, w, h, out_x, out_y)) continue;
     // scale factors map output pixel centers into source coordinates
     const float sx = out_x > 1 ? (float)(h - 1) / (float)(out_x - 1) : 0.0f;
     const float sy = out_y > 1 ? (float)(w - 1) / (float)(out_y - 1) : 0.0f;

@@ -158,3 +158,31 @@ def test_loader_native_path_matches_pil_path(tmp_path):
         assert a["image"].shape == b["image"].shape
         # different resamplers (point-bilinear vs PIL filter): loose bound
         assert np.mean(np.abs(a["image"] - b["image"])) < 20.0
+
+
+def test_native_jpeg_scaled_decode_matches_pil_resize():
+    """Targets ≤ source/2 take the DCT-domain scaled-decode path
+    (decode.cpp decode_rgb min_x/min_y): output must still track a
+    full-decode + resize reference on smooth content."""
+    pytest.importorskip("PIL")
+    import io
+
+    from PIL import Image as PILImage
+
+    from keystone_tpu.data.loaders.archive import native_decode_batch
+
+    # smooth gradient: decoder-scaling differences show as small shifts,
+    # not structural error. Asymmetric outer product → three DISTINCT
+    # channels, so a BGR/RGB channel-order regression fails the check.
+    x = np.linspace(0, 255, 320)
+    arr = np.clip(np.add.outer(x, 2 * x) / 3, 0, 255).astype(np.uint8)
+    arr = np.stack([arr, arr[::-1], arr.T], axis=-1)
+    raw = _jpeg_bytes(arr)
+
+    out, ok = native_decode_batch([raw], resize=(64, 64))  # 320/64 -> denom 4
+    assert ok[0]
+    ref = PILImage.open(io.BytesIO(raw)).convert("RGB").resize(
+        (64, 64), PILImage.BILINEAR
+    )
+    ref_bgr = np.asarray(ref, np.float32)[..., ::-1]
+    assert np.mean(np.abs(out[0] - ref_bgr)) < 3.0, np.mean(np.abs(out[0] - ref_bgr))
