@@ -1,0 +1,44 @@
+"""keystone_tpu — a TPU-native ML pipeline framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of KeystoneML
+(the reference at /root/reference): declaratively chained featurization +
+solver pipelines over a whole-pipeline optimizer, executing as sharded XLA
+computations on TPU device meshes instead of Spark RDD jobs.
+
+Top-level exports resolve lazily (PEP 562) so tooling paths — the CLI's
+``--list``, config parsing — do not pay the jax import cost.
+"""
+
+from typing import Any
+
+__version__ = "0.1.0"
+
+_EXPORTS = {
+    "ArrayDataset": "keystone_tpu.data.dataset",
+    "Dataset": "keystone_tpu.data.dataset",
+    "ObjectDataset": "keystone_tpu.data.dataset",
+    "Transformer": "keystone_tpu.workflow",
+    "Estimator": "keystone_tpu.workflow",
+    "LabelEstimator": "keystone_tpu.workflow",
+    "Pipeline": "keystone_tpu.workflow",
+    "FittedPipeline": "keystone_tpu.workflow",
+    "Identity": "keystone_tpu.workflow",
+    "PipelineEnv": "keystone_tpu.workflow",
+}
+
+__all__ = sorted(_EXPORTS) + ["__version__"]
+
+
+def __getattr__(name: str) -> Any:
+    if name in _EXPORTS:
+        import importlib
+
+        module = importlib.import_module(_EXPORTS[name])
+        value = getattr(module, name)
+        globals()[name] = value  # cache for subsequent lookups
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return __all__

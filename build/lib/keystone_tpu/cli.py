@@ -1,0 +1,271 @@
+"""Command-line workload runner.
+
+The scopt analog (reference: each workload object carries an
+``OptionParser`` over its config case class, e.g.
+pipelines/images/cifar/RandomPatchCifar.scala:101-114,
+pipelines/images/imagenet/ImageNetSiftLcsFV.scala:171-207). Here one
+argparse subcommand per workload is generated from the workload's config
+dataclass: field names become ``--flags``, field types become parsers,
+dataclass defaults become defaults — so pipeline authors only declare the
+dataclass, exactly as reference authors only declared the case class.
+
+Mesh/runtime knobs the reference put in the launcher environment
+(KEYSTONE_MEM, OMP_NUM_THREADS; reference: bin/run-pipeline.sh:9-42) map
+to ``--platform`` / ``--device-count`` here.
+
+Usage:
+    python -m keystone_tpu <workload> [--flag value ...]
+    python -m keystone_tpu --list
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+import typing
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+def _field_parser(field_type: Any) -> Optional[Callable[[str], Any]]:
+    """Map a dataclass field annotation to an argparse type callable."""
+    origin = typing.get_origin(field_type)
+    if origin is typing.Union:  # Optional[T]
+        args = [a for a in typing.get_args(field_type) if a is not type(None)]
+        return _field_parser(args[0]) if len(args) == 1 else str
+    if origin in (tuple, Tuple):
+        inner = typing.get_args(field_type)
+
+        def parse_tuple(text: str):
+            parts = [p for p in text.replace("x", ",").split(",") if p]
+            caster = inner[0] if inner else int
+            return tuple(caster(p) for p in parts)
+
+        return parse_tuple
+    if field_type is bool:
+        return lambda s: s.lower() in ("1", "true", "yes")
+    if field_type in (int, float, str):
+        return field_type
+    return None
+
+
+def add_config_arguments(parser: argparse.ArgumentParser, config_cls) -> None:
+    """Generate ``--flag`` options from a config dataclass."""
+    for field in dataclasses.fields(config_cls):
+        caster = _field_parser(field.type if not isinstance(field.type, str)
+                               else typing.get_type_hints(config_cls)[field.name])
+        if caster is None:
+            continue
+        default = (
+            field.default
+            if field.default is not dataclasses.MISSING
+            else field.default_factory()  # type: ignore[misc]
+        )
+        parser.add_argument(
+            "--" + field.name.replace("_", "-"),
+            dest=field.name,
+            type=caster,
+            default=default,
+            help=f"(default: {default!r})",
+        )
+
+
+def build_config(config_cls, args: argparse.Namespace):
+    names = {f.name for f in dataclasses.fields(config_cls)}
+    return config_cls(**{k: v for k, v in vars(args).items() if k in names})
+
+
+# ----------------------------------------------------------------- registry
+
+
+# name → (module, config class name, run callable name, kwargs, description).
+# Static strings only: --list and help must not import jax/pipelines.
+WORKLOADS: Dict[str, Tuple[str, str, str, Dict[str, Any], str]] = {
+    "mnist-random-fft": (
+        "mnist_random_fft", "MnistRandomFFTConfig", "run", {},
+        "MNIST random-FFT featurization + linear solve",
+    ),
+    "timit": (
+        "timit", "TimitConfig", "run", {},
+        "TIMIT cosine random features + block solve",
+    ),
+    "voc-sift-fisher": (
+        "voc", "SIFTFisherConfig", "run", {},
+        "VOC 2007 SIFT + Fisher Vector + block least squares",
+    ),
+    "imagenet-sift-lcs-fv": (
+        "imagenet", "ImageNetSiftLcsFVConfig", "run", {},
+        "ImageNet dual-branch SIFT+LCS Fisher Vector pipeline",
+    ),
+    "imagenet-native": (
+        "imagenet", "ImageNetSiftLcsFVConfig", "run_native_resolution", {},
+        "ImageNet SIFT+LCS+FV with per-image native-resolution featurization",
+    ),
+    "imagenet-native-streaming": (
+        "imagenet_streaming", "ImageNetSiftLcsFVConfig",
+        "run_native_resolution_streaming", {},
+        "Native-resolution flagship via the fused streaming path (at-scale)",
+    ),
+    "amazon-reviews": (
+        "text", "AmazonReviewsConfig", "run_amazon", {},
+        "Amazon reviews n-gram logistic/LBFGS text pipeline",
+    ),
+    "newsgroups": (
+        "text", "NewsgroupsConfig", "run_newsgroups", {},
+        "20 Newsgroups n-gram naive-bayes/least-squares pipeline",
+    ),
+    "stupid-backoff": (
+        "stupid_backoff", "StupidBackoffConfig", "run", {},
+        "Stupid Backoff n-gram language model",
+    ),
+    **{
+        "cifar-" + v.replace("_", "-"): (
+            "cifar", "RandomCifarConfig", "run", {"variant": v},
+            f"CIFAR-10 {v} workload",
+        )
+        for v in (
+            "linear_pixels", "random", "random_patch", "random_patch_fused",
+            "random_patch_kernel", "random_patch_augmented",
+            "random_patch_kernel_augmented",
+        )
+    },
+}
+
+
+def _resolve(name: str) -> Tuple[Any, Callable[..., dict]]:
+    """Import one workload's module and bind (config_cls, run_fn)."""
+    import importlib
+
+    module_name, config_name, run_name, kwargs, _desc = WORKLOADS[name]
+    module = importlib.import_module(
+        f".pipelines.{module_name}", package="keystone_tpu"
+    )
+    config_cls = getattr(module, config_name)
+    run_fn = getattr(module, run_name)
+    if kwargs:
+        bound = run_fn
+
+        def run_fn(config, _bound=bound, _kw=kwargs):
+            return _bound(config, **_kw)
+
+    return config_cls, run_fn
+
+
+def _apply_platform_flags(argv: list) -> None:
+    """Apply --platform / --device-count from raw argv before jax loads."""
+    import os
+
+    def flag_value(flag: str) -> Optional[str]:
+        for i, a in enumerate(argv):
+            if a == flag and i + 1 < len(argv):
+                return argv[i + 1]
+            if a.startswith(flag + "="):
+                return a.split("=", 1)[1]
+        return None
+
+    device_count = flag_value("--device-count")
+    if device_count:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={device_count}"
+        ).strip()
+    platform = flag_value("--platform")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    parser = argparse.ArgumentParser(
+        prog="keystone_tpu",
+        description="TPU-native ML pipeline framework — workload runner",
+    )
+    parser.add_argument("--list", action="store_true", help="list workloads")
+    parser.add_argument(
+        "--platform",
+        default=None,
+        help="force a JAX platform (cpu/tpu) before device init",
+    )
+    parser.add_argument(
+        "--device-count",
+        type=int,
+        default=None,
+        help="virtual CPU device count (XLA_FLAGS host platform override)",
+    )
+    parser.add_argument("--log-level", default="INFO")
+    sub = parser.add_subparsers(dest="workload")
+
+    # Platform knobs must land before anything imports jax — pre-scan argv
+    # since resolving the selected workload imports its pipeline module.
+    _apply_platform_flags(argv)
+
+    # Only the selected workload's module is imported; --list and top-level
+    # --help stay jax-free.
+    selected = next((a for a in argv if a in WORKLOADS), None)
+    resolved: Dict[str, Tuple[Any, Callable[..., dict]]] = {}
+    for name, entry in WORKLOADS.items():
+        sp = sub.add_parser(name, help=entry[-1])
+        if name == selected:
+            config_cls, run_fn = _resolve(name)
+            resolved[name] = (config_cls, run_fn)
+            add_config_arguments(sp, config_cls)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    if args.list or not args.workload:
+        for name, entry in sorted(WORKLOADS.items()):
+            print(f"{name:28s} {entry[-1]}")
+        return 0
+
+    # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;
+    # runbook: docs/MULTIHOST.md): join the pod's distributed runtime
+    # BEFORE any device use so every host sees the global device set.
+    import os as _os
+
+    if _os.environ.get("KEYSTONE_DISTRIBUTED"):
+        from .parallel.mesh import distributed_init
+
+        distributed_init()
+
+    # Warm repeat runs: compiled XLA programs persist across processes
+    # (KEYSTONE_COMPILATION_CACHE=off to disable). Enabled only on the
+    # workload path so --list / --help stay jax-free.
+    from .utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    config_cls, run_fn = resolved[args.workload]
+    config = build_config(config_cls, args)
+    results = run_fn(config)
+    print(json.dumps({"workload": args.workload, **printable_results(results)}))
+    return 0
+
+
+def printable_results(results: dict) -> dict:
+    """JSON-serializable view of a workload's results dict: true scalars
+    become floats, small arrays become lists (e.g. the VOC run's (20,)
+    per-class AP), large arrays and non-serializable objects are skipped."""
+    import numpy as _np
+
+    printable = {}
+    for k, v in results.items():
+        if isinstance(v, (int, float, str)):
+            printable[k] = v
+        elif hasattr(v, "item"):
+            if _np.ndim(v) == 0 or getattr(v, "size", 0) == 1:
+                printable[k] = float(_np.asarray(v).reshape(()))
+            elif getattr(v, "size", 0) <= 64:
+                printable[k] = _np.asarray(v).tolist()
+    return printable
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,140 @@
+"""Host-ingest throughput: tar-of-JPEG → device-ready batches.
+
+The input pipeline is the classic host-side bottleneck feeding the chip
+(SURVEY §7 hard part 5; reference: loaders/ImageLoaderUtils.scala:133-211
+streams tar entries through executor-side ImageIO at cluster scale).
+This module measures OUR ingest path — ``iter_tar_entries`` +
+``native_decode_batch`` (OpenMP libjpeg, ``native/src/decode.cpp``) — and
+optionally overlaps it with device featurization so the bench can state
+whether the host can feed the device featurize rate.
+
+Also provides the synthetic tar fixture builder the bench uses (cached:
+writing 10k JPEGs once is ~1 min of pure PIL encode time).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tarfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from .loaders.archive import iter_tar_entries, native_decode_batch
+
+
+def build_jpeg_tar_fixture(
+    path: str, num_images: int, size: int = 256, quality: int = 87, seed: int = 0
+) -> str:
+    """Write a tar of ``num_images`` synthetic JPEGs (block-textured so
+    file sizes land near real photo entropy, ~20-40 KB at 256²). Cached:
+    an existing file at ``path`` with the right entry count is reused."""
+    from PIL import Image
+
+    if os.path.exists(path):
+        try:
+            with tarfile.open(path) as t:
+                if sum(1 for m in t if m.isfile()) == num_images:
+                    return path
+        except tarfile.ReadError:
+            pass
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    rng = np.random.default_rng(seed)
+    tmp = path + ".tmp"
+    with tarfile.open(tmp, "w") as tar:
+        for i in range(num_images):
+            # Low-res random field upsampled ×8 + noise: JPEG-compressible
+            # structure, photo-like size on disk.
+            low = rng.integers(0, 256, (size // 8, size // 8, 3), dtype=np.uint8)
+            img = np.repeat(np.repeat(low, 8, axis=0), 8, axis=1)
+            img = np.clip(
+                img.astype(np.int16) + rng.integers(-12, 13, img.shape), 0, 255
+            ).astype(np.uint8)
+            buf = io.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name=f"synset{i % 16:04d}/img_{i:06d}.JPEG")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    os.replace(tmp, path)
+    return path
+
+
+def measure_ingest(
+    tar_path: str,
+    resize: tuple = (256, 256),
+    batch: int = 256,
+    threads: Optional[int] = None,
+    featurize: Optional[Callable[[np.ndarray], object]] = None,
+    max_images: Optional[int] = None,
+) -> Dict[str, float]:
+    """Stream ``tar_path`` through the native decode kernel; returns
+    images/sec plus byte counts. With ``featurize`` given, decode of
+    batch i+1 overlaps ``featurize(batch_i)`` (device work) through a
+    one-slot pipeline — the shape of a real training input pipeline —
+    and the overlapped rate is reported separately."""
+    from .. import native
+
+    lib = native.load()
+    if lib is None:
+        return {"error": "native library not built"}
+    if threads:
+        lib.ks_set_threads(int(threads))
+
+    t0 = time.perf_counter()
+    done = 0
+    raw_bytes = 0
+    pending = None  # in-flight featurize result to force
+    pool = ThreadPoolExecutor(max_workers=1)
+    decode_s = 0.0
+    feat_wait_s = 0.0
+
+    def decode(chunk):
+        return native_decode_batch([r for _, r in chunk], resize)
+
+    entries = iter_tar_entries(tar_path)
+    chunk: list = []
+    futures = []
+    for name, raw in entries:
+        chunk.append((name, raw))
+        raw_bytes += len(raw)
+        if len(chunk) == batch:
+            futures.append(chunk)
+            chunk = []
+            if max_images and sum(len(c) for c in futures) + done >= max_images:
+                break
+    if chunk:
+        futures.append(chunk)
+
+    read_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for c in futures:
+        td = time.perf_counter()
+        images, ok = decode(c)
+        decode_s += time.perf_counter() - td
+        done += int(ok.sum())
+        if featurize is not None:
+            tw = time.perf_counter()
+            if pending is not None:
+                pending.result()  # force previous device batch
+            feat_wait_s += time.perf_counter() - tw
+            pending = pool.submit(featurize, images)
+    if pending is not None:
+        pending.result()
+    total_s = time.perf_counter() - t0
+    pool.shutdown()
+
+    out = {
+        "images": done,
+        "tar_read_s": round(read_s, 2),
+        "decode_s": round(decode_s, 2),
+        "images_per_sec_decode": round(done / max(decode_s, 1e-9), 1),
+        "mb_per_sec_jpeg": round(raw_bytes / 1e6 / max(decode_s + read_s, 1e-9), 1),
+    }
+    if featurize is not None:
+        out["images_per_sec_overlapped"] = round(done / max(total_s, 1e-9), 1)
+        out["featurize_wait_s"] = round(feat_wait_s, 2)
+    return out

@@ -1,0 +1,13 @@
+from .archive import iter_tar_entries, list_archives, load_image_archives
+from .imagenet import load_imagenet, read_label_map
+from .voc import load_voc, read_voc_labels
+
+__all__ = [
+    "iter_tar_entries",
+    "list_archives",
+    "load_image_archives",
+    "load_imagenet",
+    "read_label_map",
+    "load_voc",
+    "read_voc_labels",
+]

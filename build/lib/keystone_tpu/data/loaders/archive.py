@@ -1,0 +1,214 @@
+"""Tar-of-images ingestion shared by the ImageNet and VOC loaders.
+
+TPU-native re-design of the reference's Spark tar streaming
+(reference: loaders/ImageLoaderUtils.scala:23-96 ``getFilePathsRDD`` /
+``loadFiles``). The reference parallelizes by making each tar file one RDD
+partition and streaming entries through commons-compress + ImageIO on the
+executors. Here ingestion is a host-side concern feeding the chip: tar
+entries are read sequentially (tar has no index) while JPEG decode +
+resize — the actual CPU cost — fans out over a thread pool (PIL releases
+the GIL during decode). When the native ingest library is built
+(``native/``), decode is delegated to the C++ libjpeg path instead.
+
+Ragged image sizes are the TPU impedance mismatch (SURVEY.md §7 hard part
+5): batched XLA computations need static shapes, so loaders take an
+optional ``resize=(x, y)`` that produces uniform arrays ready for
+``ArrayDataset`` stacking. Without it they return per-image dict records
+in an ``ObjectDataset``.
+"""
+
+from __future__ import annotations
+
+import glob
+import itertools
+import os
+import tarfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dataset import ObjectDataset
+from ...utils.image import load_image
+
+
+def list_archives(data_path: str) -> List[str]:
+    """All regular files under a directory, or the path itself if it is a
+    file (reference: ImageLoaderUtils.scala:33-40 getFilePathsRDD)."""
+    if os.path.isfile(data_path):
+        return [data_path]
+    if os.path.isdir(data_path):
+        return sorted(
+            p for p in glob.glob(os.path.join(data_path, "*")) if os.path.isfile(p)
+        )
+    raise FileNotFoundError(f"no archive(s) at {data_path}")
+
+
+def _resize_image(arr: np.ndarray, resize: Tuple[int, int]) -> np.ndarray:
+    """Bilinear resize an (X, Y, C) float array to (resize[0], resize[1], C)."""
+    from PIL import Image as PILImage
+
+    x_dim, y_dim = resize
+    if arr.shape[0] == x_dim and arr.shape[1] == y_dim:
+        return arr
+    chans = []
+    for c in range(arr.shape[2]):
+        pil = PILImage.fromarray(arr[..., c].astype(np.float32), mode="F")
+        # PIL sizes are (width, height) = (second axis, first axis).
+        chans.append(np.asarray(pil.resize((y_dim, x_dim), PILImage.BILINEAR)))
+    return np.stack(chans, axis=-1).astype(np.float64)
+
+
+def iter_tar_entries(
+    archive_path: str, name_prefix: Optional[str] = None
+) -> Iterator[Tuple[str, bytes]]:
+    """Yield (entry_name, raw_bytes) for regular entries, optionally
+    filtered by prefix (reference: ImageLoaderUtils.scala:70-90). Files
+    that are not tar archives are skipped (a data directory may hold label
+    files next to its shards)."""
+    try:
+        tar_cm = tarfile.open(archive_path, mode="r:*")
+    except tarfile.ReadError:
+        return
+    with tar_cm as tar:
+        for entry in tar:
+            if not entry.isfile():
+                continue
+            if name_prefix is not None and not entry.name.startswith(name_prefix):
+                continue
+            fobj = tar.extractfile(entry)
+            if fobj is None:
+                continue
+            yield entry.name, fobj.read()
+
+
+def native_decode_batch(
+    raw: List[bytes], resize: Tuple[int, int]
+) -> Optional[Tuple["np.ndarray", "np.ndarray"]]:
+    """Decode a batch of JPEGs through the native libjpeg kernel
+    (keystone_tpu/native/src/decode.cpp). Returns (images, ok_mask) or
+    None when the native library isn't built."""
+    import ctypes
+
+    from ... import native
+
+    lib = native.load()
+    if lib is None or not raw:
+        return None
+    n = len(raw)
+    x_dim, y_dim = resize
+    bufs = (ctypes.POINTER(ctypes.c_ubyte) * n)()
+    lens = (ctypes.c_longlong * n)()
+    keepalive = []
+    for i, b in enumerate(raw):
+        arr = np.frombuffer(b, dtype=np.uint8)
+        keepalive.append(arr)
+        bufs[i] = arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte))
+        lens[i] = len(b)
+    out = np.zeros((n, x_dim, y_dim, 3), dtype=np.float32)
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.ks_decode_jpeg_batch(
+        bufs, lens, n, x_dim, y_dim,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+    )
+    return out, ok.astype(bool)
+
+
+def load_image_archives(
+    data_path: str,
+    label_fn: Callable[[str], Any],
+    name_prefix: Optional[str] = None,
+    resize: Optional[Tuple[int, int]] = None,
+    num_workers: int = 8,
+    label_key: str = "label",
+    use_native: Optional[bool] = None,
+) -> ObjectDataset:
+    """Stream every image out of the tar(s) at ``data_path`` into records
+    ``{"image": (X, Y, C) float array, label_key: label_fn(entry_name),
+    "filename": entry_name}``.
+
+    Entries whose ``label_fn`` raises KeyError or whose bytes fail to
+    decode are skipped, matching the reference's Option-typed loader
+    (reference: ImageLoaderUtils.scala:84-88).
+
+    With ``resize`` set and the native library built, decode+resize runs
+    through the OpenMP libjpeg kernel (``use_native=None`` auto-detects;
+    True requires it; False forces the PIL path).
+    """
+
+    def decode(item: Tuple[str, bytes]) -> Optional[Dict[str, Any]]:
+        name, raw = item
+        try:
+            label = label_fn(name)
+        except KeyError:
+            return None
+        img = load_image(raw)
+        if img is None:
+            return None
+        if resize is not None:
+            img = _resize_image(img, resize)
+        return {"image": img, label_key: label, "filename": name}
+
+    records: List[Dict[str, Any]] = []
+    archives = [p for p in list_archives(data_path) if tarfile.is_tarfile(p)]
+
+    if use_native is None:
+        from ... import native
+
+        use_native = resize is not None and native.available()
+    if use_native and resize is None:
+        raise ValueError("native decode requires a resize target")
+
+    # Chunked submission keeps only ~2 decode-rounds of raw bytes in
+    # flight — draining the raw generator into queued futures would pull
+    # the whole tar into memory before the first decode finishes.
+    chunk = max(1, 2 * num_workers)
+    if use_native:
+        for archive in archives:
+            entries = iter_tar_entries(archive, name_prefix)
+            while True:
+                batch = list(itertools.islice(entries, chunk * 8))
+                if not batch:
+                    break
+                labeled = []
+                for name, raw in batch:
+                    try:
+                        labeled.append((name, raw, label_fn(name)))
+                    except KeyError:
+                        continue
+                if not labeled:
+                    continue
+                decoded = native_decode_batch([r for _, r, _ in labeled], resize)
+                if decoded is None:
+                    raise RuntimeError(
+                        "use_native=True but the native library is not built; "
+                        "run make -C keystone_tpu/native"
+                    )
+                images, ok = decoded
+                for i, (name, raw, label) in enumerate(labeled):
+                    if ok[i]:
+                        records.append(
+                            {"image": images[i], label_key: label, "filename": name}
+                        )
+                    else:
+                        # libjpeg only handles JPEG; PNG/BMP/CMYK entries
+                        # fall back to the PIL path so dataset contents do
+                        # not depend on whether the native build exists.
+                        rec = decode((name, raw))
+                        if rec is not None:
+                            rec["image"] = rec["image"].astype(np.float32)
+                            records.append(rec)
+        return ObjectDataset(records, num_shards=max(1, len(archives)))
+
+    with ThreadPoolExecutor(max_workers=num_workers) as pool:
+        for archive in archives:
+            entries = iter_tar_entries(archive, name_prefix)
+            while True:
+                batch = list(itertools.islice(entries, chunk))
+                if not batch:
+                    break
+                for rec in pool.map(decode, batch):
+                    if rec is not None:
+                        records.append(rec)
+    return ObjectDataset(records, num_shards=max(1, len(archives)))

@@ -1,0 +1,54 @@
+"""CSV loading (reference: loaders/CsvDataLoader.scala:90-120,
+loaders/LabeledData.scala:256-266).
+
+Rows of comma-separated numbers become one (n, d) device-ready array —
+the TPU-native form of the reference's RDD[DenseVector].
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..dataset import ArrayDataset
+
+
+def load_csv(path: str, dtype=np.float32) -> ArrayDataset:
+    """Load one CSV file, a directory of them, or a glob pattern."""
+    files = _expand(path)
+    parts = [np.loadtxt(f, delimiter=",", dtype=dtype, ndmin=2) for f in files]
+    return ArrayDataset(np.concatenate(parts, axis=0))
+
+
+def _expand(path: str):
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "*")))
+    else:
+        matches = sorted(glob.glob(path))
+        files = matches if matches else [path]
+    if not files:
+        raise FileNotFoundError(path)
+    return files
+
+
+@dataclass
+class LabeledData:
+    """(labels, features) pair of aligned datasets
+    (reference: loaders/LabeledData.scala)."""
+
+    labels: ArrayDataset
+    data: ArrayDataset
+
+
+def load_labeled_csv(path: str, label_col: int = 0, label_offset: int = 0) -> LabeledData:
+    """CSV where one column is an integer label (reference MNIST format is
+    1-indexed label first; pass label_offset=-1 to 0-index)."""
+    raw = load_csv(path)
+    arr = np.asarray(raw.data)
+    labels = arr[:, label_col].astype(np.int32) + label_offset
+    features = np.delete(arr, label_col, axis=1)
+    return LabeledData(ArrayDataset(labels), ArrayDataset(features))

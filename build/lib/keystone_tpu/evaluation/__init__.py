@@ -1,0 +1,15 @@
+"""Evaluators (reference: evaluation/)."""
+
+from .augmented import AugmentedExamplesEvaluator
+from .binary import BinaryClassificationMetrics, BinaryClassifierEvaluator
+from .mean_average_precision import MeanAveragePrecisionEvaluator
+from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics
+
+__all__ = [
+    "AugmentedExamplesEvaluator",
+    "BinaryClassificationMetrics",
+    "BinaryClassifierEvaluator",
+    "MeanAveragePrecisionEvaluator",
+    "MulticlassClassifierEvaluator",
+    "MulticlassMetrics",
+]

@@ -1,0 +1,60 @@
+"""Evaluation over augmented example copies.
+
+Reference: evaluation/AugmentedExamplesEvaluator.scala:9-71 — predictions
+for augmented copies of the same underlying example (identified by a name)
+are aggregated per name by *average* score or *borda* rank-sum voting,
+argmaxed, and scored with the multiclass evaluator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+from .mean_average_precision import _to_score_matrix
+from .multiclass import MulticlassClassifierEvaluator, MulticlassMetrics, _to_int_array
+
+
+class AugmentedExamplesEvaluator:
+    def __init__(self, names: Sequence[Any], num_classes: int, policy: str = "average"):
+        if policy not in ("average", "borda"):
+            raise ValueError("policy must be 'average' or 'borda'")
+        self.names = list(names)
+        self.num_classes = num_classes
+        self.policy = policy
+
+    def evaluate(self, predicted: Any, actual_labels: Any) -> MulticlassMetrics:
+        scores = _to_score_matrix(predicted)  # (n_copies, k)
+        labels = _to_int_array(actual_labels)
+        if not (len(self.names) == scores.shape[0] == len(labels)):
+            raise ValueError("names, predictions and labels must align")
+
+        if self.policy == "borda":
+            # rank of each class in ascending score order, per copy
+            order = np.argsort(scores, axis=1, kind="stable")
+            votes = np.empty_like(scores)
+            np.put_along_axis(
+                votes, order, np.broadcast_to(np.arange(scores.shape[1], dtype=np.float64), scores.shape).copy(), axis=1
+            )
+        else:
+            votes = scores
+
+        groups: dict[Any, list[int]] = {}
+        for i, name in enumerate(self.names):
+            groups.setdefault(name, []).append(i)
+
+        final_preds, final_actuals = [], []
+        for name, idx in groups.items():
+            group_labels = labels[idx]
+            if len(set(group_labels.tolist())) != 1:
+                raise ValueError(f"conflicting labels for augmented copies of {name!r}")
+            agg = votes[idx].sum(axis=0)
+            if self.policy == "average":
+                agg = agg / len(idx)
+            final_preds.append(int(np.argmax(agg)))
+            final_actuals.append(int(group_labels[0]))
+
+        return MulticlassClassifierEvaluator(self.num_classes).evaluate(
+            np.asarray(final_preds), np.asarray(final_actuals)
+        )

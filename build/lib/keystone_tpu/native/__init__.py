@@ -1,0 +1,105 @@
+"""ctypes bindings for the native host-side kernel library.
+
+The reference loads its C++ kernels with ``System.loadLibrary`` behind JNI
+declarations (reference: utils/external/VLFeat.scala:3-29,
+utils/external/EncEval.scala:3-30). Here the library is built by
+``make -C keystone_tpu/native`` and bound over a C ABI; every entry point
+is also implemented in XLA, so the native layer is optional — ``load()``
+returns None when the library isn't built and callers fall back.
+
+Entry points (see src/ for contracts):
+- ``ks_dsift`` / ``ks_dsift_descriptor_count`` — dense multi-scale SIFT.
+- ``ks_gmm_fit`` / ``ks_fisher_encode`` — GMM EM + Fisher Vector.
+- ``ks_decode_jpeg_batch`` / ``ks_jpeg_dims`` — batch JPEG ingest.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libkeystone_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c_float_p = ctypes.POINTER(ctypes.c_float)
+    c_int_p = ctypes.POINTER(ctypes.c_int)
+    c_ubyte_p = ctypes.POINTER(ctypes.c_ubyte)
+
+    lib.ks_dsift_descriptor_count.restype = ctypes.c_int
+    lib.ks_dsift_descriptor_count.argtypes = [ctypes.c_int] * 6
+
+    lib.ks_dsift.restype = None
+    lib.ks_dsift.argtypes = [
+        c_float_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int, c_float_p,
+    ]
+
+    lib.ks_gmm_fit.restype = ctypes.c_int
+    lib.ks_gmm_fit.argtypes = [
+        c_float_p, ctypes.c_longlong, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_float, ctypes.c_ulonglong, ctypes.c_float,
+        ctypes.c_float, c_float_p, c_float_p, c_float_p,
+    ]
+
+    lib.ks_fisher_encode.restype = None
+    lib.ks_fisher_encode.argtypes = [
+        c_float_p, ctypes.c_longlong, ctypes.c_int, c_float_p, c_float_p,
+        c_float_p, ctypes.c_int, ctypes.c_float, c_float_p,
+    ]
+
+    lib.ks_decode_jpeg_batch.restype = None
+    lib.ks_decode_jpeg_batch.argtypes = [
+        ctypes.POINTER(c_ubyte_p), ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, c_float_p, c_ubyte_p,
+    ]
+
+    lib.ks_jpeg_dims.restype = ctypes.c_int
+    lib.ks_jpeg_dims.argtypes = [c_ubyte_p, ctypes.c_longlong, c_int_p, c_int_p]
+    return lib
+
+
+def build(force: bool = False) -> bool:
+    """Build the shared library in-tree. Returns True on success."""
+    if not force and os.path.exists(_LIB_PATH):
+        return True
+    try:
+        subprocess.run(
+            ["make", "-C", _DIR, "-j"],
+            check=True,
+            capture_output=True,
+            timeout=300,
+        )
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+    return os.path.exists(_LIB_PATH)
+
+
+def load(auto_build: bool = False) -> Optional[ctypes.CDLL]:
+    """Load (optionally building) the native library; None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _load_failed and not auto_build:
+            return None
+        if not os.path.exists(_LIB_PATH) and auto_build:
+            build()
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _load_failed = True
+            return None
+        return _lib
+
+
+def available(auto_build: bool = False) -> bool:
+    return load(auto_build=auto_build) is not None

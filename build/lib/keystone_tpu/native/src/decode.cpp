@@ -1,0 +1,178 @@
+// Batch JPEG decode + bilinear resize, host-native ingest kernel.
+//
+// The TPU-native analog of the reference's executor-side ImageIO decode
+// (reference: loaders/ImageLoaderUtils.scala:84-88, utils/images/
+// ImageConversions.scala:5-80): the input pipeline is the classic host-side
+// bottleneck feeding the chip, so decode fans out over OpenMP threads with
+// libjpeg doing the hot loop. Output matches the framework's image
+// convention — (X=rows, Y=cols, C) float arrays in BGR channel order
+// (keystone_tpu/utils/image.py load_image).
+
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* err = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  std::longjmp(err->jump, 1);
+}
+
+void silent_output(j_common_ptr) {}
+
+// Decode one JPEG into an RGB byte buffer. Returns false on any error.
+// min_x/min_y (>0): the caller's resample target — decode is DCT-domain
+// scaled to the smallest 1/2^k size still >= the target in both dims, so
+// IDCT + memory traffic scale with output pixels, not source pixels (the
+// bilinear resample that follows eats the remaining gap). 0 disables.
+bool decode_rgb(const unsigned char* buf, long long len, std::vector<unsigned char>& rgb,
+                int& width, int& height, int min_x, int min_y) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  cinfo.out_color_space = JCS_RGB;
+  if (min_x > 0 && min_y > 0) {
+    // ceil division: libjpeg's scaled output is ceil(dim/denom)
+    // (jdiv_round_up), so floor would reject valid just-under-2^k sizes
+    for (int d = 8; d >= 2; d /= 2) {
+      if ((int)((cinfo.image_height + d - 1) / d) >= min_x &&
+          (int)((cinfo.image_width + d - 1) / d) >= min_y) {
+        cinfo.scale_num = 1;
+        cinfo.scale_denom = d;
+        break;
+      }
+    }
+  }
+  jpeg_start_decompress(&cinfo);
+  width = cinfo.output_width;
+  height = cinfo.output_height;
+  if (width <= 0 || height <= 0 || cinfo.output_components != 3) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  rgb.resize((size_t)width * height * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    unsigned char* row = rgb.data() + (size_t)cinfo.output_scanline * width * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// Bilinear sample of channel c at (fx, fy) in an RGB byte image. Neighbor
+// indices are clamped independently so 1-pixel-wide/tall sources stay in
+// bounds.
+inline float bilerp(const unsigned char* rgb, int w, int h, float fx, float fy,
+                    int c) {
+  int x0 = (int)fx, y0 = (int)fy;
+  if (x0 > h - 1) x0 = h - 1;
+  if (y0 > w - 1) y0 = w - 1;
+  if (x0 < 0) x0 = 0;
+  if (y0 < 0) y0 = 0;
+  const int x1 = std::min(x0 + 1, h - 1);
+  const int y1 = std::min(y0 + 1, w - 1);
+  const float ax = fx - x0, ay = fy - y0;
+  const float v00 = rgb[((size_t)x0 * w + y0) * 3 + c];
+  const float v01 = rgb[((size_t)x0 * w + y1) * 3 + c];
+  const float v10 = rgb[((size_t)x1 * w + y0) * 3 + c];
+  const float v11 = rgb[((size_t)x1 * w + y1) * 3 + c];
+  const float top = v00 * (1 - ay) + v01 * ay;
+  const float bot = v10 * (1 - ay) + v11 * ay;
+  return top * (1 - ax) + bot * ax;
+}
+
+}  // namespace
+
+extern "C" {
+
+// bufs[i]: raw JPEG bytes of length lens[i]. out: (n, out_x, out_y, 3)
+// float32 BGR. ok[i] = 1 on success, 0 on decode failure (row left zero).
+// out_x and out_y must be positive — every image is resampled to that
+// fixed shape (ragged native sizes cannot share one output buffer).
+void ks_decode_jpeg_batch(const unsigned char* const* bufs,
+                          const long long* lens, int n, int out_x, int out_y,
+                          float* out, unsigned char* ok) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (int i = 0; i < n; ++i) {
+    std::vector<unsigned char> rgb;
+    int w = 0, h = 0;
+    ok[i] = 0;
+    float* dst = out + (size_t)i * out_x * out_y * 3;
+    std::memset(dst, 0, sizeof(float) * (size_t)out_x * out_y * 3);
+    if (!decode_rgb(bufs[i], lens[i], rgb, w, h, out_x, out_y)) continue;
+    // scale factors map output pixel centers into source coordinates
+    const float sx = out_x > 1 ? (float)(h - 1) / (float)(out_x - 1) : 0.0f;
+    const float sy = out_y > 1 ? (float)(w - 1) / (float)(out_y - 1) : 0.0f;
+    for (int x = 0; x < out_x; ++x) {
+      for (int y = 0; y < out_y; ++y) {
+        float* px = dst + ((size_t)x * out_y + y) * 3;
+        px[0] = bilerp(rgb.data(), w, h, x * sx, y * sy, 2);  // B
+        px[1] = bilerp(rgb.data(), w, h, x * sx, y * sy, 1);  // G
+        px[2] = bilerp(rgb.data(), w, h, x * sx, y * sy, 0);  // R
+      }
+    }
+    ok[i] = 1;
+  }
+}
+
+// Cap the decode pool (bench scaling curves; 0 = library default).
+void ks_set_threads(int n) {
+#ifdef _OPENMP
+  if (n > 0) omp_set_num_threads(n);
+#else
+  (void)n;
+#endif
+}
+
+// Probe: returns 1 and fills (height=rows, width=cols) without full decode.
+int ks_jpeg_dims(const unsigned char* buf, long long len, int* rows, int* cols) {
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+  jerr.pub.output_message = silent_output;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<unsigned char*>(buf), (unsigned long)len);
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  *rows = cinfo.image_height;
+  *cols = cinfo.image_width;
+  jpeg_destroy_decompress(&cinfo);
+  return 1;
+}
+
+}  // extern "C"

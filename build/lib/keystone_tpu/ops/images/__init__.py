@@ -1,0 +1,52 @@
+"""Image featurization operators (reference: nodes/images/)."""
+
+from .core import (
+    CenterCornerPatcher,
+    Convolver,
+    Cropper,
+    FusedConvFeaturizer,
+    GrayScaler,
+    ImageExtractor,
+    ImageVectorizer,
+    LabelExtractor,
+    MultiLabelExtractor,
+    MultiLabeledImageExtractor,
+    PixelScaler,
+    Pooler,
+    RandomImageTransformer,
+    RandomPatcher,
+    SymmetricRectifier,
+    Windower,
+    pack_filters,
+)
+from .daisy import DaisyExtractor
+from .fisher import FisherVector, GMMFisherVectorEstimator
+from .hog import HogExtractor
+from .lcs import LCSExtractor
+from .sift import SIFTExtractor
+
+__all__ = [
+    "DaisyExtractor",
+    "FisherVector",
+    "GMMFisherVectorEstimator",
+    "HogExtractor",
+    "LCSExtractor",
+    "SIFTExtractor",
+    "CenterCornerPatcher",
+    "Convolver",
+    "Cropper",
+    "FusedConvFeaturizer",
+    "GrayScaler",
+    "ImageExtractor",
+    "ImageVectorizer",
+    "LabelExtractor",
+    "MultiLabelExtractor",
+    "MultiLabeledImageExtractor",
+    "PixelScaler",
+    "Pooler",
+    "RandomImageTransformer",
+    "RandomPatcher",
+    "SymmetricRectifier",
+    "Windower",
+    "pack_filters",
+]

@@ -1,0 +1,519 @@
+"""Core image operators: convolution, pooling, rectification, patching.
+
+TPU-native re-designs of the reference's image nodes. The reference runs
+per-image Scala loops over an ``Image`` trait (im2col into a scratch
+matrix, then a BLAS GEMM per image — reference:
+nodes/images/Convolver.scala:20-221). Here every operator is a single
+batched XLA computation over an (N, X, Y, C) array: convolutions lower to
+``lax.conv_general_dilated`` (MXU), pooling to ``lax.reduce_window``, and
+the per-patch normalization the reference does row-by-row in the im2col
+matrix is re-derived as a closed form over box-filter statistics so the
+whole Convolver stays one fused conv — no materialized patch matrix.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable, Optional, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ...utils import image as imutil
+from ...workflow.pipeline import BatchTransformer, Transformer
+from ..learning.zca import ZCAWhitener
+
+
+class GrayScaler(BatchTransformer):
+    """NTSC grayscale (reference: nodes/images/GrayScaler.scala)."""
+
+    def apply_arrays(self, x):
+        c = x.shape[-1]
+        if c == 3:
+            # Reference assumes BGR order (ImageUtils.scala:88-90).
+            g = 0.2989 * x[..., 2] + 0.5870 * x[..., 1] + 0.1140 * x[..., 0]
+        else:
+            g = jnp.sqrt(jnp.mean(x**2, axis=-1))
+        return g[..., None]
+
+
+class PixelScaler(BatchTransformer):
+    """[0,255] → [0,1] (reference: nodes/images/PixelScaler.scala)."""
+
+    def apply_arrays(self, x):
+        return x / 255.0
+
+
+class ImageVectorizer(BatchTransformer):
+    """Image → channel-major flat vector
+    (reference: nodes/images/ImageVectorizer.scala)."""
+
+    def apply_arrays(self, x):
+        n = x.shape[0]
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(n, -1)
+
+
+class SymmetricRectifier(BatchTransformer):
+    """Channel-doubling rectifier [max(v, x−α), max(v, −x−α)]
+    (reference: nodes/images/SymmetricRectifier.scala)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def apply_arrays(self, x):
+        pos = jnp.maximum(self.max_val, x - self.alpha)
+        neg = jnp.maximum(self.max_val, -x - self.alpha)
+        return jnp.concatenate([pos, neg], axis=-1)
+
+
+def pack_filters(filter_images: np.ndarray) -> np.ndarray:
+    """(F, s, s, C) filter images → (F, s·s·C) rows with layout
+    index = c + x·C + y·C·s (reference: Convolver.scala packFilters:98-125)."""
+    f = np.asarray(filter_images)
+    n = f.shape[0]
+    return np.ascontiguousarray(f.transpose(0, 2, 1, 3)).reshape(n, -1)
+
+
+class Convolver(BatchTransformer):
+    """Valid convolution of a filter bank over images, with optional
+    per-patch normalization and ZCA whitening.
+
+    Reference behavior (nodes/images/Convolver.scala:128-204): for each
+    output location, extract the s×s×C patch, optionally normalize it
+    (subtract patch mean, divide by sqrt(patch sample-variance + v)),
+    optionally subtract the whitener means, then dot with each
+    (pre-whitened) filter.
+
+    TPU re-design: rather than materializing the (resW·resH, s²C) im2col
+    matrix per image, the same math is computed as
+
+        out = (raw − m·Σf) / sd − μ_w·f
+
+    where ``raw`` is one batched NHWC valid conv of the images with the
+    whitened filters (the only MXU-heavy term) and m/sd come from two
+    cheap box-filter convs (patch sums / sums of squares). Identical
+    numerics, no patch matrix, fully fused by XLA.
+
+    ``filters`` is the packed (F, s·s·C) matrix, assumed already whitened
+    when ``whitener`` is given — use :meth:`create` to go from raw filter
+    images (mirrors the reference's companion apply:61-90).
+    """
+
+    def __init__(
+        self,
+        filters: np.ndarray,
+        img_channels: int,
+        whitener: Optional[ZCAWhitener] = None,
+        normalize_patches: bool = True,
+        var_constant: float = 10.0,
+    ):
+        filters = np.asarray(filters, dtype=np.float32)
+        self.num_filters, patch_dim = filters.shape
+        self.img_channels = img_channels
+        self.conv_size = int(math.isqrt(patch_dim // img_channels))
+        assert self.conv_size**2 * img_channels == patch_dim, "filters must be square"
+        self.normalize_patches = normalize_patches
+        self.var_constant = float(var_constant)
+        # (F, y, x, c) -> spatial kernel (x, y, c, F) for NHWC/HWIO conv.
+        s, c = self.conv_size, img_channels
+        self.kernel = jnp.asarray(
+            filters.reshape(self.num_filters, s, s, c).transpose(2, 1, 3, 0)
+        )
+        self.filter_sums = jnp.asarray(filters.sum(axis=1))  # (F,)
+        if whitener is not None:
+            means = np.asarray(whitener.means, dtype=np.float32)
+            self.offset = jnp.asarray(means @ filters.T)  # μ_w · f per filter
+        else:
+            self.offset = None
+
+    @staticmethod
+    def create(
+        filter_images: np.ndarray,
+        whitener: Optional[ZCAWhitener] = None,
+        normalize_patches: bool = True,
+        var_constant: float = 10.0,
+        flip_filters: bool = False,
+    ) -> "Convolver":
+        """From raw (F, s, s, C) filter images; whitens the packed filters
+        with W·Wᵀ like the reference (Convolver.scala:74-80)."""
+        filter_images = np.asarray(filter_images)
+        if flip_filters:
+            filter_images = imutil.flip_image(filter_images)
+        packed = pack_filters(filter_images)
+        if whitener is not None:
+            w = np.asarray(whitener.whitener)
+            mu = np.asarray(whitener.means)
+            packed = (packed - mu) @ w @ w.T
+        return Convolver(
+            packed,
+            img_channels=filter_images.shape[-1],
+            whitener=whitener,
+            normalize_patches=normalize_patches,
+            var_constant=var_constant,
+        )
+
+    def apply_arrays(self, x):
+        x = x.astype(jnp.float32)
+        raw = lax.conv_general_dilated(
+            x,
+            self.kernel,
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        out = raw
+        if self.normalize_patches:
+            s, c = self.conv_size, self.img_channels
+            d = float(s * s * c)
+            ones = jnp.ones((s, s, c, 1), dtype=jnp.float32)
+            box = partial(
+                lax.conv_general_dilated,
+                rhs=ones,
+                window_strides=(1, 1),
+                padding="VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            psum = box(x)  # (N, rx, ry, 1)
+            psumsq = box(x * x)
+            m = psum / d
+            var = jnp.maximum(psumsq - d * m * m, 0.0) / (d - 1.0)
+            sd = jnp.sqrt(var + self.var_constant)
+            out = (raw - m * self.filter_sums) / sd
+        if self.offset is not None:
+            out = out - self.offset
+        return out
+
+
+class FusedConvFeaturizer(BatchTransformer):
+    """Memory-bounded conv → symmetric-rectify → pool → vectorize.
+
+    Computes exactly ``ImageVectorizer(pool(rect(conv(x))))`` but scans
+    over blocks of ``filter_block`` filters so the full (N, rx, ry, F)
+    convolution output never materializes — per scan step only one
+    (N, rx, ry, filter_block) panel plus the tiny pooled accumulator are
+    live. At the reference CIFAR config (numFilters=10000,
+    examples/images/cifar_random_patch.sh:30-36) the unfused intermediate
+    is ~37 GB for a 1k-image batch; the fused form is bounded by the block
+    panel regardless of F. Channel layout matches the unfused ops: pooled
+    positives for all F filters, then pooled negatives for all F.
+    """
+
+    def __init__(
+        self,
+        convolver: "Convolver",
+        rectifier: "SymmetricRectifier",
+        pooler: "Pooler",
+        filter_block: int = 512,
+    ):
+        self.conv = convolver
+        self.rect = rectifier
+        self.pool = pooler
+        self.filter_block = filter_block
+
+    def packed_filter_blocks(self, fb: Optional[int] = None):
+        """Zero-padded (nb, s, s, c, fb) kernel blocks plus per-block
+        filter sums and whitener offsets — the traced inputs shared by
+        :meth:`apply_arrays` and the rematerializing solver
+        (ops/learning/conv_block.py, which passes its own block width)."""
+        conv = self.conv
+        f = conv.num_filters
+        fb = min(self.filter_block, f) if fb is None else fb
+        nb = -(-f // fb)
+        f_pad = nb * fb
+        kernel = conv.kernel  # (s, s, c, F)
+        fsums = conv.filter_sums
+        offset = conv.offset if conv.offset is not None else jnp.zeros((f,), jnp.float32)
+        if f_pad != f:
+            kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, 0), (0, f_pad - f)))
+            fsums = jnp.pad(fsums, (0, f_pad - f))
+            offset = jnp.pad(offset, (0, f_pad - f))
+        s, c = conv.conv_size, conv.img_channels
+        kblocks = jnp.moveaxis(kernel.reshape(s, s, c, nb, fb), 3, 0)
+        return kblocks, fsums.reshape(nb, fb), offset.reshape(nb, fb)
+
+    def norm_stats(self, x):
+        """Patch mean / stddev maps for per-patch normalization (None, None
+        when disabled) — filter-independent, computed once per image batch."""
+        conv = self.conv
+        if not conv.normalize_patches:
+            return None, None
+        s, c = conv.conv_size, conv.img_channels
+        d = float(s * s * c)
+        ones = jnp.ones((s, s, c, 1), dtype=jnp.float32)
+        box = partial(
+            lax.conv_general_dilated,
+            rhs=ones,
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        m = box(x) / d  # (N, rx, ry, 1)
+        var = jnp.maximum(box(x * x) - d * m * m, 0.0) / (d - 1.0)
+        return m, jnp.sqrt(var + conv.var_constant)
+
+    def block_pooled(self, x, kb, fs_b, off_b, m, sd):
+        """conv → normalize → rectify → pool for ONE filter block:
+        (N, px, py, 2·fb) pooled panel. The single source of the
+        featurizer math for every consumer."""
+        raw = lax.conv_general_dilated(
+            x, kb, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        out = (raw - m * fs_b) / sd if m is not None else raw
+        out = out - off_b
+        pos = jnp.maximum(self.rect.max_val, out - self.rect.alpha)
+        neg = jnp.maximum(self.rect.max_val, -out - self.rect.alpha)
+        return jnp.concatenate(
+            [self.pool.apply_arrays(pos), self.pool.apply_arrays(neg)], axis=-1
+        )
+
+    def apply_arrays(self, x):
+        conv = self.conv
+        x = x.astype(jnp.float32)
+        n = x.shape[0]
+        f = conv.num_filters
+        fb = min(self.filter_block, f)
+        nb = -(-f // fb)
+        f_pad = nb * fb
+        kblocks, fsum_blocks, offset_blocks = self.packed_filter_blocks()
+        m, sd = self.norm_stats(x)
+
+        def block_step(_, inputs):
+            kb, fs_b, off_b = inputs
+            pooled = self.block_pooled(x, kb, fs_b, off_b, m, sd)
+            return _, (pooled[..., :fb], pooled[..., fb:])
+
+        _, (pp, pn) = lax.scan(
+            block_step, None, (kblocks, fsum_blocks, offset_blocks)
+        )
+        # (nb, N, px, py, fb) → (N, px, py, nb·fb) in global filter order.
+        px, py = pp.shape[2], pp.shape[3]
+        pp = jnp.moveaxis(pp, 0, 3).reshape(n, px, py, f_pad)[..., :f]
+        pn = jnp.moveaxis(pn, 0, 3).reshape(n, px, py, f_pad)[..., :f]
+        pooled = jnp.concatenate([pp, pn], axis=-1)
+        return jnp.transpose(pooled, (0, 2, 1, 3)).reshape(n, -1)
+
+
+_POOL_FUNCTIONS = {
+    "sum": (lax.add, 0.0),
+    "max": (lax.max, -jnp.inf),
+}
+
+
+class Pooler(BatchTransformer):
+    """Strided pooling over square regions with a per-pixel function
+    (reference: nodes/images/Pooler.scala:22-69).
+
+    Pool centers start at ``pool_size/2`` and advance by ``stride``; each
+    pool covers ``[center − pool_size/2, center + pool_size/2)`` clipped to
+    the image, with out-of-image cells contributing the identity (0 for
+    sum — exactly the reference's zero-initialized pool buffer).
+    """
+
+    def __init__(
+        self,
+        stride: int,
+        pool_size: int,
+        pixel_function: Optional[Callable] = None,
+        pool_function: str = "sum",
+    ):
+        self.stride = stride
+        self.pool_size = pool_size
+        self.pixel_function = pixel_function
+        if pool_function not in _POOL_FUNCTIONS:
+            raise ValueError(f"pool_function must be one of {list(_POOL_FUNCTIONS)}")
+        self.pool_function = pool_function
+
+    def apply_arrays(self, x):
+        x_dim, y_dim = x.shape[1], x.shape[2]
+        stride_start = self.pool_size // 2
+        half = self.pool_size // 2
+        window = 2 * half  # [c−p/2, c+p/2) is 2·(p//2) wide
+        num_x = max(0, -(-(x_dim - stride_start) // self.stride))
+        num_y = max(0, -(-(y_dim - stride_start) // self.stride))
+        if self.pixel_function is not None:
+            x = self.pixel_function(x)
+        op, init = _POOL_FUNCTIONS[self.pool_function]
+        # Last window reaches (num−1)·stride + window; zero-pad to cover it.
+        need_x = (num_x - 1) * self.stride + window
+        need_y = (num_y - 1) * self.stride + window
+        pad_x = max(0, need_x - x_dim)
+        pad_y = max(0, need_y - y_dim)
+        x = jnp.pad(x, ((0, 0), (0, pad_x), (0, pad_y), (0, 0)), constant_values=init)
+        out = lax.reduce_window(
+            x,
+            jnp.array(init, dtype=x.dtype),
+            op,
+            window_dimensions=(1, window, window, 1),
+            window_strides=(1, self.stride, self.stride, 1),
+            padding="VALID",
+        )
+        return out[:, :num_x, :num_y, :]
+
+
+class Cropper(BatchTransformer):
+    """Fixed bounding-box crop (reference: nodes/images/Cropper.scala)."""
+
+    def __init__(self, start_x: int, start_y: int, end_x: int, end_y: int):
+        self.bounds = (start_x, start_y, end_x, end_y)
+
+    def apply_arrays(self, x):
+        sx, sy, ex, ey = self.bounds
+        return x[:, sx:ex, sy:ey, :]
+
+
+class RandomImageTransformer(Transformer):
+    """Apply ``transform`` to each image with probability ``chance``
+    (reference: nodes/images/RandomImageTransformer.scala)."""
+
+    def __init__(self, chance: float, transform: Callable, seed: int = 12334):
+        self.chance = chance
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        if self._rng.random() < self.chance:
+            return self.transform(img)
+        return img
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        if isinstance(dataset, ArrayDataset):
+            x = np.asarray(jax.device_get(dataset.data))[: dataset.num_examples]
+            flip = self._rng.random(x.shape[0]) < self.chance
+            out = np.where(
+                flip.reshape((-1,) + (1,) * (x.ndim - 1)), np.asarray(self.transform(x)), x
+            )
+            return ArrayDataset(jnp.asarray(out))
+        return dataset.map(self.apply)
+
+
+def _flatmap_images(dataset: Dataset, per_image: Callable[[np.ndarray], np.ndarray]) -> ArrayDataset:
+    """Host-side flatMap: each image yields a (k, px, py, C) stack; results
+    concatenate along the example axis (analog of the reference's
+    FunctionNode RDD flatMaps)."""
+    if isinstance(dataset, ArrayDataset):
+        imgs = np.asarray(jax.device_get(dataset.data))[: dataset.num_examples]
+    else:
+        imgs = np.stack(dataset.collect())
+    pieces = [per_image(img) for img in imgs]
+    return ArrayDataset(jnp.asarray(np.concatenate(pieces, axis=0)))
+
+
+class Windower(Transformer):
+    """All windows of size w on a stride grid, x-major
+    (reference: nodes/images/Windower.scala:13-56). One image of (X, Y, C)
+    yields ((X−w)/s+1)·((Y−w)/s+1) windows; a batch concatenates them."""
+
+    def __init__(self, stride: int, window_size: int):
+        self.stride = stride
+        self.window_size = window_size
+
+    def _windows(self, img: np.ndarray) -> np.ndarray:
+        w, s = self.window_size, self.stride
+        xs = range(0, img.shape[0] - w + 1, s)
+        ys = range(0, img.shape[1] - w + 1, s)
+        return np.stack([img[x : x + w, y : y + w, :] for x in xs for y in ys])
+
+    def apply(self, img):
+        return self._windows(np.asarray(img))
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        return _flatmap_images(dataset, self._windows)
+
+
+class RandomPatcher(Transformer):
+    """``num_patches`` uniformly random patches per image
+    (reference: nodes/images/RandomPatcher.scala:16-47)."""
+
+    def __init__(self, num_patches: int, patch_size_x: int, patch_size_y: int, seed: int = 12334):
+        self.num_patches = num_patches
+        self.patch_size_x = patch_size_x
+        self.patch_size_y = patch_size_y
+        self._rng = np.random.default_rng(seed)
+
+    def _patches(self, img: np.ndarray) -> np.ndarray:
+        px, py = self.patch_size_x, self.patch_size_y
+        out = []
+        for _ in range(self.num_patches):
+            sx = self._rng.integers(0, img.shape[0] - px + 1)
+            sy = self._rng.integers(0, img.shape[1] - py + 1)
+            out.append(img[sx : sx + px, sy : sy + py, :])
+        return np.stack(out)
+
+    def apply(self, img):
+        return self._patches(np.asarray(img))
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        return _flatmap_images(dataset, self._patches)
+
+
+class CenterCornerPatcher(Transformer):
+    """Four corner patches + center patch, optionally with horizontal flips
+    (reference: nodes/images/CenterCornerPatcher.scala:18-48)."""
+
+    def __init__(self, patch_size_x: int, patch_size_y: int, horizontal_flips: bool = False):
+        self.patch_size_x = patch_size_x
+        self.patch_size_y = patch_size_y
+        self.horizontal_flips = horizontal_flips
+
+    def _patches(self, img: np.ndarray) -> np.ndarray:
+        px, py = self.patch_size_x, self.patch_size_y
+        x_dim, y_dim = img.shape[0], img.shape[1]
+        starts = [
+            (0, 0),
+            (x_dim - px, 0),
+            (0, y_dim - py),
+            (x_dim - px, y_dim - py),
+            ((x_dim - px) // 2, (y_dim - py) // 2),
+        ]
+        out = []
+        for sx, sy in starts:
+            patch = img[sx : sx + px, sy : sy + py, :]
+            out.append(patch)
+            if self.horizontal_flips:
+                out.append(imutil.flip_horizontal(patch))
+        return np.stack(out)
+
+    def apply(self, img):
+        return self._patches(np.asarray(img))
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        return _flatmap_images(dataset, self._patches)
+
+
+# ------------------------------------------------------- labeled-image glue
+
+
+class LabelExtractor(Transformer):
+    """{"image", "label"} dict → label
+    (reference: nodes/images/LabeledImageExtractors.scala)."""
+
+    def apply(self, datum):
+        return datum["label"]
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        if isinstance(dataset, ArrayDataset):
+            return ArrayDataset(dataset.data["label"], dataset.num_examples)
+        return dataset.map(self.apply)
+
+
+class ImageExtractor(Transformer):
+    """{"image", "label"} dict → image."""
+
+    def apply(self, datum):
+        return datum["image"]
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        if isinstance(dataset, ArrayDataset):
+            return ArrayDataset(dataset.data["image"], dataset.num_examples)
+        return dataset.map(self.apply)
+
+
+MultiLabelExtractor = LabelExtractor
+MultiLabeledImageExtractor = ImageExtractor

@@ -1,0 +1,15 @@
+"""Native-backed image operators (reference: nodes/images/external/).
+
+Each operator here is numerically interchangeable with its XLA sibling;
+the native path exists for CPU-heavy hosts and for parity testing, exactly
+as the reference pairs Scala and JNI implementations.
+"""
+
+from .fisher import NativeFisherVector, NativeGMMFisherVectorEstimator
+from .sift import NativeSIFTExtractor
+
+__all__ = [
+    "NativeFisherVector",
+    "NativeGMMFisherVectorEstimator",
+    "NativeSIFTExtractor",
+]

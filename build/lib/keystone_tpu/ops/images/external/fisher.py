@@ -1,0 +1,108 @@
+"""Native GMM fit + Fisher Vector encoding.
+
+The analog of reference: nodes/images/external/FisherVector.scala:17-55 and
+nodes/learning/external/GaussianMixtureModelEstimator.scala:14-50, which
+call the enceval JNI kernel. Parameter layout conversion happens here: the
+framework's GMM holds (d, k) matrices, the C ABI is cluster-major (k, d).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ....data.dataset import ArrayDataset, Dataset
+from ....workflow.pipeline import Estimator, Transformer
+from .... import native
+from ...learning.gmm import GaussianMixtureModel
+
+
+def _lib():
+    lib = native.load(auto_build=True)
+    if lib is None:
+        raise RuntimeError(
+            "native library unavailable; build with make -C keystone_tpu/native"
+        )
+    return lib
+
+
+def native_gmm_fit(
+    x: np.ndarray,
+    k: int,
+    max_iterations: int = 100,
+    tol: float = 1e-4,
+    seed: int = 0,
+    var_floor: float = 1e-9,
+    weight_threshold: float = 1e-4,
+) -> GaussianMixtureModel:
+    """EM fit on the host (reference: EncEval.cxx computeGMM)."""
+    lib = _lib()
+    x = np.ascontiguousarray(x, dtype=np.float32)
+    n, d = x.shape
+    if n < k or k < 1:
+        raise ValueError(f"GMM fit needs at least k={k} samples, got n={n}")
+    means = np.zeros((k, d), dtype=np.float32)
+    variances = np.zeros((k, d), dtype=np.float32)
+    weights = np.zeros(k, dtype=np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    lib.ks_gmm_fit(
+        x.ctypes.data_as(fp), n, d, k, max_iterations,
+        np.float32(tol), seed, np.float32(var_floor),
+        np.float32(weight_threshold),
+        means.ctypes.data_as(fp), variances.ctypes.data_as(fp),
+        weights.ctypes.data_as(fp),
+    )
+    return GaussianMixtureModel(
+        means.T, variances.T, weights, weight_threshold=weight_threshold
+    )
+
+
+class NativeFisherVector(Transformer):
+    """Per-item (n_desc, d) → (d, 2k) Fisher vectors on the host."""
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+        self._means = np.ascontiguousarray(np.asarray(gmm.means).T, np.float32)
+        self._vars = np.ascontiguousarray(np.asarray(gmm.variances).T, np.float32)
+        self._weights = np.ascontiguousarray(np.asarray(gmm.weights), np.float32)
+
+    def apply(self, datum):
+        lib = _lib()
+        x = np.ascontiguousarray(datum, dtype=np.float32)
+        n, d = x.shape
+        k = self._weights.shape[0]
+        out = np.zeros((d, 2 * k), dtype=np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        lib.ks_fisher_encode(
+            x.ctypes.data_as(fp), n, d,
+            self._means.ctypes.data_as(fp), self._vars.ctypes.data_as(fp),
+            self._weights.ctypes.data_as(fp), k,
+            np.float32(self.gmm.weight_threshold), out.ctypes.data_as(fp),
+        )
+        return out
+
+    def apply_batch(self, dataset: Dataset) -> ArrayDataset:
+        ds = dataset if isinstance(dataset, ArrayDataset) else dataset.to_arrays()
+        x = np.asarray(ds.data)[: ds.num_examples]
+        out = np.stack([self.apply(m) for m in x])
+        return ArrayDataset(out, ds.num_examples)
+
+
+class NativeGMMFisherVectorEstimator(Estimator):
+    """Fit a GMM natively, return the native encoder
+    (reference: FisherVector.scala:85-97 — the reference's optimizable
+    estimator picks the native path when k ≥ 32)."""
+
+    def __init__(self, k: int, seed: int = 0):
+        self.k = k
+        self.seed = seed
+
+    def fit(self, data: Dataset) -> NativeFisherVector:
+        arrays = data if isinstance(data, ArrayDataset) else data.to_arrays()
+        # slice off mesh zero-padding before fitting, like the XLA estimator
+        x = np.asarray(arrays.data, dtype=np.float32)[: arrays.num_examples]
+        if x.ndim == 3:
+            x = x.reshape(-1, x.shape[-1])
+        gmm = native_gmm_fit(x, self.k, seed=self.seed)
+        return NativeFisherVector(gmm)

@@ -1,0 +1,71 @@
+"""Native dense multi-scale SIFT.
+
+The analog of reference: nodes/images/external/SIFTExtractor.scala:16-40,
+which calls the VLFeat JNI kernel per image. Here the whole batch goes
+through one C call (OpenMP fans out over images inside). Numerically
+matches the XLA extractor (``ops/images/sift.py``) — same flat-window
+dense-SIFT algorithm — so the two are drop-in interchangeable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ....data.dataset import ArrayDataset, Dataset
+from ....workflow.pipeline import Transformer
+from .... import native
+from ..sift import DESCRIPTOR_SIZE, SIFTExtractor
+
+
+class NativeSIFTExtractor(Transformer):
+    """Batch dense SIFT on the host CPU over the native C ABI."""
+
+    def __init__(self, step_size: int = 3, bin_size: int = 4, scales: int = 4,
+                 scale_step: int = 1):
+        self.step_size = step_size
+        self.bin_size = bin_size
+        self.scales = scales
+        self.scale_step = scale_step
+        # shares grid geometry with the XLA extractor
+        self._xla = SIFTExtractor(step_size, bin_size, scales, scale_step)
+
+    def _extract(self, images: np.ndarray) -> np.ndarray:
+        lib = native.load(auto_build=True)
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable; build with make -C keystone_tpu/native"
+            )
+        images = np.ascontiguousarray(images, dtype=np.float32)
+        n, xd, yd = images.shape
+        total = lib.ks_dsift_descriptor_count(
+            xd, yd, self.step_size, self.bin_size, self.scales, self.scale_step
+        )
+        if total <= 0:
+            raise ValueError("image too small for any SIFT scale")
+        out = np.zeros((n, total, DESCRIPTOR_SIZE), dtype=np.float32)
+        fp = ctypes.POINTER(ctypes.c_float)
+        lib.ks_dsift(
+            images.ctypes.data_as(fp), n, xd, yd,
+            self.step_size, self.bin_size, self.scales, self.scale_step,
+            out.ctypes.data_as(fp),
+        )
+        return out
+
+    def apply(self, datum):
+        img = np.asarray(datum)
+        if img.ndim == 3:
+            img = img[..., 0]
+        return self._extract(img[None])[0]
+
+    def apply_batch(self, dataset: Dataset) -> ArrayDataset:
+        ds = dataset if isinstance(dataset, ArrayDataset) else dataset.to_arrays()
+        x = np.asarray(ds.data)
+        if x.ndim == 4:
+            x = x[..., 0]
+        out = self._extract(x[: ds.num_examples])
+        return ArrayDataset(out, ds.num_examples)
+
+    def grid_counts(self, x_dim: int, y_dim: int):
+        return self._xla.grid_counts(x_dim, y_dim)

@@ -1,0 +1,111 @@
+"""Native-resolution (masked) extraction as first-class pipeline ops.
+
+The reference featurizes every image at its own size — the JNI kernels
+take per-call (w, h) (reference: src/main/cpp/VLFeat.cxx:170-186) and the
+Transformer API maps them per image (reference:
+nodes/images/external/SIFTExtractor.scala:27-33). The TPU analog groups
+images into padded static-shape buckets (``data.buckets``) and runs the
+masked extractors per bucket; this module wraps that as a ``Transformer``
+so the whole native-resolution flow lives inside the Pipeline API —
+visible to the optimizer, autocache, and prefix reuse — instead of a
+bespoke host loop.
+
+Dataflow convention: input buckets carry ``{"image": (N, Xb, Yb, C),
+"dims": (N, 2)}``; extractor output carries ``{"desc": (N, n_pad, d),
+"valid": (N, n_pad)}``. BatchTransformer routes ops applied to the dict
+through the descriptors only; ``FisherVector`` consumes the mask and
+returns dense rows, after which buckets concatenate into an ordinary
+(N, fv_dim) dataset for the solver.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ...data.dataset import ArrayDataset, BucketedDataset, Dataset
+from ...workflow.pipeline import Transformer
+
+
+class MaskedExtractor(Transformer):
+    """Run an extractor's ``apply_arrays_masked`` over size buckets.
+
+    ``pre`` optionally maps the padded image batch before extraction
+    (e.g. PixelScaler→GrayScaler for SIFT); ``post`` maps the descriptor
+    array after (e.g. SignedHellinger), preserving validity.
+    """
+
+    def __init__(
+        self,
+        extractor,
+        pre: Optional[Callable] = None,
+        post: Optional[Callable] = None,
+    ):
+        self.extractor = extractor
+        self.pre = pre
+        self.post = post
+        self._jit_cache = None
+
+    @property
+    def _jitted(self):
+        # One jitted computation per bucket shape (jax caches on shapes):
+        # eager per-primitive dispatch would pay the host→device round
+        # trip once per op instead of once per bucket. Built lazily and
+        # excluded from pickling (jit wrappers don't pickle; FittedPipeline
+        # save/load must keep working with this op in the graph).
+        import jax
+
+        if self._jit_cache is None:
+            self._jit_cache = jax.jit(self._apply_bucket_arrays)
+        return self._jit_cache
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_jit_cache"] = None
+        return state
+
+    def apply(self, datum):
+        # Per-datum serving path: eager, NOT jitted — native-resolution
+        # datums have arbitrary (H, W), so jitting here would compile the
+        # full extractor once per distinct image size and grow the cache
+        # without bound. Batch (bucketed) application is the fast path.
+        img = jnp.asarray(datum["image"])[None]
+        dims = jnp.asarray(datum["dims"])[None]
+        out = self._apply_bucket_arrays(img, dims)
+        return {"desc": out["desc"][0], "valid": out["valid"][0]}
+
+    def _apply_bucket_arrays(self, images, dims):
+        x = images.astype(jnp.float32)
+        if self.pre is not None:
+            x = self.pre(x)
+        desc, valid = self.extractor.apply_arrays_masked(x, dims)
+        if self.post is not None:
+            desc = self.post(desc)
+        return {"desc": desc, "valid": valid}
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        if isinstance(dataset, BucketedDataset):
+            return dataset.map_datasets(self.apply_batch)
+        assert isinstance(dataset, ArrayDataset) and isinstance(dataset.data, dict), (
+            "MaskedExtractor needs {'image', 'dims'} bucket data "
+            "(see data.buckets.to_bucketed_dataset)"
+        )
+        out = self._jitted(
+            jnp.asarray(dataset.data["image"]), jnp.asarray(dataset.data["dims"])
+        )
+        return ArrayDataset(out, dataset.num_examples)
+
+
+class ConcatBuckets(Transformer):
+    """Collapse a BucketedDataset into one dense ArrayDataset (bucket-major
+    row order) — the boundary op before solvers/evaluators once per-bucket
+    shapes agree (post-FisherVector)."""
+
+    def apply(self, datum):
+        return datum
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        if isinstance(dataset, BucketedDataset):
+            return dataset.concat()
+        return dataset

@@ -1,0 +1,228 @@
+"""Block least-squares solvers (feature-block coordinate descent).
+
+TPU-native re-design of the reference's block solver
+(reference: nodes/learning/BlockLinearMapper.scala:22-283): features are
+split into blocks (``VectorSplitter``), per-block mean-centering is
+applied, and block coordinate descent minimizes ‖AW − Y‖² + λ‖W‖².
+
+The reference materializes each block as its own RDD and treeReduces
+per-block Grams to the driver; here the whole epoch×block loop is one
+compiled XLA computation over the row-sharded feature matrix
+(``parallel.linalg.block_coordinate_descent``) — block slicing is a
+``dynamic_slice`` on the device-resident array, and per-block Gram sums
+are one psum over ICI each.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...data.dataset import ArrayDataset, Dataset
+from ...parallel import linalg
+from ...parallel.mesh import get_mesh
+from ...workflow.pipeline import BatchTransformer, LabelEstimator
+from ..stats.core import _as_array_dataset
+
+
+class BlockLinearMapper(BatchTransformer):
+    """Apply a block-solved linear model: (x − μ_A)·W + b.
+
+    Equivalent to applying each feature-block's weights and summing the
+    partial predictions (reference: BlockLinearMapper.scala:50-73); on TPU
+    one fused matmul over the concatenated blocks is strictly better.
+    """
+
+    def __init__(
+        self,
+        weights: jnp.ndarray,  # (d_padded, k)
+        block_size: int,
+        intercept: Optional[jnp.ndarray] = None,
+        feature_mean: Optional[jnp.ndarray] = None,  # (d,)
+    ):
+        self.weights = jnp.asarray(weights)
+        self.block_size = block_size
+        self.intercept = None if intercept is None else jnp.asarray(intercept)
+        self.feature_mean = None if feature_mean is None else jnp.asarray(feature_mean)
+
+    def apply_arrays(self, x):
+        d = x.shape[-1]
+        if self.feature_mean is not None:
+            x = x - self.feature_mean
+        w = self.weights[:d]  # drop padded feature rows
+        out = linalg.mm(x, w)
+        if self.intercept is not None:
+            out = out + self.intercept
+        return out
+
+    def apply_and_evaluate(self, x, evaluator):
+        """Streaming per-block apply: after adding feature block i's
+        contribution, call ``evaluator`` with the cumulative predictions
+        (+ intercept, added per call, never into the running sum) —
+        reference: BlockLinearMapper.scala:89-135 applyAndEvaluate.
+
+        Only the running (n, k) sum and one block's partial product are
+        live at a time, so predictions for all blocks are never
+        materialized together — the point of the reference API, kept here
+        for HBM rather than executor memory. Returns the list of
+        evaluator results, one per block."""
+        x = jnp.asarray(x)
+        d = x.shape[-1]
+        if self.feature_mean is not None:
+            x = x - self.feature_mean
+        w = self.weights[:d]
+        results = []
+        acc = None
+        for start in range(0, d, self.block_size):
+            xb = x[:, start : start + self.block_size]
+            wb = w[start : start + self.block_size]
+            part = linalg.mm(xb, wb)
+            acc = part if acc is None else acc + part
+            cur = acc + self.intercept if self.intercept is not None else acc
+            results.append(evaluator(cur))
+        return results
+
+
+class BlockLeastSquaresEstimator(LabelEstimator):
+    """Feature-block coordinate-descent least squares
+    (reference: BlockLinearMapper.scala:199-283 BlockLeastSquaresEstimator).
+
+    ``num_iter`` full epochs over the feature blocks; λ is applied per
+    block. The node is weighted for the auto-cache planner the same way the
+    reference weights it: 3·num_iter + 1 passes over the data.
+    """
+
+    def __init__(
+        self,
+        block_size: int,
+        num_iter: int = 1,
+        reg: float = 0.0,
+        host_streaming: Optional[bool] = None,
+    ):
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.reg = reg
+        # None = auto: stream feature blocks from host RAM when the feature
+        # matrix is a host array too large to sit in HBM next to its
+        # centered copy and Gram workspace.
+        self.host_streaming = host_streaming
+
+    @property
+    def weight(self) -> int:
+        return 3 * self.num_iter + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        mesh = get_mesh()
+
+        raw = features.data
+        stream = self.host_streaming
+        if stream is None:
+            # Auto-stream only on pure data meshes: the streaming solver's
+            # shard_map spans the row axes only, so on a (data, model) mesh
+            # it would replicate every block's work across the model axis —
+            # the 2-D in-core path below owns that layout.
+            stream = (
+                isinstance(raw, np.ndarray)
+                and raw.nbytes > _host_streaming_threshold_bytes()
+                and linalg.model_axis_size(mesh) == 1
+            )
+        if stream:
+            reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(
+                np.asarray(raw[: min(features.num_examples, 4096)]),
+                features.num_examples,
+            )
+            w, mu_a, mu_b = linalg.block_coordinate_descent_streaming(
+                np.asarray(raw),
+                np.asarray(targets.data, np.float32),
+                reg=reg,
+                num_epochs=self.num_iter,
+                block_size=min(self.block_size, raw.shape[1]),
+                num_examples=features.num_examples,
+                mesh=mesh,
+            )
+            return BlockLinearMapper(
+                w, block_size=min(self.block_size, raw.shape[1]),
+                intercept=mu_b, feature_mean=mu_a,
+            )
+
+        x = jnp.asarray(features.data, dtype=jnp.float32)
+        y = jnp.asarray(targets.data, dtype=jnp.float32)
+        n = features.num_examples
+        d = x.shape[1]
+        mask = features.mask().reshape(-1, 1)
+
+        mu_a = jnp.sum(x * mask, axis=0) / n
+        mu_b = jnp.sum(y * mask, axis=0) / n
+        xc = (x - mu_a) * mask
+        yc = (y - mu_b) * mask
+
+        # The reg floor must see the REAL data statistics: computed here,
+        # before zero-row masking dilution (first n rows only) and before
+        # zero-column padding, either of which undershoots E[x²] and with
+        # it the intended 1e-6 of the mean Gram diagonal.
+        reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(xc[:n], n)
+
+        # Pad the feature dim to a whole number of blocks (zero columns are
+        # inert: their Gram rows/cols are zero and λ keeps the solve PD).
+        # On a 2-D (data, model) mesh each model group needs a whole number
+        # of blocks, so pad to model_axis·block columns.
+        block = min(self.block_size, d)
+        m = linalg.model_axis_size(mesh)
+        d_pad = _round_up(d, block * m)
+        if d_pad != d:
+            xc = jnp.pad(xc, ((0, 0), (0, d_pad - d)))
+        if m > 1:
+            xc = linalg.prepare_block_sharded(xc, mesh)
+            yc = linalg.prepare_block_sharded(yc, mesh, fine_rows=True)
+            w = linalg.block_coordinate_descent_2d(
+                xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
+            )
+        else:
+            xc = linalg.prepare_row_sharded(xc, mesh)
+            yc = linalg.prepare_row_sharded(yc, mesh)
+            w = linalg.block_coordinate_descent(
+                xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
+            )
+        return BlockLinearMapper(
+            w, block_size=block, intercept=mu_b, feature_mean=mu_a
+        )
+
+
+def _scale_aware_reg_floor(x_sample, n: int) -> float:
+    """λ floor for an unregularized BCD solve: 1e-6 of the mean Gram
+    diagonal (≈ 1e-6·n·E[x²]).
+
+    An ABSOLUTE 1e-6 floor is invisible next to Gram entries of O(n): a
+    rank-deficient block (more features than examples) then has condition
+    ~n·E[x²]/1e-6 ≫ fp32's Cholesky limit and the factor silently emits
+    NaNs — the model degrades to chance with no error raised. Relative to
+    the data scale, the floor keeps the factor finite while acting as a
+    minimum-norm tiebreak on the interpolating solution. ``x_sample`` may
+    be a row subset; only E[x²] is needed.
+    """
+    xs = jnp.asarray(x_sample, jnp.float32)
+    # The solvers fit CENTERED data; an uncentered sample with a large
+    # mean would overshoot the centered Gram scale by orders of
+    # magnitude. (Already-centered input makes this a no-op.)
+    xs = xs - jnp.mean(xs, axis=0, keepdims=True)
+    mean_sq = float(jnp.mean(jnp.square(xs)))
+    return max(1e-6 * n * mean_sq, 1e-6)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _host_streaming_threshold_bytes() -> int:
+    """Above this, a host ndarray feature matrix is streamed block-by-block
+    instead of placed whole in HBM. Default 4 GB (the in-core path also
+    materializes a centered copy, so real residency is ~2× + Gram
+    workspace); override with KEYSTONE_STREAM_BYTES."""
+    import os
+
+    return int(float(os.environ.get("KEYSTONE_STREAM_BYTES", 4e9)))

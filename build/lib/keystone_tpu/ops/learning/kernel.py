@@ -1,0 +1,343 @@
+"""Kernel methods: blockwise Gaussian kernel, Gauss-Seidel kernel ridge
+regression, and streaming kernel-block application.
+
+TPU-native re-design of the reference's kernel suite
+(reference: nodes/learning/KernelGenerator.scala:36-206,
+nodes/learning/KernelMatrix.scala:17-90,
+nodes/learning/KernelRidgeRegression.scala:37-275,
+nodes/learning/KernelBlockLinearMapper.scala:28-90).
+
+This is the framework's long-context machinery: the n×n kernel matrix is
+the quadratic-in-samples object (the attention-matrix analog) and is never
+materialized. The re-design maps the reference's Spark dataflow onto the
+mesh:
+
+- **Training (Gauss-Seidel BCD on the dual, arXiv:1602.05310).** Train
+  rows (and the dual model) are sharded over the ``data`` axis. Per column
+  block: the block's rows are assembled by a psum-scatter (the broadcast
+  analog), each shard computes its K(x_local, X_b) panel on the MXU,
+  K_bᵀW partial products psum over ICI, and the b×b regularized solve runs
+  replicated. The whole epochs×blocks loop is ONE compiled XLA program —
+  the reference needed a Spark job per block plus RDD lineage checkpoints
+  every 25 blocks (truncateLineage); with no lineage, that subsystem
+  disappears by construction.
+- **Application** (``KernelBlockLinearMapper``): ring rotation. Test rows
+  stay put; (train shard, dual-weight shard) pairs rotate around the ICI
+  ring via ppermute, each step contributing K(test_local, x_shard)·W_shard
+  — structurally ring attention.
+
+Behavioral parity: λ is applied as K_bb + λI (not λnI); per-epoch block
+permutation via ``block_permuter`` seed; the last short block is handled
+by zero-padding (padded rows solve to exactly zero duals).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...data.dataset import ArrayDataset, Dataset
+from ...parallel import linalg
+from ...parallel.collectives import shard_map
+from ...parallel.mesh import DATA_AXIS, REPLICA_AXIS, get_mesh, row_axes, row_shard_count
+from ...workflow.pipeline import BatchTransformer, Estimator, LabelEstimator, Transformer
+from ..stats.core import _as_array_dataset
+
+
+# ------------------------------------------------------------------- kernels
+
+
+def gaussian_kernel_block(xa, xb, gamma):
+    """exp(−γ‖a−b‖²) panel via one MXU matmul + fused exp epilogue.
+
+    Pure XLA by measurement: a hand-tiled Pallas version ran 1.6× slower
+    on v5e (see ops/pallas/__init__.py for the numbers) — the emitter
+    already keeps the squared-distance intermediate out of HBM."""
+    an = jnp.sum(xa * xa, axis=1, keepdims=True)
+    bn = jnp.sum(xb * xb, axis=1)
+    sq = an - 2.0 * linalg.mm(xa, xb.T) + bn
+    return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
+
+
+class KernelTransformer:
+    """Materializes kernel blocks against fixed training data
+    (reference: KernelGenerator.scala KernelTransformer + KernelMatrix)."""
+
+    def __init__(self, train: jnp.ndarray, gamma: float, num_train: int):
+        self.train = train  # (n_pad, d) row-sharded
+        self.gamma = gamma
+        self.num_train = num_train
+
+    def column_block(self, start: int, size: int) -> jnp.ndarray:
+        """K(X, X[start:start+size]) — (n_pad, size)."""
+        xb = lax.dynamic_slice(
+            self.train, (start, 0), (size, self.train.shape[1])
+        )
+        return gaussian_kernel_block(self.train, xb, self.gamma)
+
+    def diag_block(self, start: int, size: int) -> jnp.ndarray:
+        xb = lax.dynamic_slice(
+            self.train, (start, 0), (size, self.train.shape[1])
+        )
+        return gaussian_kernel_block(xb, xb, self.gamma)
+
+
+class BlockKernelMatrix:
+    """Cache-managing view over kernel column blocks
+    (reference: KernelMatrix.scala:50-90 BlockKernelMatrix). On TPU the
+    cache is HBM residency of computed panels."""
+
+    def __init__(self, transformer: KernelTransformer, cache_blocks: bool = True):
+        self.transformer = transformer
+        self.cache_blocks = cache_blocks
+        self._cache = {}
+
+    def __call__(self, start: int, size: int) -> jnp.ndarray:
+        key = (start, size)
+        if self.cache_blocks and key in self._cache:
+            return self._cache[key]
+        block = self.transformer.column_block(start, size)
+        if self.cache_blocks:
+            self._cache[key] = block
+        return block
+
+    def diag_block(self, start: int, size: int) -> jnp.ndarray:
+        return self.transformer.diag_block(start, size)
+
+    def unpersist(self) -> None:
+        self._cache.clear()
+
+
+class GaussianKernelGenerator(Estimator):
+    """reference: KernelGenerator.scala GaussianKernelGenerator."""
+
+    def __init__(self, gamma: float):
+        self.gamma = gamma
+
+    def fit(self, data: Dataset) -> KernelTransformer:
+        ds = _as_array_dataset(data)
+        mesh = get_mesh()
+        x = linalg.prepare_row_sharded(jnp.asarray(ds.data, jnp.float32), mesh)
+        return KernelTransformer(x, self.gamma, ds.num_examples)
+
+
+# ---------------------------------------------------------------------- KRR
+
+
+class KernelRidgeRegression(LabelEstimator):
+    """Gauss-Seidel block coordinate descent on the kernel dual."""
+
+    def __init__(
+        self,
+        kernel_generator: GaussianKernelGenerator,
+        reg: float,
+        block_size: int,
+        num_epochs: int,
+        block_permuter: Optional[int] = None,
+    ):
+        self.kernel_generator = kernel_generator
+        self.reg = reg
+        self.block_size = block_size
+        self.num_epochs = num_epochs
+        self.block_permuter = block_permuter
+
+    def fit(self, data: Dataset, labels: Dataset) -> "KernelBlockLinearMapper":
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        mesh = get_mesh()
+        n = features.num_examples
+        gamma = self.kernel_generator.gamma
+
+        bs = min(self.block_size, n)
+        ndev = row_shard_count(mesh)
+        # pad rows to lcm-ish: multiple of both block size and shard count
+        n_pad = _round_up_multiple(n, bs, ndev)
+
+        x = jnp.asarray(features.data, jnp.float32)
+        y = jnp.asarray(targets.data, jnp.float32)
+        x = _pad_rows_to(x, n_pad)
+        y = _pad_rows_to(y, n_pad)
+        x = linalg.prepare_row_sharded(x, mesh)
+        y = linalg.prepare_row_sharded(y, mesh)
+
+        num_blocks = n_pad // bs
+        rng = np.random.default_rng(self.block_permuter)
+        starts = []
+        for _ in range(self.num_epochs):
+            order = np.arange(num_blocks)
+            if self.block_permuter is not None:
+                rng.shuffle(order)
+            starts.extend((order * bs).tolist())
+        starts = jnp.asarray(np.asarray(starts, np.int32))
+
+        w = _krr_fit(mesh, bs)(
+            x, y, starts, jnp.float32(gamma), jnp.float32(self.reg), jnp.int32(n)
+        )
+        return KernelBlockLinearMapper(x, w, gamma, num_train=n, block_size=bs)
+
+
+@linalg.mode_cached()
+def _krr_fit(mesh: Mesh, bs: int):
+    axes = row_axes(mesh)
+    ndev = row_shard_count(mesh)
+
+    def per_device(x_local, y_local, starts, gamma, lam, n):
+        n_local, d = x_local.shape
+        k = y_local.shape[1]
+        n_pad = n_local * ndev
+        dev = _linear_shard_index(mesh, axes)
+        global_rows = dev * n_local + jnp.arange(n_local)
+        row_valid = (global_rows < n).astype(x_local.dtype)
+        eye = jnp.eye(bs, dtype=x_local.dtype)
+
+        def gather_block(mat, s):
+            """Assemble rows [s, s+bs) of the global matrix via psum-scatter."""
+            pos = global_rows - s
+            inside = (pos >= 0) & (pos < bs)
+            idx = jnp.where(inside, pos, bs)  # bs row = dropped
+            out = jnp.zeros((bs + 1, mat.shape[1]), mat.dtype)
+            out = out.at[idx].add(mat * inside[:, None].astype(mat.dtype))
+            return lax.psum(out[:bs], axes)
+
+        def step(w, s):
+            xb = gather_block(x_local, s)                     # (bs, d) replicated
+            col_valid = ((s + jnp.arange(bs)) < n).astype(x_local.dtype)
+            k_panel = gaussian_kernel_block(x_local, xb, gamma)
+            k_panel = k_panel * row_valid[:, None] * col_valid[None, :]
+            w_rows = lax.dynamic_slice(w, (dev * n_local, 0), (n_local, k))
+            resid = lax.psum(linalg.mm(k_panel.T, w_rows), axes)  # (bs, k)
+            kbb = gaussian_kernel_block(xb, xb, gamma)
+            kbb = kbb * col_valid[:, None] * col_valid[None, :]
+            w_b_old = lax.dynamic_slice(w, (s, 0), (bs, k))
+            y_b = gather_block(y_local, s)
+            rhs = y_b - (resid - linalg.mm(kbb.T, w_b_old))
+            factor = jax.scipy.linalg.cho_factor(kbb + lam * eye, lower=True)
+            w_b_new = jax.scipy.linalg.cho_solve(factor, rhs)
+            w = lax.dynamic_update_slice(w, w_b_new, (s, 0))
+            return w, None
+
+        w0 = jnp.zeros((n_pad, y_local.shape[1]), x_local.dtype)
+        w, _ = lax.scan(step, w0, starts)
+        return w
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(), P(), P(), P()),
+        out_specs=P(),
+    )
+    return jax.jit(fn)
+
+
+# ------------------------------------------------------------------- apply
+
+
+class KernelBlockLinearMapper(BatchTransformer):
+    """Apply the kernel model to test data via ring rotation
+    (reference: KernelBlockLinearMapper.scala:28-90, re-designed as ring
+    dataflow: the train/dual shards travel the ICI ring while test rows
+    stay put — the same schedule as ring attention)."""
+
+    def __init__(self, train: jnp.ndarray, duals: jnp.ndarray, gamma: float,
+                 num_train: int, block_size: int):
+        self.train = train      # (n_pad, d) row-sharded
+        self.duals = jnp.asarray(duals)  # (n_pad, k); zero rows at padding
+        self.gamma = gamma
+        self.num_train = num_train
+        self.block_size = block_size
+
+    def apply_arrays(self, x):
+        mesh = get_mesh()
+        ndev = row_shard_count(mesh)
+        m = x.shape[0]
+        m_pad = _round_up_multiple(m, ndev)
+        xt = linalg.prepare_row_sharded(_pad_rows_to(jnp.asarray(x, jnp.float32), m_pad), mesh)
+        train_sharded = linalg.prepare_row_sharded(self.train, mesh)
+        duals_sharded = linalg.prepare_row_sharded(self.duals, mesh)
+        # gamma is traced, so one compiled executable serves every gamma.
+        out = _ring_kernel_apply(mesh)(
+            xt, train_sharded, duals_sharded, jnp.float32(self.gamma)
+        )
+        return out[:m]
+
+
+@linalg.mode_cached()
+def _ring_kernel_apply(mesh: Mesh):
+    axes = row_axes(mesh)
+    nd = mesh.shape[DATA_AXIS]
+    nr = mesh.shape.get(REPLICA_AXIS, 1)
+    nshards = nd * nr
+
+    def per_device(xt_local, xs, ws, gamma):
+        data_perm = [(j, (j + 1) % nd) for j in range(nd)]
+        replica_perm = [(j, (j + 1) % nr) for j in range(nr)]
+
+        def hop_replica(val):
+            return lax.ppermute(val, REPLICA_AXIS, replica_perm)
+
+        def ring_step(i, carry):
+            acc, xs, ws = carry
+            panel = gaussian_kernel_block(xt_local, xs, gamma)
+            acc = acc + linalg.mm(panel, ws)
+            # inner ICI ring every step; after each full data cycle the
+            # shards hop once across the DCN replica ring, so nd*nr steps
+            # visit every (replica, data) shard exactly once.
+            xs = lax.ppermute(xs, DATA_AXIS, data_perm)
+            ws = lax.ppermute(ws, DATA_AXIS, data_perm)
+            if nr > 1:
+                do_hop = (i + 1) % nd == 0
+                xs = lax.cond(do_hop, hop_replica, lambda v: v, xs)
+                ws = lax.cond(do_hop, hop_replica, lambda v: v, ws)
+            return acc, xs, ws
+
+        acc0 = jnp.zeros((xt_local.shape[0], ws.shape[1]), xt_local.dtype)
+        acc, _, _ = lax.fori_loop(0, nshards, ring_step, (acc0, xs, ws))
+        return acc
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes, None), P()),
+        out_specs=P(axes, None),
+    )
+    return jax.jit(fn)  # gamma (4th arg) is replicated + traced
+
+
+def _linear_shard_index(mesh: Mesh, axes):
+    """Row-major linear index of this device's shard over ``axes``."""
+    idx = jnp.int32(0)
+    for axis in axes:
+        idx = idx * mesh.shape[axis] + lax.axis_index(axis)
+    return idx
+
+
+# -------------------------------------------------------------------- utils
+
+
+def _round_up_multiple(n: int, *multiples: int) -> int:
+    out = n
+    for m in multiples:
+        out = ((out + m - 1) // m) * m
+    # ensure divisibility by all (multiples are not necessarily coprime-safe
+    # after sequential rounding; iterate to fixpoint)
+    changed = True
+    while changed:
+        changed = False
+        for m in multiples:
+            if out % m != 0:
+                out = ((out + m - 1) // m) * m
+                changed = True
+    return out
+
+
+def _pad_rows_to(a: jnp.ndarray, target: int) -> jnp.ndarray:
+    if a.shape[0] == target:
+        return a
+    return jnp.pad(a, [(0, target - a.shape[0])] + [(0, 0)] * (a.ndim - 1))

@@ -1,0 +1,54 @@
+"""Multi-class linear discriminant analysis.
+
+TPU-native re-design of
+reference: nodes/learning/LinearDiscriminantAnalysis.scala:1-68 (Rao's
+multiple discriminant analysis via the eigendecomposition of S_W⁻¹·S_B).
+
+Scatter matrices are formed with batched MXU matmuls over the one-hot
+class-assignment matrix instead of host-side per-class grouping; the
+generalized eigenproblem is solved once, replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import Dataset
+from ...parallel import linalg
+from ...workflow.pipeline import LabelEstimator
+from ..stats.core import _as_array_dataset
+from .linear import LinearMapper
+
+
+class LinearDiscriminantAnalysis(LabelEstimator):
+    def __init__(self, num_dimensions: int):
+        self.num_dimensions = num_dimensions
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        x = np.asarray(jax.device_get(features.data), dtype=np.float64)[: features.num_examples]
+        y = np.asarray(jax.device_get(targets.data)).astype(np.int64).ravel()[: x.shape[0]]
+
+        classes = np.unique(y)
+        onehot = (y[:, None] == classes[None, :]).astype(np.float64)  # (n, c)
+        counts = onehot.sum(axis=0)                                   # (c,)
+        class_means = (onehot.T @ x) / counts[:, None]                # (c, d)
+        total_mean = x.mean(axis=0)
+
+        # Within-class scatter: Σ_c Σ_{i∈c} (x−μ_c)(x−μ_c)ᵀ
+        #                     = XᵀX − Σ_c n_c μ_c μ_cᵀ
+        sw = x.T @ x - (class_means.T * counts) @ class_means
+        # Between-class scatter: Σ_c n_c (μ_c−μ)(μ_c−μ)ᵀ
+        diff = class_means - total_mean
+        sb = (diff.T * counts) @ diff
+
+        eigvals, eigvecs = np.linalg.eig(np.linalg.solve(sw, sb))
+        order = np.argsort(-np.abs(eigvals))[: self.num_dimensions]
+        w = np.real(eigvecs[:, order])
+        return LinearMapper(jnp.asarray(w, dtype=jnp.float32))

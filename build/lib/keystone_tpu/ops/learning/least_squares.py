@@ -1,0 +1,165 @@
+"""Optimizable least-squares meta-solver.
+
+TPU-native re-design of reference:
+nodes/learning/LeastSquaresEstimator.scala:26-87 — a cost-model-driven
+choice among the concrete least-squares solvers:
+
+- dense L-BFGS          (few features, dense data)
+- Sparsify ∘ sparse L-BFGS  (sparse data)
+- Densify ∘ block solve (many features, dense)
+- Densify ∘ exact normal equations (few features)
+
+Statistics (n, d, k, sparsity) come from the node-level optimizer's sample
+pass; machine count from the mesh. Cost formulas mirror the reference's
+(flops / bytes-scanned / network per solver), with the caveat the
+reference itself documents: the weights were fit on its 16-node cluster
+and should be re-fit per deployment.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ...data.dataset import ArrayDataset, Dataset
+from ...parallel.mesh import num_devices
+from ...workflow.optimize import DataStats, Optimizable
+from ...workflow.pipeline import LabelEstimator, Transformer
+from .block import BlockLeastSquaresEstimator
+from .cost import (
+    DEFAULT_COST_WEIGHTS,
+    CostModel,
+    CostWeights,
+    default_cost_weights,
+)
+from .lbfgs import DenseLBFGSEstimator, SparseLBFGSEstimator
+from .linear import LinearMapEstimator
+
+
+class _DenseLBFGSCost(CostModel):
+    def cost(self, n, d, k, sparsity, num_machines, w=DEFAULT_COST_WEIGHTS):
+        iters = 20
+        flops = iters * n * d * k * max(sparsity, 1e-12) / num_machines
+        bytes_scanned = iters * n * d * max(sparsity, 1e-12) / num_machines
+        network = iters * d * k * np.log2(max(num_machines, 2))
+        return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
+
+
+class _SparseLBFGSCost(_DenseLBFGSCost):
+    pass
+
+
+class _BlockSolveCost(CostModel):
+    def __init__(self, block_size=1000, num_iter=3):
+        self.block_size = block_size
+        self.num_iter = num_iter
+
+    def cost(self, n, d, k, sparsity, num_machines, w=DEFAULT_COST_WEIGHTS):
+        b = self.block_size
+        iters = self.num_iter * max(d // b, 1)
+        flops = iters * (n * b * (b + k)) / num_machines
+        bytes_scanned = iters * n * b / num_machines
+        network = iters * (b * b + b * k) * np.log2(max(num_machines, 2))
+        return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
+
+
+class _ExactCost(CostModel):
+    def cost(self, n, d, k, sparsity, num_machines, w=DEFAULT_COST_WEIGHTS):
+        flops = n * d * (d + k) / num_machines + d * d * d
+        bytes_scanned = n * d / num_machines + d * d
+        network = d * (d + k)
+        return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
+
+
+class LeastSquaresEstimator(LabelEstimator, Optimizable):
+    """Meta-solver choosing the concrete least-squares implementation."""
+
+    def __init__(
+        self,
+        reg: float = 0.0,
+        num_machines: Optional[int] = None,
+        weights: Optional[CostWeights] = None,
+        sparse_threshold: float = 0.2,
+        block_size: int = 1000,
+        block_iters: int = 3,
+    ):
+        self.reg = reg
+        self.num_machines = num_machines
+        # None → resolved per-backend at optimize() time (measured-TPU
+        # constants on accelerators, the reference's on CPU).
+        self.weights = weights
+        self.sparse_threshold = sparse_threshold
+        self.block_size = block_size
+        self.block_iters = block_iters
+
+    # default implementation when node-level optimization never ran
+    def fit(self, data: Dataset, labels: Dataset) -> Transformer:
+        return self._default().fit(data, labels)
+
+    def _default(self) -> LabelEstimator:
+        return DenseLBFGSEstimator(reg=self.reg)
+
+    def optimize(self, samples: List[Dataset], stats: DataStats):
+        sample_x = samples[0]
+        n = stats.n_total
+        d, k, sparsity = _sample_shape_stats(sample_x, samples[1] if len(samples) > 1 else None)
+        machines = self.num_machines or num_devices()
+        # Resolve per call, not in __init__: the right weights depend on
+        # the backend active when planning runs.
+        weights = self.weights if self.weights is not None else default_cost_weights()
+
+        candidates = [
+            (
+                _SparseLBFGSCost().cost(n, d, k, sparsity, machines, weights)
+                if sparsity < self.sparse_threshold
+                else np.inf,
+                SparseLBFGSEstimator(reg=self.reg),
+            ),
+            (
+                _DenseLBFGSCost().cost(n, d, k, 1.0, machines, weights),
+                DenseLBFGSEstimator(reg=self.reg),
+            ),
+            (
+                _BlockSolveCost(self.block_size, self.block_iters).cost(
+                    n, d, k, 1.0, machines, weights
+                ),
+                BlockLeastSquaresEstimator(
+                    self.block_size, num_iter=self.block_iters, reg=self.reg
+                ),
+            ),
+            (
+                _ExactCost().cost(n, d, k, 1.0, machines, weights),
+                LinearMapEstimator(reg=self.reg),
+            ),
+        ]
+        return min(candidates, key=lambda c: c[0])[1]
+
+
+def _sample_shape_stats(sample_x: Dataset, sample_y: Optional[Dataset]):
+    import jax
+
+    if isinstance(sample_x, ArrayDataset):
+        x = np.asarray(jax.device_get(sample_x.data))[: sample_x.num_examples]
+        d = x.shape[1] if x.ndim > 1 else 1
+        sparsity = float((x != 0).mean())
+    else:
+        items = sample_x.take(32)
+        first = items[0]
+        if hasattr(first, "nnz"):  # scipy sparse rows
+            d = first.shape[1]
+            nnz = sum(i.nnz for i in items)
+            sparsity = nnz / (len(items) * d)
+        else:
+            arr = np.stack([np.asarray(i) for i in items])
+            d = arr.shape[1]
+            sparsity = float((arr != 0).mean())
+    if sample_y is not None and isinstance(sample_y, ArrayDataset):
+        ydata = np.asarray(jax.device_get(sample_y.data))
+        k = ydata.shape[1] if ydata.ndim > 1 else 1
+    elif sample_y is not None:
+        items = sample_y.take(1)
+        k = np.asarray(items[0]).size if items else 1
+    else:
+        k = 1
+    return d, k, sparsity

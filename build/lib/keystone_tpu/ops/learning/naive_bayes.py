@@ -1,0 +1,61 @@
+"""Multinomial naive Bayes.
+
+TPU-native re-design of reference: nodes/learning/NaiveBayesModel.scala:21-69
+(which delegated fitting to Spark MLlib's NaiveBayes). Here the fit is two
+masked matmuls over the sharded batch: per-class feature sums (one-hot
+labelsᵀ · X on the MXU) and class counts, followed by the standard
+additively-smoothed log estimates. The model maps features to per-class
+log-posteriors  π + Θ·x.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import Dataset
+from ...parallel import linalg
+from ...workflow.pipeline import BatchTransformer, LabelEstimator
+from ..stats.core import _as_array_dataset
+
+
+class NaiveBayesModel(BatchTransformer):
+    def __init__(self, pi: jnp.ndarray, theta: jnp.ndarray):
+        self.pi = jnp.asarray(pi)        # (k,) log priors
+        self.theta = jnp.asarray(theta)  # (k, d) log conditionals
+
+    def apply_arrays(self, x):
+        return self.pi + linalg.mm(x, self.theta.T)
+
+
+class NaiveBayesEstimator(LabelEstimator):
+    """lambda-smoothed multinomial NB (reference: NaiveBayesModel.scala:57-69)."""
+
+    def __init__(self, num_classes: int, smoothing: float = 1.0):
+        self.num_classes = num_classes
+        self.smoothing = smoothing
+
+    def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        x = jnp.asarray(features.data, dtype=jnp.float32)
+        y = jnp.asarray(targets.data).astype(jnp.int32).ravel()[: x.shape[0]]
+        mask = features.mask()
+        pi, theta = _nb_fit(
+            x, y, mask, self.num_classes, jnp.float32(self.smoothing)
+        )
+        return NaiveBayesModel(pi, theta)
+
+
+@functools.partial(linalg.mode_jit, static_argnums=(3,))
+def _nb_fit(x, y, mask, num_classes, lam):
+    onehot = jax.nn.one_hot(y, num_classes, dtype=x.dtype) * mask[:, None]
+    class_counts = jnp.sum(onehot, axis=0)                  # (k,)
+    feature_sums = linalg.mm(onehot.T, x)                   # (k, d)
+    n = jnp.sum(class_counts)
+    pi = jnp.log(class_counts + lam) - jnp.log(n + num_classes * lam)
+    denom = jnp.sum(feature_sums, axis=1, keepdims=True) + lam * x.shape[1]
+    theta = jnp.log(feature_sums + lam) - jnp.log(denom)
+    return pi, theta

@@ -1,0 +1,36 @@
+"""Text / NLP operators (reference: nodes/nlp/)."""
+
+from .corenlp import CoreNLPFeatureExtractor, lemmatize
+from .indexers import NaiveBitPackIndexer, NGramIndexer
+from .stupid_backoff import StupidBackoffEstimator, StupidBackoffModel
+from .text import (
+    HashingTF,
+    LowerCase,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    TermFrequency,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    WordFrequencyTransformer,
+)
+
+__all__ = [
+    "CoreNLPFeatureExtractor",
+    "lemmatize",
+    "HashingTF",
+    "LowerCase",
+    "NGramsCounts",
+    "NGramsFeaturizer",
+    "NGramsHashingTF",
+    "NaiveBitPackIndexer",
+    "NGramIndexer",
+    "StupidBackoffEstimator",
+    "StupidBackoffModel",
+    "TermFrequency",
+    "Tokenizer",
+    "Trim",
+    "WordFrequencyEncoder",
+    "WordFrequencyTransformer",
+]

@@ -1,0 +1,50 @@
+"""Branch-merging gather operator.
+
+TPU-native re-design of the reference's gather node
+(reference: workflow/GatherTransformerOperator.scala:9,
+workflow/Pipeline.scala:119-154). Per input item it emits the list of all
+branch outputs; when every branch produced device arrays the gathered form
+is a tuple-pytree ``ArrayDataset`` so downstream concatenation
+(``VectorCombiner``) stays a single fused XLA op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ...workflow.operators import TransformerOperator
+
+
+class GatherTransformer(TransformerOperator):
+    @property
+    def label(self) -> str:
+        return "Gather"
+
+    def single_transform(self, datums: List[Any]) -> Any:
+        return list(datums)
+
+    def batch_transform(self, datasets: List[Dataset]) -> Dataset:
+        from ...data.dataset import BucketedDataset
+
+        if all(isinstance(d, BucketedDataset) for d in datasets):
+            counts = {tuple(len(b) for b in d.buckets) for d in datasets}
+            if len(counts) == 1:  # aligned buckets: gather bucket-wise
+                return BucketedDataset(
+                    [
+                        self.batch_transform(list(bs))
+                        for bs in zip(*(d.buckets for d in datasets))
+                    ]
+                )
+        if all(isinstance(d, ArrayDataset) for d in datasets):
+            import jax
+
+            n = min(d.num_examples for d in datasets)
+            phys = min(d.physical_rows for d in datasets)
+            data = tuple(
+                jax.tree_util.tree_map(lambda a: a[:phys], d.data) if d.physical_rows != phys else d.data
+                for d in datasets
+            )
+            return ArrayDataset(data, num_examples=n)
+        collected = [d.collect() for d in datasets]
+        return ObjectDataset([list(row) for row in zip(*collected)])

@@ -1,0 +1,70 @@
+"""Label encoding and argmax-style classifiers.
+
+TPU-native re-designs of:
+- ``ClassLabelIndicatorsFromIntLabels`` / ``FromIntArrayLabels``
+  (reference: nodes/util/ClassLabelIndicators.scala:15-60): ±1 one-hot
+  label matrices.
+- ``MaxClassifier`` (reference: nodes/util/MaxClassifier.scala): argmax.
+- ``TopKClassifier`` (reference: nodes/util/TopKClassifier.scala): indices
+  of the k largest scores, descending.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ...workflow.pipeline import BatchTransformer, Transformer
+
+
+class ClassLabelIndicators(BatchTransformer):
+    """int label i → length-k vector of -1s with +1 at position i."""
+
+    def __init__(self, num_classes: int):
+        assert num_classes > 1, "num_classes must be > 1"
+        self.num_classes = num_classes
+
+    def apply_arrays(self, labels):
+        labels = jnp.asarray(labels).astype(jnp.int32)
+        onehot = jnp.full((labels.shape[0], self.num_classes), -1.0, dtype=jnp.float32)
+        return onehot.at[jnp.arange(labels.shape[0]), labels].set(1.0)
+
+
+class MultiLabelIndicators(Transformer):
+    """list of int labels → ±1 multi-hot vector."""
+
+    def __init__(self, num_classes: int):
+        assert num_classes > 1
+        self.num_classes = num_classes
+
+    def apply(self, labels: Sequence[int]):
+        vec = np.full(self.num_classes, -1.0, dtype=np.float32)
+        vec[np.asarray(list(labels), dtype=np.int64)] = 1.0
+        return vec
+
+    def apply_batch(self, dataset: Dataset) -> ArrayDataset:
+        return ArrayDataset(np.stack([self.apply(i) for i in dataset.collect()]))
+
+
+class MaxClassifier(BatchTransformer):
+    """scores (n, k) → argmax int (n,)."""
+
+    def apply_arrays(self, scores):
+        return jnp.argmax(scores, axis=-1).astype(jnp.int32)
+
+
+class TopKClassifier(BatchTransformer):
+    """scores (n, c) → (n, k) class indices, best first."""
+
+    def __init__(self, k: int):
+        self.k = k
+
+    def apply_arrays(self, scores):
+        from jax import lax
+
+        _, idx = lax.top_k(scores, self.k)
+        return idx.astype(jnp.int32)

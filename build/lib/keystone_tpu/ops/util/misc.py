@@ -1,0 +1,66 @@
+"""Caching / shuffling / identity utility operators.
+
+TPU-native re-design of the reference's RDD-level utilities
+(reference: nodes/util/Cacher.scala:15-25, nodes/util/Shuffler.scala:15-22).
+
+On TPU, "caching" is a residency decision rather than a lineage cut:
+``hbm`` keeps the materialized batch on device; ``host`` pulls it to host
+RAM (freeing HBM for later stages) and re-feeds it on demand. The
+auto-cache planner (workflow/autocache.py) inserts these.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+import jax
+
+from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ...workflow.operators import TransformerOperator
+
+
+class CacherOperator(TransformerOperator):
+    """Identity marker that pins its input at a storage level."""
+
+    def __init__(self, name: str = "", level: str = "hbm"):
+        assert level in ("hbm", "host")
+        self.name = name
+        self.level = level
+
+    @property
+    def label(self) -> str:
+        return f"Cache[{self.name or self.level}]"
+
+    def single_transform(self, datums: List[Any]) -> Any:
+        return datums[0]
+
+    def batch_transform(self, datasets: List[Dataset]) -> Dataset:
+        ds = datasets[0]
+        if self.level == "host" and isinstance(ds, ArrayDataset):
+            host_data = jax.tree_util.tree_map(np.asarray, ds.data)
+            return ArrayDataset(host_data, ds.num_examples)
+        return ds.cache()
+
+
+class ShufflerOperator(TransformerOperator):
+    """Random permutation of the example axis
+    (reference: nodes/util/Shuffler.scala:15-22)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+
+    def single_transform(self, datums: List[Any]) -> Any:
+        return datums[0]
+
+    def batch_transform(self, datasets: List[Dataset]) -> Dataset:
+        ds = datasets[0]
+        rng = np.random.default_rng(self.seed)
+        if isinstance(ds, ArrayDataset):
+            perm = rng.permutation(ds.num_examples)
+            data = jax.tree_util.tree_map(lambda a: np.asarray(a)[:ds.num_examples][perm], ds.data)
+            return ArrayDataset(data, ds.num_examples)
+        items = ds.collect()
+        rng.shuffle(items)
+        return ObjectDataset(items, ds.num_shards)

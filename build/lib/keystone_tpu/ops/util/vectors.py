@@ -1,0 +1,138 @@
+"""Vector shaping / conversion operators.
+
+TPU-native re-designs of reference nodes:
+- ``VectorCombiner`` (reference: nodes/util/VectorCombiner.scala) — concat
+  gathered branch outputs feature-wise.
+- ``VectorSplitter`` (reference: nodes/util/VectorSplitter.scala:10-37) —
+  the feature-block primitive feeding block solvers.
+- ``Densify``/``Sparsify`` (reference: nodes/util/Densify.scala,
+  Sparsify.scala) — dense arrays ↔ host scipy-style sparse datasets.
+- ``Cast`` (reference: nodes/util/FloatToDouble.scala) — dtype change; on
+  TPU the interesting move is fp32 ↔ bf16.
+- ``MatrixVectorizer`` (reference: nodes/util/MatrixVectorizer.scala).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ...workflow.pipeline import BatchTransformer, Transformer
+
+
+class VectorCombiner(BatchTransformer):
+    """Concatenate a gathered tuple of (n, d_i) arrays into (n, Σd_i)."""
+
+    def apply_arrays(self, data):
+        if isinstance(data, (tuple, list)):
+            parts = [jnp.asarray(p) for p in data]
+            flat = [p.reshape(p.shape[0], -1) for p in parts]
+            return jnp.concatenate(flat, axis=-1)
+        return jnp.asarray(data)
+
+    def apply(self, datum):
+        parts = [np.asarray(p).ravel() for p in datum]
+        return np.concatenate(parts)
+
+
+class VectorSplitter(Transformer):
+    """Split an (n, d) dataset into feature blocks [(n, b), ...].
+
+    The reference materializes ``Seq[RDD[DenseVector]]``; here a block is a
+    column slice view of the same device array, so no copy happens until a
+    solver touches the block.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+
+    def split(self, dataset: Dataset) -> List[ArrayDataset]:
+        ds = dataset if isinstance(dataset, ArrayDataset) else dataset.to_arrays()  # type: ignore
+        x = ds.data
+        d = x.shape[1]
+        blocks = []
+        for start in range(0, d, self.block_size):
+            end = min(start + self.block_size, d)
+            blocks.append(ArrayDataset(x[:, start:end], ds.num_examples))
+        return blocks
+
+    def apply(self, datum):
+        vec = np.asarray(datum)
+        return [
+            vec[s : s + self.block_size] for s in range(0, len(vec), self.block_size)
+        ]
+
+    def apply_batch(self, dataset: Dataset) -> ObjectDataset:
+        blocks = self.split(dataset)
+        return ObjectDataset(blocks)
+
+
+class Cast(BatchTransformer):
+    """Dtype conversion (the FloatToDouble analog; on TPU: fp32/bf16)."""
+
+    def __init__(self, dtype):
+        self.dtype = jnp.dtype(dtype)
+
+    @property
+    def label(self) -> str:
+        return f"Cast[{self.dtype}]"
+
+    def apply_arrays(self, data):
+        return jax.tree_util.tree_map(lambda a: a.astype(self.dtype), data)
+
+
+class FloatToDouble(Cast):
+    """Name-parity alias; on TPU promotes to fp32 (f64 is emulated/slow)."""
+
+    def __init__(self):
+        super().__init__(jnp.float32)
+
+
+class MatrixVectorizer(BatchTransformer):
+    """Flatten per-item matrices: (n, r, c) → (n, r·c)."""
+
+    def apply_arrays(self, x):
+        return x.reshape(x.shape[0], -1)
+
+
+class Densify(Transformer):
+    """Sparse host dataset → dense device array."""
+
+    def apply(self, datum):
+        if hasattr(datum, "toarray"):  # scipy sparse
+            return np.asarray(datum.toarray()).ravel()
+        return np.asarray(datum)
+
+    def apply_batch(self, dataset: Dataset) -> ArrayDataset:
+        if isinstance(dataset, ArrayDataset):
+            return dataset
+        items = dataset.collect()
+        if items and hasattr(items[0], "toarray"):
+            import scipy.sparse as sp
+
+            stacked = sp.vstack(items).toarray()
+            return ArrayDataset(np.asarray(stacked, dtype=np.float32))
+        return ArrayDataset(np.stack([self.apply(i) for i in items]))
+
+
+class Sparsify(Transformer):
+    """Dense dataset → host CSR rows (for the sparse solver path)."""
+
+    def apply(self, datum):
+        import scipy.sparse as sp
+
+        return sp.csr_matrix(np.asarray(datum).reshape(1, -1))
+
+    def apply_batch(self, dataset: Dataset) -> ObjectDataset:
+        import scipy.sparse as sp
+
+        if isinstance(dataset, ArrayDataset):
+            host = np.asarray(jax.device_get(dataset.data))[: dataset.num_examples]
+            mat = sp.csr_matrix(host)
+            return ObjectDataset([mat[i] for i in range(mat.shape[0])])
+        return ObjectDataset([self.apply(i) for i in dataset.collect()])

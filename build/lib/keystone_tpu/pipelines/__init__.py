@@ -1,0 +1,24 @@
+"""End-to-end workloads (reference: src/main/scala/keystoneml/pipelines/).
+
+Each module exposes a config dataclass, ``build_pipeline`` builders, and a
+``run(config)`` driver returning a results dict — the analog of the
+reference's scopt-parsed ``object ... { def run(sc, config) }`` programs.
+"""
+
+import importlib
+
+__all__ = [
+    "cifar",
+    "imagenet",
+    "mnist_random_fft",
+    "stupid_backoff",
+    "text",
+    "timit",
+    "voc",
+]
+
+
+def __getattr__(name):  # PEP 562: import workload modules on first access
+    if name in __all__:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

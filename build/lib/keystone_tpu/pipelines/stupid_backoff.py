@@ -1,0 +1,74 @@
+"""Stupid Backoff language-model workload.
+
+Reference: pipelines/nlp/StupidBackoffPipeline.scala — tokenize a corpus,
+fit a frequency vocabulary, featurize 2..n-grams over encoded ids, count
+them, and fit the Stupid Backoff scorer.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+
+from ..data.dataset import ObjectDataset
+from ..ops.nlp import (
+    NGramsCounts,
+    NGramsFeaturizer,
+    StupidBackoffEstimator,
+    StupidBackoffModel,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class StupidBackoffConfig:
+    train_data: str = ""
+    n: int = 3
+
+
+def fit_language_model(lines, n: int = 3) -> StupidBackoffModel:
+    text = Tokenizer().apply_batch(ObjectDataset(list(lines)))
+    frequency_encode = WordFrequencyEncoder().fit(text)
+    unigram_counts = frequency_encode.unigram_counts
+
+    make_ngrams = frequency_encode.to_pipeline().then(NGramsFeaturizer(range(2, n + 1)))
+    ngram_counts = NGramsCounts("no_add")(make_ngrams(text))
+    return StupidBackoffEstimator(unigram_counts).fit(ngram_counts)
+
+
+def _synthetic_corpus(num_lines: int = 2000, seed: int = 0) -> list:
+    """Zipf-sampled sentences over a small vocabulary — the repo's
+    no-data-provided convention (like mnist_random_fft's synthetic path)
+    so the workload runs end-to-end out of the box."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(500)]
+    ranks = np.arange(1, len(vocab) + 1, dtype=np.float64)
+    p = (1.0 / ranks) / np.sum(1.0 / ranks)
+    return [
+        " ".join(rng.choice(vocab, size=rng.integers(4, 12), p=p))
+        for _ in range(num_lines)
+    ]
+
+
+def run(config: StupidBackoffConfig) -> dict:
+    start = time.time()
+    if config.train_data:
+        with open(config.train_data) as f:
+            lines = [l for l in f.read().splitlines() if l.strip()]
+    else:
+        logger.info("no --train-data given: using a synthetic Zipf corpus")
+        lines = _synthetic_corpus()
+    model = fit_language_model(lines, config.n)
+    logger.info(
+        "number of tokens: %d | vocab: %d | ngrams: %d",
+        model.num_tokens,
+        len(model.unigram_counts),
+        len(model.scores),
+    )
+    return {"model": model, "seconds": time.time() - start}

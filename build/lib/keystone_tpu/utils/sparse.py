@@ -1,0 +1,18 @@
+"""Small shared sparse-construction helpers."""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+
+
+def csr_row(values: Mapping[int, float], num_features: int):
+    """Build a (1, num_features) scipy CSR row from a {column: value} map."""
+    import scipy.sparse as sp
+
+    if not values:
+        return sp.csr_matrix((1, num_features))
+    cols = np.fromiter(values.keys(), dtype=np.int64)
+    vals = np.fromiter(values.values(), dtype=np.float64)
+    return sp.csr_matrix((vals, (np.zeros_like(cols), cols)), shape=(1, num_features))

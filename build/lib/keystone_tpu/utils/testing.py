@@ -1,0 +1,49 @@
+"""Shared approximate-equality helpers for tests and numerics checks.
+
+Analog of the reference's ``Stats.aboutEq`` family (reference:
+src/main/scala/keystoneml/utils/Stats.scala:16-62): elementwise
+absolute-difference comparison with a single default threshold, plus an
+assertion form that reports the worst offender on failure. One helper
+replaces the ad-hoc ``allclose`` variants scattered through the test
+suite so tolerance policy lives in one place.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Default margin, matching the reference's ``Stats.thresh`` scaled up to
+#: float32 arithmetic (the reference computes in float64; most of this
+#: framework computes in float32 where 1e-8 is below the ulp at O(1)).
+THRESH = 1e-8
+THRESH_F32 = 1e-4
+
+
+def about_eq(a, b, thresh: float | None = None) -> bool:
+    """True iff ``a`` and ``b`` have equal shape and every elementwise
+    absolute difference is below ``thresh`` (elementwise, like the
+    reference — not norm-based)."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        return False
+    if thresh is None:
+        thresh = THRESH if a.dtype == np.float64 and b.dtype == np.float64 else THRESH_F32
+    return bool(np.all(np.abs(a - b) < thresh))
+
+
+def assert_about_eq(a, b, thresh: float | None = None, msg: str = "") -> None:
+    """Assert elementwise closeness; on failure report max |a-b| and where."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    assert a.shape == b.shape, f"shape mismatch {a.shape} vs {b.shape}. {msg}"
+    if thresh is None:
+        thresh = THRESH if a.dtype == np.float64 and b.dtype == np.float64 else THRESH_F32
+    diff = np.abs(np.asarray(a, dtype=np.float64) - np.asarray(b, dtype=np.float64))
+    worst = float(diff.max()) if diff.size else 0.0
+    if not worst < thresh:
+        idx = np.unravel_index(int(np.argmax(diff)), diff.shape) if diff.ndim else ()
+        raise AssertionError(
+            f"max |a-b| = {worst:.3e} >= {thresh:.1e} at index {idx}: "
+            f"a={np.asarray(a)[idx]!r} b={np.asarray(b)[idx]!r}. {msg}"
+        )

@@ -1,0 +1,54 @@
+"""Pipeline workflow layer: graph IR, operators, executor, optimizer, typed API."""
+
+from .graph import Graph, NodeId, SinkId, SourceId
+from .operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    Expression,
+    ExpressionOperator,
+    Operator,
+    TransformerOperator,
+)
+from .executor import GraphExecutor, PipelineEnv
+from .pipeline import (
+    BatchTransformer,
+    Chainable,
+    Estimator,
+    FittedPipeline,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineResult,
+    Transformer,
+)
+from .prefix import Prefix, find_prefix
+from .rules import (
+    Batch,
+    EquivalentNodeMergeRule,
+    Rule,
+    RuleExecutor,
+    UnusedBranchRemovalRule,
+    auto_caching_optimizer,
+    default_optimizer,
+)
+from .optimize import DataStats, NodeOptimizationRule, Optimizable
+from .tracing import PipelineTrace, current_trace, trace
+
+__all__ = [
+    "Graph", "NodeId", "SinkId", "SourceId",
+    "Operator", "DatasetOperator", "DatumOperator", "DelegatingOperator",
+    "EstimatorOperator", "ExpressionOperator", "TransformerOperator", "Expression",
+    "GraphExecutor", "PipelineEnv",
+    "Transformer", "BatchTransformer", "Estimator", "LabelEstimator",
+    "Pipeline", "FittedPipeline", "Identity", "Chainable",
+    "PipelineResult", "PipelineDataset", "PipelineDatum",
+    "Prefix", "find_prefix",
+    "Rule", "Batch", "RuleExecutor", "EquivalentNodeMergeRule",
+    "UnusedBranchRemovalRule", "default_optimizer", "auto_caching_optimizer",
+    "DataStats", "NodeOptimizationRule", "Optimizable",
+    "PipelineTrace", "current_trace", "trace",
+]

@@ -1,0 +1,100 @@
+"""Graph analysis: ancestry, reachability, deterministic linearization.
+
+TPU-native re-design of the reference's graph analyses
+(reference: workflow/AnalysisUtils.scala:3-122).
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from .graph import Graph, GraphId, NodeId, SinkId, SourceId
+
+
+def get_parents(graph: Graph, vid: GraphId) -> List[GraphId]:
+    """Direct dependencies of a vertex, in order."""
+    if isinstance(vid, SinkId):
+        return [graph.get_sink_dependency(vid)]
+    if isinstance(vid, NodeId):
+        return list(graph.get_dependencies(vid))
+    return []
+
+
+def get_children(graph: Graph, vid: GraphId) -> Set[GraphId]:
+    """All vertices that directly consume ``vid``."""
+    children: Set[GraphId] = set()
+    for node, deps in graph.dependencies.items():
+        if vid in deps:
+            children.add(node)
+    for sink, dep in graph.sink_dependencies.items():
+        if dep == vid:
+            children.add(sink)
+    return children
+
+
+def get_ancestors(graph: Graph, vid: GraphId) -> Set[GraphId]:
+    """Transitive closure of parents (excluding ``vid`` itself)."""
+    seen: Set[GraphId] = set()
+    stack = get_parents(graph, vid)
+    while stack:
+        v = stack.pop()
+        if v in seen:
+            continue
+        seen.add(v)
+        stack.extend(get_parents(graph, v))
+    return seen
+
+
+def get_descendants(graph: Graph, vid: GraphId) -> Set[GraphId]:
+    """Transitive closure of children (excluding ``vid`` itself)."""
+    seen: Set[GraphId] = set()
+    stack = list(get_children(graph, vid))
+    while stack:
+        v = stack.pop()
+        if v in seen:
+            continue
+        seen.add(v)
+        stack.extend(get_children(graph, v))
+    return seen
+
+
+def linearize(graph: Graph, vid: GraphId) -> List[GraphId]:
+    """Deterministic topological order of ``vid``'s ancestors plus ``vid``.
+
+    Depth-first post-order with ordered dependency traversal, so equal graphs
+    always linearize identically (reference: AnalysisUtils.scala topological
+    linearization).
+    """
+    order: List[GraphId] = []
+    seen: Set[GraphId] = set()
+
+    def visit(v: GraphId) -> None:
+        if v in seen:
+            return
+        seen.add(v)
+        for parent in get_parents(graph, v):
+            visit(parent)
+        order.append(v)
+
+    visit(vid)
+    return order
+
+
+def linearize_whole(graph: Graph) -> List[GraphId]:
+    """Topological order over the entire graph (all sinks, sorted)."""
+    order: List[GraphId] = []
+    seen: Set[GraphId] = set()
+
+    def visit(v: GraphId) -> None:
+        if v in seen:
+            return
+        seen.add(v)
+        for parent in get_parents(graph, v):
+            visit(parent)
+        order.append(v)
+
+    for sink in sorted(graph.sink_dependencies):
+        visit(sink)
+    for node in sorted(graph.operators):
+        visit(node)
+    return order

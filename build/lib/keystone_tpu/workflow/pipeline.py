@@ -1,0 +1,488 @@
+"""Typed pipeline API: Transformer / Estimator / LabelEstimator / Pipeline.
+
+TPU-native re-design of the reference's public facade
+(reference: workflow/Transformer.scala:18-70, workflow/Estimator.scala:10-62,
+workflow/LabelEstimator.scala:13-100, workflow/Chainable.scala:13-126,
+workflow/Pipeline.scala:22-155, workflow/FittedPipeline.scala:22-48).
+
+Semantics preserved from the reference:
+
+- ``a >> b >> est.with_data(data)`` builds an immutable DAG; nothing runs
+  until a result is forced.
+- Applying a pipeline yields lazy ``PipelineDataset``/``PipelineDatum``
+  handles; forcing ``.get()`` runs the optimizer once, then executes with
+  memoization.
+- Estimators bound to data fit **once** per process even across repeated
+  applications — results are memoized under structural prefixes in the
+  process-wide state table.
+- ``Pipeline.fit()`` executes every estimator, splices the fit transformers
+  in place, prunes fit-time-only branches, and returns a serializable
+  ``FittedPipeline`` containing only transformers.
+
+What is different on TPU: datasets are sharded device batches rather than
+RDDs, and transformer ``apply_batch`` implementations are jitted XLA
+computations over whole batches rather than per-partition JVM loops.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from ..data.dataset import ArrayDataset, Dataset, ObjectDataset, as_dataset
+from .executor import GraphExecutor, PipelineEnv
+from .graph import Graph, NodeId, NodeOrSourceId, SinkId, SourceId
+from .operators import (
+    DatasetOperator,
+    DatumOperator,
+    DelegatingOperator,
+    EstimatorOperator,
+    Expression,
+    TransformerOperator,
+)
+from .rules import UnusedBranchRemovalRule
+
+
+# --------------------------------------------------------------------- results
+
+
+class PipelineResult:
+    """Lazy handle on a pipeline output
+    (reference: workflow/PipelineResult.scala:13-20)."""
+
+    def __init__(self, executor: GraphExecutor, sink: SinkId, graph: Graph):
+        self._executor = executor
+        self._sink = sink
+        self.graph = graph  # unoptimized graph, for further composition
+
+    def get(self) -> Any:
+        return self._executor.execute(self._sink).get()
+
+
+class PipelineDataset(PipelineResult):
+    """Lazy dataset result; duck-types enough of Dataset for evaluators."""
+
+    def collect(self) -> List[Any]:
+        return self.get().collect()
+
+    def __len__(self) -> int:
+        return len(self.get())
+
+
+class PipelineDatum(PipelineResult):
+    pass
+
+
+# -------------------------------------------------------------------- chaining
+
+
+class Chainable:
+    """Mixin providing ``then`` / ``>>`` composition
+    (reference: workflow/Chainable.scala:13-126)."""
+
+    def to_pipeline(self) -> "Pipeline":
+        raise NotImplementedError
+
+    def then(self, nxt: "Chainable") -> "Pipeline":
+        """``self`` then ``nxt`` (reference ``andThen``)."""
+        this = self.to_pipeline()
+        other = nxt.to_pipeline()
+        combined, _, sink_map = this.graph.connect_graph(other.graph, {other.source: this.sink})
+        return Pipeline(combined, this.source, sink_map[other.sink])
+
+    def then_estimator(self, est: "Estimator", data: Union[Dataset, PipelineDataset, Any]) -> "Pipeline":
+        """Fit ``est`` on this pipeline applied to ``data``; result applies
+        self then the fit transformer (reference: Chainable.scala estimator
+        overloads of andThen)."""
+        return self.then(est.with_data(self.to_pipeline().apply(data)))
+
+    def then_label_estimator(
+        self,
+        est: "LabelEstimator",
+        data: Union[Dataset, PipelineDataset, Any],
+        labels: Union[Dataset, PipelineDataset, Any],
+    ) -> "Pipeline":
+        return self.then(est.with_data(self.to_pipeline().apply(data), labels))
+
+    def __rshift__(self, nxt: "Chainable") -> "Pipeline":
+        return self.then(nxt)
+
+
+# ----------------------------------------------------------------- transformer
+
+
+class Transformer(TransformerOperator, Chainable):
+    """Typed unary transformer (reference: workflow/Transformer.scala:18-70).
+
+    Subclasses implement ``apply`` (one datum) and optionally override
+    ``apply_batch`` with a device-batched implementation.
+    """
+
+    def apply(self, datum: Any) -> Any:
+        raise NotImplementedError
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        return dataset.map(self.apply)
+
+    # Operator protocol -----------------------------------------------------
+    def single_transform(self, datums: List[Any]) -> Any:
+        return self.apply(datums[0])
+
+    def batch_transform(self, datasets: List[Dataset]) -> Dataset:
+        return self.apply_batch(datasets[0])
+
+    # Chaining --------------------------------------------------------------
+    def to_pipeline(self) -> "Pipeline":
+        graph = Graph()
+        graph, source = graph.add_source()
+        graph, node = graph.add_node(self, [source])
+        graph, sink = graph.add_sink(node)
+        return Pipeline(graph, source, sink)
+
+    def __call__(self, data: Any) -> Any:
+        if isinstance(data, (Dataset, PipelineDataset)):
+            return self.to_pipeline().apply(data)
+        return self.apply(data)
+
+    @staticmethod
+    def from_fn(fn: Callable[[Any], Any], batch_fn: Optional[Callable] = None, name: str = "") -> "Transformer":
+        return _FnTransformer(fn, batch_fn, name)
+
+
+class _FnTransformer(Transformer):
+    def __init__(self, fn, batch_fn=None, name=""):
+        self.fn = fn
+        self.batch_fn = batch_fn
+        self.name = name or getattr(fn, "__name__", "fn")
+
+    @property
+    def label(self) -> str:
+        return self.name
+
+    def apply(self, datum):
+        return self.fn(datum)
+
+    def apply_batch(self, dataset):
+        if self.batch_fn is not None and isinstance(dataset, ArrayDataset):
+            return dataset.map_batched(self.batch_fn)
+        return dataset.map(self.fn)
+
+
+class Identity(Transformer):
+    """reference: workflow/Identity.scala:11"""
+
+    def apply(self, datum: Any) -> Any:
+        return datum
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        return dataset
+
+
+class BatchTransformer(Transformer):
+    """Transformer whose native form is whole-batch array computation.
+
+    Subclasses implement ``apply_arrays(pytree) -> pytree`` (jit-friendly);
+    per-datum apply wraps it with a singleton batch dimension.
+
+    Batch application preserves the framework-wide invariant that rows past
+    ``num_examples`` (mesh padding) stay exactly zero, so downstream
+    Gram/gradient accumulations over the data axis are unaffected by
+    padding no matter what elementwise work happens in between.
+    """
+
+    def apply_arrays(self, data: Any) -> Any:
+        raise NotImplementedError
+
+    def apply(self, datum: Any) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        # jnp.asarray keeps device arrays on device (np.asarray would force
+        # a host round-trip per datum) and still handles scalars/lists.
+        batched = jax.tree_util.tree_map(lambda a: jnp.asarray(a)[None], datum)
+        out = self.apply_arrays(batched)
+        return jax.tree_util.tree_map(lambda a: a[0], out)
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        import jax
+        import jax.numpy as jnp
+
+        from ..data.dataset import BucketedDataset
+
+        if isinstance(dataset, BucketedDataset):
+            # Native-resolution path: one static-shape application per
+            # size bucket (each bucket compiles once, like any batch).
+            return dataset.map_datasets(self.apply_batch)
+        if isinstance(dataset, ObjectDataset):
+            dataset = dataset.to_arrays()
+        assert isinstance(dataset, ArrayDataset)
+        if (
+            isinstance(dataset.data, dict)
+            and "desc" in dataset.data
+            and "valid" in dataset.data
+        ):
+            # Masked descriptor convention ({"desc": (N, n_pad, d),
+            # "valid": (N, n_pad)} from ops.images.native): the op acts on
+            # the descriptors, validity flows through untouched. Safe for
+            # the chain between extractor and FisherVector (elementwise
+            # maps and PCA matmuls keep zero rows zero).
+            out = self.apply_arrays(dataset.data["desc"])
+            return ArrayDataset(
+                {"desc": out, "valid": dataset.data["valid"]},
+                dataset.num_examples,
+            )
+        out = dataset.map_batched(self.apply_arrays)
+        if out.physical_rows > out.num_examples:
+            real_row = jnp.arange(out.physical_rows) < out.num_examples
+
+            def zero_pad_rows(a):
+                # where (not multiply): ops like log/div turn zero pad rows
+                # into NaN/Inf, and 0*NaN is NaN — select restores exact 0.
+                m = real_row.reshape((-1,) + (1,) * (a.ndim - 1))
+                return jnp.where(m, a, jnp.zeros((), dtype=a.dtype))
+
+            out = ArrayDataset(
+                jax.tree_util.tree_map(zero_pad_rows, out.data), out.num_examples
+            )
+        return out
+
+
+# ------------------------------------------------------------------ estimators
+
+
+class Estimator(EstimatorOperator):
+    """Unsupervised estimator (reference: workflow/Estimator.scala:10-62)."""
+
+    def fit(self, data: Dataset) -> Transformer:
+        raise NotImplementedError
+
+    def fit_datasets(self, datasets: List[Dataset]) -> TransformerOperator:
+        return self.fit(datasets[0])
+
+    def with_data(self, data: Union[Dataset, PipelineDataset, Any]) -> "Pipeline":
+        """Bind training data now; returns a pipeline applying the (lazily)
+        fit transformer to its input (reference: Estimator.scala:29-46)."""
+        graph = Graph()
+        graph, data_dep = _attach_data(graph, data)
+        graph, est_node = graph.add_node(self, [data_dep])
+        graph, source = graph.add_source()
+        graph, delegating = graph.add_node(DelegatingOperator(), [est_node, source])
+        graph, sink = graph.add_sink(delegating)
+        return Pipeline(graph, source, sink)
+
+
+class LabelEstimator(EstimatorOperator):
+    """Supervised estimator (reference: workflow/LabelEstimator.scala:13-100)."""
+
+    def fit(self, data: Dataset, labels: Dataset) -> Transformer:
+        raise NotImplementedError
+
+    def fit_datasets(self, datasets: List[Dataset]) -> TransformerOperator:
+        return self.fit(datasets[0], datasets[1])
+
+    def with_data(
+        self,
+        data: Union[Dataset, PipelineDataset, Any],
+        labels: Union[Dataset, PipelineDataset, Any],
+    ) -> "Pipeline":
+        graph = Graph()
+        graph, data_dep = _attach_data(graph, data)
+        graph, labels_dep = _attach_data(graph, labels)
+        graph, est_node = graph.add_node(self, [data_dep, labels_dep])
+        graph, source = graph.add_source()
+        graph, delegating = graph.add_node(DelegatingOperator(), [est_node, source])
+        graph, sink = graph.add_sink(delegating)
+        return Pipeline(graph, source, sink)
+
+
+def _attach_data(graph: Graph, data: Any):
+    """Attach a dataset (or lazy pipeline result graph) to ``graph``."""
+    if isinstance(data, PipelineDataset):
+        combined, _, sink_map = graph.add_graph(data.graph)
+        inner_sink = sink_map[data._sink]
+        dep = combined.get_sink_dependency(inner_sink)
+        return combined.remove_sink(inner_sink), dep
+    dataset = as_dataset(data)
+    graph, node = graph.add_node(DatasetOperator(dataset), [])
+    return graph, node
+
+
+# -------------------------------------------------------------------- pipeline
+
+
+class Pipeline(Chainable):
+    """A single-input single-output dataflow with fit-on-demand semantics."""
+
+    def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
+        self.graph = graph
+        self.source = source
+        self.sink = sink
+
+    def to_pipeline(self) -> "Pipeline":
+        return self
+
+    # ------------------------------------------------------------------ apply
+    def apply(self, data: Any) -> PipelineResult:
+        if isinstance(data, PipelineDataset):
+            combined, _, sink_map = data.graph.add_graph(self.graph)
+            new_source = _find_mapped_source(self.graph, self.source, combined, data.graph)
+            inner_dep = combined.get_sink_dependency(data._sink)
+            combined = combined.remove_sink(data._sink)
+            combined = combined.replace_dependency(new_source, inner_dep)
+            combined = combined.remove_source(new_source)
+            sink = sink_map[self.sink]
+            return PipelineDataset(GraphExecutor(combined), sink, combined)
+        if isinstance(data, (Dataset, list, tuple)) or _is_array(data):
+            dataset = as_dataset(data)
+            graph, node = self.graph.add_node(DatasetOperator(dataset), [])
+            graph = graph.replace_dependency(self.source, node)
+            graph = graph.remove_source(self.source)
+            return PipelineDataset(GraphExecutor(graph), self.sink, graph)
+        # single datum
+        graph, node = self.graph.add_node(DatumOperator(data), [])
+        graph = graph.replace_dependency(self.source, node)
+        graph = graph.remove_source(self.source)
+        return PipelineDatum(GraphExecutor(graph), self.sink, graph)
+
+    def __call__(self, data: Any) -> PipelineResult:
+        return self.apply(data)
+
+    # -------------------------------------------------------------------- fit
+    def fit(self) -> "FittedPipeline":
+        """Execute all estimator fits and return a transformer-only pipeline
+        (reference: Pipeline.scala:38-65)."""
+        env = PipelineEnv.get_or_create()
+        graph, prefixes = env.optimizer.execute(self.graph)
+        executor = GraphExecutor(graph, optimize=False)
+        executor._prefixes = prefixes
+
+        for node in sorted(graph.nodes):
+            op = graph.operators.get(node)
+            if not isinstance(op, DelegatingOperator):
+                continue
+            deps = graph.get_dependencies(node)
+            transformer_dep, data_deps = deps[0], deps[1:]
+            fit_transformer = executor.execute(transformer_dep).get()
+            if not isinstance(fit_transformer, TransformerOperator):
+                raise TypeError(
+                    f"delegating node {node} resolved to {type(fit_transformer).__name__}"
+                )
+            graph = graph.set_operator(node, fit_transformer)
+            graph = graph.set_dependencies(node, data_deps)
+            # keep executor and graph views consistent for later delegating nodes
+            executor._optimized = graph
+            executor._memo.pop(node, None)
+
+        graph, _ = UnusedBranchRemovalRule().apply(graph, {})
+        return FittedPipeline(graph, self.source, self.sink)
+
+    # ------------------------------------------------------------------ gather
+    @staticmethod
+    def gather(branches: Sequence[Chainable]) -> "Pipeline":
+        """Merge parallel branches into one pipeline emitting, per input,
+        the list of branch outputs (reference: Pipeline.scala:119-154)."""
+        from ..ops.util.gather import GatherTransformer
+
+        graph = Graph()
+        graph, source = graph.add_source()
+        ends: List[NodeOrSourceId] = []
+        for branch in branches:
+            bp = branch.to_pipeline()
+            combined, source_map, sink_map = graph.add_graph(bp.graph)
+            mapped_source = source_map[bp.source]
+            combined = combined.replace_dependency(mapped_source, source)
+            combined = combined.remove_source(mapped_source)
+            mapped_sink = sink_map[bp.sink]
+            ends.append(combined.get_sink_dependency(mapped_sink))
+            graph = combined.remove_sink(mapped_sink)
+        graph, gather_node = graph.add_node(GatherTransformer(), ends)
+        graph, sink = graph.add_sink(gather_node)
+        return Pipeline(graph, source, sink)
+
+    def to_dot(self) -> str:
+        return self.graph.to_dot()
+
+
+def _is_array(x: Any) -> bool:
+    import numpy as np
+
+    return hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(x, (np.generic,))
+
+
+def _find_mapped_source(
+    orig_graph: Graph, orig_source: SourceId, combined: Graph, base_graph: Graph
+) -> SourceId:
+    """Locate where ``orig_source`` landed after ``base_graph.add_graph(orig)``.
+
+    ``add_graph`` remaps ids deterministically (sorted order past max id), so
+    recompute the mapping the same way.
+    """
+    _, source_map, _ = base_graph.add_graph(orig_graph)
+    return source_map[orig_source]
+
+
+# ------------------------------------------------------------- fitted pipeline
+
+
+class FittedPipeline(Transformer):
+    """Transformer-only pipeline: serializable, no estimators, no re-fitting
+    (reference: workflow/FittedPipeline.scala:22-48)."""
+
+    def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
+        self.graph = graph
+        self.source = source
+        self.sink = sink
+        # Serving-loop fast path: the datum-bound graph is built once and
+        # reused; only the DatumOperator's payload is swapped per call,
+        # under a lock so concurrent serving calls can't read each
+        # other's datum. Safe because per-datum execution runs with
+        # optimize=False — a fresh executor per call, no cross-call memo,
+        # no prefix write-back keyed on the (mutated) operator.
+        self._datum_op: Optional[DatumOperator] = None
+        self._datum_graph: Optional[Graph] = None
+        self._datum_lock = threading.Lock()
+
+    def __getstate__(self):
+        # save() must not pickle the last served datum (or the lock).
+        state = self.__dict__.copy()
+        state["_datum_op"] = None
+        state["_datum_graph"] = None
+        state["_datum_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._datum_lock = threading.Lock()
+
+    def apply(self, datum: Any) -> Any:
+        with self._datum_lock:
+            if self._datum_graph is None:
+                self._datum_op = DatumOperator(datum)
+                graph, node = self.graph.add_node(self._datum_op, [])
+                graph = graph.replace_dependency(self.source, node)
+                self._datum_graph = graph.remove_source(self.source)
+            else:
+                self._datum_op.datum = datum
+            executor = GraphExecutor(self._datum_graph, optimize=False)
+            return executor.execute(self.sink).get()
+
+    def apply_batch(self, dataset: Dataset) -> Dataset:
+        graph, node = self.graph.add_node(DatasetOperator(dataset), [])
+        graph = graph.replace_dependency(self.source, node)
+        graph = graph.remove_source(self.source)
+        executor = GraphExecutor(graph, optimize=False)
+        return executor.execute(self.sink).get()
+
+    # ---------------------------------------------------------- serialization
+    def save(self, path: str) -> None:
+        with open(path, "wb") as f:
+            pickle.dump(self, f)
+
+    @staticmethod
+    def load(path: str) -> "FittedPipeline":
+        with open(path, "rb") as f:
+            out = pickle.load(f)
+        if not isinstance(out, FittedPipeline):
+            raise TypeError(f"{path} does not contain a FittedPipeline")
+        return out

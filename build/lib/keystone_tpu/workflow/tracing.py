@@ -1,0 +1,110 @@
+"""Per-operator execution tracing.
+
+The reference's observability is (1) per-rule DOT logging
+(reference: workflow/RuleExecutor.scala:42-49) — covered by
+``Graph.to_dot``/rule logging here — and (2) the AutoCacheRule profiler
+that eagerly executes scaled samples under ``System.nanoTime``
+(reference: workflow/AutoCacheRule.scala:153-465) — covered by
+``workflow/autocache.py``. This module adds the per-op timeline the
+reference lacked: wrap any pipeline execution in ``trace()`` and every
+operator's forced execution is timed.
+
+Timing forces each operator's lazy result (and on accelerators blocks on a
+scalar fetch) — tracing is a profiling mode, not a zero-cost observer;
+laziness across operators is preserved apart from the forcing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+@dataclass
+class OpTiming:
+    label: str
+    seconds: float
+
+
+@dataclass
+class PipelineTrace:
+    timings: List[OpTiming] = field(default_factory=list)
+
+    def record(self, label: str, seconds: float) -> None:
+        self.timings.append(OpTiming(label, seconds))
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(t.seconds for t in self.timings)
+
+    def report(self) -> str:
+        """Pretty table, slowest first."""
+        rows = sorted(self.timings, key=lambda t: -t.seconds)
+        width = max([len("operator"), len("TOTAL")] + [len(t.label) for t in rows])
+        lines = [f"{'operator':<{width}}  seconds"]
+        for t in rows:
+            lines.append(f"{t.label:<{width}}  {t.seconds:8.4f}")
+        lines.append(f"{'TOTAL':<{width}}  {self.total_seconds:8.4f}")
+        return "\n".join(lines)
+
+
+_local = threading.local()
+
+
+def current_trace() -> Optional[PipelineTrace]:
+    return getattr(_local, "trace", None)
+
+
+@contextmanager
+def trace():
+    """Context manager: trace all pipeline executions in this thread.
+
+    >>> with trace() as t:
+    ...     pipeline(data).get()
+    >>> print(t.report())
+    """
+    prev = current_trace()
+    tr = PipelineTrace()
+    _local.trace = tr
+    try:
+        yield tr
+    finally:
+        _local.trace = prev
+
+
+def _force(value: Any) -> None:
+    """Force lazy/async results so timings measure real work.
+
+    Datasets are unwrapped to their array pytree; device arrays are
+    synced with block_until_ready plus a one-element host fetch (some
+    accelerator relays only guarantee completion on a host readback)."""
+    data = getattr(value, "data", value)  # ArrayDataset → pytree
+    try:
+        import jax
+        import numpy as np
+
+        leaves = [
+            l for l in jax.tree_util.tree_leaves(data) if hasattr(l, "dtype")
+        ]
+        jax.block_until_ready(leaves)
+        for leaf in leaves[:1]:
+            if leaf.size:
+                np.asarray(leaf.ravel()[:1])  # scalar host fetch
+    except Exception:
+        pass
+
+
+def timed_execute(op, deps):
+    """Execute ``op`` under the active trace (or plainly if none)."""
+    tr = current_trace()
+    expression = op.execute(deps)
+    if tr is None:
+        return expression
+    label = getattr(op, "label", type(op).__name__)
+    start = time.perf_counter()
+    _force(expression.get())
+    tr.record(str(label), time.perf_counter() - start)
+    return expression
