@@ -8,6 +8,7 @@
 // convention — (X=rows, Y=cols, C) float arrays in BGR channel order
 // (keystone_tpu/utils/image.py load_image).
 
+#include <algorithm>
 #include <csetjmp>
 #include <cstdio>
 #include <cstring>
@@ -85,28 +86,6 @@ bool decode_rgb(const unsigned char* buf, long long len, std::vector<unsigned ch
   return true;
 }
 
-// Bilinear sample of channel c at (fx, fy) in an RGB byte image. Neighbor
-// indices are clamped independently so 1-pixel-wide/tall sources stay in
-// bounds.
-inline float bilerp(const unsigned char* rgb, int w, int h, float fx, float fy,
-                    int c) {
-  int x0 = (int)fx, y0 = (int)fy;
-  if (x0 > h - 1) x0 = h - 1;
-  if (y0 > w - 1) y0 = w - 1;
-  if (x0 < 0) x0 = 0;
-  if (y0 < 0) y0 = 0;
-  const int x1 = std::min(x0 + 1, h - 1);
-  const int y1 = std::min(y0 + 1, w - 1);
-  const float ax = fx - x0, ay = fy - y0;
-  const float v00 = rgb[((size_t)x0 * w + y0) * 3 + c];
-  const float v01 = rgb[((size_t)x0 * w + y1) * 3 + c];
-  const float v10 = rgb[((size_t)x1 * w + y0) * 3 + c];
-  const float v11 = rgb[((size_t)x1 * w + y1) * 3 + c];
-  const float top = v00 * (1 - ay) + v01 * ay;
-  const float bot = v10 * (1 - ay) + v11 * ay;
-  return top * (1 - ax) + bot * ax;
-}
-
 }  // namespace
 
 extern "C" {
@@ -131,12 +110,40 @@ void ks_decode_jpeg_batch(const unsigned char* const* bufs,
     // scale factors map output pixel centers into source coordinates
     const float sx = out_x > 1 ? (float)(h - 1) / (float)(out_x - 1) : 0.0f;
     const float sy = out_y > 1 ? (float)(w - 1) / (float)(out_y - 1) : 0.0f;
+    // Bilinear resample with column neighbors/weights precomputed once
+    // (identical for every row and channel) and row neighbors hoisted
+    // per row; neighbor indices clamped independently so 1-pixel
+    // wide/tall sources stay in bounds.
+    std::vector<int> y0s(out_y), y1s(out_y);
+    std::vector<float> ays(out_y);
+    for (int y = 0; y < out_y; ++y) {
+      float fy = y * sy;
+      int y0 = (int)fy;
+      if (y0 > w - 1) y0 = w - 1;
+      if (y0 < 0) y0 = 0;
+      y0s[y] = y0;
+      y1s[y] = std::min(y0 + 1, w - 1);
+      ays[y] = fy - y0;
+    }
     for (int x = 0; x < out_x; ++x) {
-      for (int y = 0; y < out_y; ++y) {
-        float* px = dst + ((size_t)x * out_y + y) * 3;
-        px[0] = bilerp(rgb.data(), w, h, x * sx, y * sy, 2);  // B
-        px[1] = bilerp(rgb.data(), w, h, x * sx, y * sy, 1);  // G
-        px[2] = bilerp(rgb.data(), w, h, x * sx, y * sy, 0);  // R
+      float fx = x * sx;
+      int x0 = (int)fx;
+      if (x0 > h - 1) x0 = h - 1;
+      if (x0 < 0) x0 = 0;
+      const int x1 = std::min(x0 + 1, h - 1);
+      const float ax = fx - x0;
+      const unsigned char* r0 = rgb.data() + (size_t)x0 * w * 3;
+      const unsigned char* r1 = rgb.data() + (size_t)x1 * w * 3;
+      float* px = dst + (size_t)x * out_y * 3;
+      for (int y = 0; y < out_y; ++y, px += 3) {
+        const int o0 = y0s[y] * 3, o1 = y1s[y] * 3;
+        const float ay = ays[y];
+        // channel c of source RGB -> output BGR (px[2-c])
+        for (int c = 0; c < 3; ++c) {
+          const float top = r0[o0 + c] * (1 - ay) + r0[o1 + c] * ay;
+          const float bot = r1[o0 + c] * (1 - ay) + r1[o1 + c] * ay;
+          px[2 - c] = top * (1 - ax) + bot * ax;
+        }
       }
     }
     ok[i] = 1;
