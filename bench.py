@@ -855,6 +855,10 @@ def _selected_workloads() -> list[str]:
         raise SystemExit(
             f"unknown workloads in KEYSTONE_BENCH_WORKLOADS: {unknown}"
         )
+    if not names:  # " " or "," — a zero-leg bench run must not look green
+        raise SystemExit(
+            "KEYSTONE_BENCH_WORKLOADS is set but selects no workloads"
+        )
     return names
 
 

@@ -557,3 +557,22 @@ def test_dryrun_perturbation_makes_legs_fail():
     assert "DRYRUN_LEGS" in out, out[-1500:]
     assert out.count("FAIL") >= 5, out[-1500:]  # most legs carry invariants
     assert "rel_err" in out, out[-1500:]
+
+
+def test_bench_workload_filter_validation(monkeypatch):
+    """KEYSTONE_BENCH_WORKLOADS restricts the run; unknown names fail
+    loudly (a typo'd leg name must not silently run everything)."""
+    import bench
+
+    monkeypatch.setenv("KEYSTONE_BENCH_WORKLOADS", "gram_mfu, ingest")
+    assert bench._selected_workloads() == ["gram_mfu", "ingest"]
+    monkeypatch.setenv("KEYSTONE_BENCH_WORKLOADS", "timit_exact,nope")
+    with pytest.raises(SystemExit, match="nope"):
+        bench._selected_workloads()
+    # whitespace/comma-only must not silently select ZERO legs (a
+    # zero-leg bench run exiting 0 would look like a green measurement)
+    monkeypatch.setenv("KEYSTONE_BENCH_WORKLOADS", " , ")
+    with pytest.raises(SystemExit, match="no workloads"):
+        bench._selected_workloads()
+    monkeypatch.delenv("KEYSTONE_BENCH_WORKLOADS")
+    assert bench._selected_workloads() == list(bench.WORKLOADS)
