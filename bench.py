@@ -847,8 +847,8 @@ def _selected_workloads() -> list[str]:
     failure-path integration test to keep a real dead-relay rehearsal
     under a minute of leg time; also handy for one-leg re-measurement)."""
     flt = os.environ.get("KEYSTONE_BENCH_WORKLOADS")
-    if not flt:
-        return list(WORKLOADS)
+    if flt is None:  # unset → full run; SET-but-empty falls through to
+        return list(WORKLOADS)  # the loud zero-selection guard below
     names = [w.strip() for w in flt.split(",") if w.strip()]
     unknown = [w for w in names if w not in WORKLOADS]
     if unknown:

@@ -10,19 +10,9 @@ set -u
 cd "$(dirname "$0")/.."
 
 echo "=== stage 1: solver sweep + constant fit ==="
-# Dense rows on the chip; the sparse solver is host-side scipy, so its
-# rows merge in from a separate CPU run (or re-measure with --grid all).
-# Re-running dense under the shipped refine default also refreshes the
-# cost constants (docs/NEXT_LEVERS.md item 5).
-python scripts/solver_comparison.py \
-    --out scripts/solver-comparisons-tpu-dense.csv --preset full --grid dense \
-    2>&1 | tee /tmp/sweep_tpu.log | tail -5 || echo "sweep failed (see /tmp/sweep_tpu.log)"
-JAX_PLATFORMS=cpu python scripts/solver_comparison.py \
-    --out scripts/solver-comparisons-tpu.csv --preset full --grid sparse \
-    --merge-csv scripts/solver-comparisons-tpu-dense.csv --fit-constants \
-    --constants-out keystone_tpu/ops/learning/tpu_cost_constants.json \
-    --fitted-on "TPU v5 lite (dense rows) + host scipy (sparse rows)" \
-    2>&1 | tee /tmp/sweep_cpu.log | tail -5 || echo "sparse/fit failed (see /tmp/sweep_cpu.log)"
+# The canonical sweep invocation lives in run_solver_sweep.sh (shared
+# with the relay watchdog's recovery path so the recipes cannot drift).
+bash scripts/run_solver_sweep.sh
 
 echo "=== stage 2: full bench ==="
 python bench.py 2>&1 | tee /tmp/bench_full.log | tail -2 || echo "bench failed (see /tmp/bench_full.log)"

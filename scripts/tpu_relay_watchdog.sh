@@ -34,6 +34,17 @@ while true; do
       if [ "$rc" = 0 ]; then
         mv "$OUT.tmp" "$OUT"
         echo "[$(stamp)] bench captured -> $OUT" >> "$LOG"
+        # Round-5 staged set (docs/NEXT_LEVERS.md item 1): with the chip
+        # healthy and the bench done, run the CANONICAL solver sweep
+        # (scripts/run_solver_sweep.sh — shared with
+        # run_tpu_measurements.sh so the recipes cannot drift; writes the
+        # merged CSV + refit constants with honest provenance).
+        # Sequentially, never concurrently (two TPU processes wedge the
+        # relay); sweep failure must not discard the bench capture.
+        echo "[$(stamp)] running canonical solver sweep" >> "$LOG"
+        timeout 7200 bash scripts/run_solver_sweep.sh >> "$LOG" 2>&1 \
+          && echo "[$(stamp)] solver sweep captured" >> "$LOG" \
+          || echo "[$(stamp)] solver sweep FAILED (bench capture kept)" >> "$LOG"
         exit 0
       fi
       # Bench failed (relay may have died mid-run) — keep polling; a

@@ -569,10 +569,12 @@ def test_bench_workload_filter_validation(monkeypatch):
     monkeypatch.setenv("KEYSTONE_BENCH_WORKLOADS", "timit_exact,nope")
     with pytest.raises(SystemExit, match="nope"):
         bench._selected_workloads()
-    # whitespace/comma-only must not silently select ZERO legs (a
-    # zero-leg bench run exiting 0 would look like a green measurement)
-    monkeypatch.setenv("KEYSTONE_BENCH_WORKLOADS", " , ")
-    with pytest.raises(SystemExit, match="no workloads"):
-        bench._selected_workloads()
+    # set-but-empty ("", " ", ",") must not silently select ZERO legs (a
+    # zero-leg bench run exiting 0 would look like a green measurement) —
+    # and an accidentally-empty wrapper var must not run the FULL bench
+    for empty in ("", " , ", " "):
+        monkeypatch.setenv("KEYSTONE_BENCH_WORKLOADS", empty)
+        with pytest.raises(SystemExit, match="no workloads"):
+            bench._selected_workloads()
     monkeypatch.delenv("KEYSTONE_BENCH_WORKLOADS")
     assert bench._selected_workloads() == list(bench.WORKLOADS)
