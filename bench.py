@@ -143,19 +143,26 @@ def _bench_timit_exact(small: bool) -> dict:
     reg = 1e-2
 
     n = full_n - full_n % ndev
+    x = y = model = None
     while True:
         try:
-            key = jax.random.PRNGKey(0)
-            ka, kb, kw = jax.random.split(key, 3)
-            scales = jnp.logspace(0.0, -2.0, d, dtype=jnp.float32)
-            x = jax.random.normal(ka, (n, d), dtype=jnp.float32) * scales
-            w_true = jax.random.normal(kw, (d, k), dtype=jnp.float32)
-            y = jax.jit(
-                lambda x, w: jnp.matmul(
-                    x, w, precision=jax.lax.Precision.HIGHEST
-                )
-            )(x, w_true)
-            y = y + 0.1 * jax.random.normal(kb, (n, k), dtype=jnp.float32)
+            # ONE fused generation dispatch. The eager form
+            # (normal(...) * scales) materializes the raw normal AND the
+            # scaled product — two (n, d) buffers, 18 GB at the full
+            # TIMIT shape — which OOMs a 16 GB v5e before the solver
+            # ever runs (JAX's default preallocation leaves ~12 GB
+            # usable). Under jit, XLA fuses RNG→scale into a single
+            # write of x and signal+noise into a single write of y.
+            def _gen(key):
+                ka, kb, kw = jax.random.split(key, 3)
+                scales = jnp.logspace(0.0, -2.0, d, dtype=jnp.float32)
+                x = jax.random.normal(ka, (n, d), dtype=jnp.float32) * scales
+                w_true = jax.random.normal(kw, (d, k), dtype=jnp.float32)
+                y = jnp.matmul(x, w_true, precision=jax.lax.Precision.HIGHEST)
+                y = y + 0.1 * jax.random.normal(kb, (n, k), dtype=jnp.float32)
+                return x, y
+
+            x, y = jax.jit(_gen)(jax.random.PRNGKey(0))
             jax.block_until_ready((x, y))
 
             est = LinearMapEstimator(reg=reg)
@@ -182,7 +189,12 @@ def _bench_timit_exact(small: bool) -> dict:
             mse = float(jnp.mean((pred - y[:head]) ** 2))
             break
         except Exception as e:  # OOM or shape-dependent failure: halve n
-            if n <= full_n // 4 or "RESOURCE_EXHAUSTED" not in str(e).upper():
+            # Free THIS attempt's buffers before allocating the next —
+            # holding the failed n's x/y (directly or via the dataset
+            # wrappers) across the retry is itself an OOM source (the
+            # r5 on-chip failure mode).
+            x = y = model = features = labels = None
+            if n <= full_n // 16 or "RESOURCE_EXHAUSTED" not in str(e).upper():
                 raise
             n = (n // 2) - ((n // 2) % ndev)
 
