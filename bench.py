@@ -55,8 +55,14 @@ then spent probing for the accelerator and upgrading to full-size
 on-chip legs, each persisted as it completes. ``timeout 1200 python
 bench.py`` with the relay dead prints one JSON line and leaves a fresh
 ``BENCH_PARTIAL.json`` (enforced by tests/test_failure_paths.py). When
-the accelerator is healthy the deadline does not cut workloads short:
-time spent measuring (as opposed to waiting) is always allowed.
+the accelerator is healthy, waiting is not the risk — measuring is: a
+cold full-leg run is hours against the driver's ~20-min envelope. So
+measuring time is bounded too (``KEYSTONE_BENCH_MEASURE_BUDGET``,
+default 780 s): legs run in priority order (headline first), legs past
+the budget are marked ``skipped`` and adopted — with file provenance —
+from the newest watchdog capture in ``docs/measurements/``
+(``*onchip_bench.json``; the watchdog runs with the budget effectively
+unbounded so those captures measure every leg live on silicon).
 """
 
 from __future__ import annotations
@@ -337,7 +343,11 @@ def _bench_gram_mfu(small: bool) -> dict:
     )
 
     m = n - 32  # static slice height; dynamic offset defeats hoisting
-    lo, hi = 1, 9
+    # Wide K spread: the slope divides dispatch jitter by (hi−lo), and
+    # the axon relay's ~100 ms round-trip jitters by several ms — an
+    # 8-gram spread let that noise read as MFU 1.28 (> peak, r5 run).
+    # 24 grams ≈ 250 ms of kernel time per hi-probe, still cheap.
+    lo, hi = 2, 26
     for dtype, label, prec in (
         (jnp.bfloat16, "bf16", None),
         (jnp.float32, "fp32", None),
@@ -839,15 +849,19 @@ def _bench_ingest(small: bool) -> dict:
 
 
 def _workload_registry() -> dict:
+    # ORDER IS THE MEASURING PRIORITY: cheap, headline-bearing legs
+    # first, so a budget-capped run (KEYSTONE_BENCH_MEASURE_BUDGET — the
+    # driver's envelope is ~20 min; a cold full-leg run is hours) banks
+    # the headline and kernel evidence before the long flagship legs.
     return {
         "timit_exact": _bench_timit_exact,
-        "timit_wide_block": _bench_timit_wide_block,
         "gram_mfu": _bench_gram_mfu,
-        "cifar_random_patch": _bench_cifar_random_patch,
+        "timit_wide_block": _bench_timit_wide_block,
+        "ingest": _bench_ingest,
         "imagenet_fv": _bench_imagenet_fv,
         "imagenet_native": _bench_imagenet_native,
+        "cifar_random_patch": _bench_cifar_random_patch,
         "imagenet_flagship": _bench_flagship_50k,
-        "ingest": _bench_ingest,
     }
 
 
@@ -1004,34 +1018,82 @@ def _load_child_partial(path: str = "BENCH_PARTIAL.json") -> dict | None:
     return None
 
 
+def _onchip_capture_candidates() -> list[str]:
+    """Capture files the relay watchdog (scripts/tpu_relay_watchdog.sh)
+    may have written this round, newest mtime first. KEYSTONE_ONCHIP_CAPTURE
+    overrides (tests; explicit captures)."""
+    override = os.environ.get("KEYSTONE_ONCHIP_CAPTURE")
+    if override:
+        return [override]
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    paths = glob.glob(os.path.join(here, "docs", "measurements", "*onchip_bench.json"))
+    return sorted(paths, key=lambda p: os.path.getmtime(p), reverse=True)
+
+
 def _load_best_onchip_run() -> dict | None:
-    """The relay watchdog (scripts/tpu_relay_watchdog.sh) captures a full
-    on-chip bench whenever the relay is healthy mid-round. If this run
-    had to fall back to CPU, that capture is the round's best silicon
-    evidence — attach it (with file provenance) rather than losing it."""
-    path = os.environ.get(
-        "KEYSTONE_ONCHIP_CAPTURE", "docs/measurements/r4_onchip_bench.json"
-    )
-    try:
-        with open(path) as f:
-            text = f.read()
-        for line in text.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                payload = json.loads(line)
-                if payload.get("platform") == "cpu":
-                    return None  # a CPU capture adds nothing
-                return {
-                    "source": path,
-                    "captured_mtime": time.strftime(
-                        "%Y-%m-%d %H:%M:%S UTC",
-                        time.gmtime(os.path.getmtime(path)),
-                    ),
-                    "result": payload,
-                }
-    except (OSError, json.JSONDecodeError):
-        pass
+    """The relay watchdog captures a full on-chip bench whenever the
+    relay is healthy mid-round. If this run had to fall back to CPU,
+    that capture is the round's best silicon evidence — attach it (with
+    file provenance) rather than losing it."""
+    for path in _onchip_capture_candidates():
+        try:
+            with open(path) as f:
+                text = f.read()
+            for line in text.splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    payload = json.loads(line)
+                    if payload.get("platform") == "cpu":
+                        break  # a CPU capture adds nothing; try older files
+                    return {
+                        "source": path,
+                        "captured_mtime": time.strftime(
+                            "%Y-%m-%d %H:%M:%S UTC",
+                            time.gmtime(os.path.getmtime(path)),
+                        ),
+                        "result": payload,
+                    }
+        except (OSError, json.JSONDecodeError):
+            continue
     return None
+
+
+def _adopt_captured_legs(merged: dict, names: list[str]) -> list[str]:
+    """For legs THIS run skipped (measuring budget) or failed, adopt the
+    leg result from the newest on-chip capture, stamping file provenance
+    inside the leg. The driver's envelope (~20 min) cannot fit the long
+    flagship legs cold, so the watchdog measures them unattended when
+    the relay is healthy and this run carries the evidence forward —
+    marked, never silently. Returns the adopted leg names."""
+    best = _load_best_onchip_run()
+    if best is None:
+        return []
+    captured = best["result"]
+    adopted = []
+    for name in names:
+        leg = captured.get(name)
+        if not isinstance(leg, dict) or "error" in leg or "skipped" in leg:
+            continue
+        replaced = merged.get(name)
+        stamp = {
+            "source": best["source"],
+            "captured_mtime": best["captured_mtime"],
+            "this_run": (replaced or {}).get("error")
+            or (replaced or {}).get("skipped") or "not run",
+        }
+        # A capture can itself contain adopted legs (watchdog runs use
+        # this same main()). Keep the WHOLE chain — restamping would
+        # claim old data was measured live in the newer capture.
+        if "adopted_from_capture" in leg:
+            stamp["chain"] = leg["adopted_from_capture"]
+        merged[name] = {
+            **{k: v for k, v in leg.items() if k != "adopted_from_capture"},
+            "adopted_from_capture": stamp,
+        }
+        adopted.append(name)
+    return adopted
 
 
 def main() -> int:
@@ -1048,6 +1110,19 @@ def main() -> int:
     reserve_s = 30.0  # finalization reserve: print + dump always fit
     probe_timeout_s = float(os.environ.get("KEYSTONE_BENCH_PROBE_TIMEOUT", 120))
     probe_interval_s = float(os.environ.get("KEYSTONE_BENCH_PROBE_INTERVAL", 120))
+    # MEASURING budget for the healthy-chip path (r5: waiting was bounded
+    # but measuring wasn't, and a cold full-leg run is hours against the
+    # driver's ~20-min envelope — the r4 rc=124 failure mode on the
+    # healthy path). Legs run in registry order (headline first); once
+    # spent, remaining legs are marked "skipped" and adopted — with file
+    # provenance — from the newest watchdog capture. Watchdog/manual
+    # capture runs raise this to measure everything live.
+    measure_budget_s = float(os.environ.get("KEYSTONE_BENCH_MEASURE_BUDGET", 780))
+    measured = [0.0]  # seconds spent inside accelerator leg children
+    # Below the floor a child can't even warm a compile cache — skip
+    # outright instead of launching a doomed child. Scaled so tiny
+    # test budgets still exercise the run-then-skip transition.
+    skip_floor = min(60.0, 0.05 * measure_budget_s)
 
     waited = [0.0]  # seconds spent waiting (probes + sleeps + insurance)
 
@@ -1128,7 +1203,10 @@ def main() -> int:
     # crash can't poison the chip's HBM for the rest (round-2 lesson: the
     # cifar OOM left imagenet_fv dying at 0.3s in the shared process).
     per_workload_timeout = {
-        "cifar_random_patch": 1200.0,
+        # 50k imgs × 10k filters end to end ≈ 6e16 MXU FLOPs + ~500
+        # relay dispatches — the r5 on-chip run proved 1200 s is short
+        # of the real cost on a v5e behind the ~100 ms relay.
+        "cifar_random_patch": 2400.0,
         # 1000-class weighted solve = a scan of 1000 (4096, 4096)
         # Cholesky factorizations at solver precision + the featurize
         # stages; give it room before the ladder gets blamed.
@@ -1189,10 +1267,26 @@ def main() -> int:
             continue
         run_rounds += 1
         for name in todo:
+            m_left = measure_budget_s - measured[0]
+            if m_left <= skip_floor:
+                # A retry-round skip must not reclassify a round-1 crash
+                # as a budget skip (the error text IS the audit trail).
+                if not (isinstance(merged.get(name), dict)
+                        and "error" in merged[name]):
+                    merged[name] = {
+                        "skipped": f"measuring budget ({measure_budget_s:.0f}s) "
+                                   "exhausted before this leg"
+                    }
+                    _dump_partial({"partial": True, "phase": "accelerator",
+                                   "diagnostics": diagnostics, **merged})
+                continue
+            t0m = time.monotonic()
             wreport, err = _run_child(
                 dict(os.environ), small=False,
-                timeout_s=per_workload_timeout.get(name, 900.0), workload=name,
+                timeout_s=min(per_workload_timeout.get(name, 900.0), m_left),
+                workload=name,
             )
+            measured[0] += time.monotonic() - t0m
             if wreport is None:
                 merged[name] = {"error": err[:500]}
             else:
@@ -1211,25 +1305,61 @@ def main() -> int:
     # refine = fast Gram + 2 residual corrections at HIGHEST). The extra
     # legs quantify the alternatives' speed/accuracy: "highest" is the
     # reference-parity 6-pass Cholesky, "default" the raw 1-pass Gram.
-    if isinstance(merged.get("timit_exact"), dict) and "error" not in merged["timit_exact"]:
+    if (isinstance(merged.get("timit_exact"), dict)
+            and "error" not in merged["timit_exact"]
+            and "skipped" not in merged["timit_exact"]):
         for mode, label, key in (
             ("highest", "highest (6-pass fp32-emulation Gram)", "timit_exact_highest"),
             ("default", "default (1-pass bf16 Gram, no IR)", "timit_exact_fastmode"),
         ):
+            m_left = measure_budget_s - measured[0]
+            if m_left <= skip_floor:
+                merged[key] = {"skipped": "measuring budget exhausted"}
+                continue
             env = dict(os.environ)
             env["KEYSTONE_SOLVER_PRECISION"] = mode
+            t0m = time.monotonic()
             wreport, err = _run_child(
-                env, small=False, timeout_s=900.0, workload="timit_exact"
+                env, small=False, timeout_s=min(900.0, m_left),
+                workload="timit_exact",
             )
+            measured[0] += time.monotonic() - t0m
             leg = (wreport or {}).get("timit_exact", {"error": err[:300]})
             leg["solver_precision"] = label
             merged[key] = leg
             _dump_partial({"partial": True, "phase": "accelerator",
                            "diagnostics": diagnostics, **merged})
 
+    # Gate on LIVE results first: at least one selected workload must
+    # have been measured in THIS process for the run to count as an
+    # accelerator run (adopted capture data must not mask a run whose
+    # every live leg failed — that path falls back to CPU insurance,
+    # which attaches the capture separately as best_onchip_run).
     report = None
-    if any(isinstance(merged.get(n), dict) and "error" not in merged[n] for n in WORKLOADS):
+    if any(
+        isinstance(merged.get(n), dict)
+        and "error" not in merged[n] and "skipped" not in merged[n]
+        for n in WORKLOADS
+    ):
         report = merged
+
+    # Adopt skipped/failed SELECTED legs from the newest watchdog capture
+    # (marked in-leg with source + mtime; surfaced top-level below).
+    # Only for a live accelerator run, and only for legs this run was
+    # actually asked to produce — a KEYSTONE_BENCH_WORKLOADS-filtered
+    # smoke run must not emit a full-looking artifact.
+    adopted: list[str] = []
+    if report is merged and merged:
+        selected = _selected_workloads()
+        pending_names = list(selected)
+        if "timit_exact" in selected:
+            pending_names += ["timit_exact_highest", "timit_exact_fastmode"]
+        pending = [
+            n for n in pending_names
+            if not isinstance(merged.get(n), dict)
+            or "error" in merged[n] or "skipped" in merged[n]
+        ]
+        adopted = _adopt_captured_legs(merged, pending)
     if report is None:
         run_cpu_insurance()  # no accelerator success and no insurance yet
         report = cpu_report
@@ -1256,6 +1386,10 @@ def main() -> int:
         k for k, v in report.items()
         if isinstance(v, dict) and "error" in v
     )
+    skipped = sorted(
+        k for k, v in report.items()
+        if isinstance(v, dict) and "skipped" in v
+    )
     reduced = sorted(
         k for k, v in report.items()
         if isinstance(v, dict) and v.get("extrapolated")
@@ -1266,6 +1400,11 @@ def main() -> int:
         "unit": "ms",
         "vs_baseline": round(TIMIT_BASELINE_MS / ms, 3) if ms else None,
         "workloads_with_errors": failed,
+        "workloads_skipped_budget": skipped,
+        "workloads_from_capture": sorted(adopted),
+        # The headline itself must not read as a live measurement when
+        # timit_exact was adopted — flag it at the top level too.
+        **({"headline_from_capture": True} if "timit_exact" in adopted else {}),
         "workloads_extrapolated": reduced,
         **{k: v for k, v in report.items() if k != "timit_exact"},
         "timit_exact": timit,

@@ -29,8 +29,35 @@ while true; do
     echo "[$(stamp)] relay port open; confirming with jax probe" >> "$LOG"
     if timeout 300 python -c "import jax; d=jax.devices(); assert d[0].platform!='cpu'; print(d[0].device_kind)" >> "$LOG" 2>&1; then
       echo "[$(stamp)] TPU healthy — running full bench" >> "$LOG"
-      timeout 7200 python bench.py > "$OUT.tmp" 2>> "$LOG"
+      # Large-but-BOUNDED measuring budget: this is the capture run the
+      # driver's budget-capped runs adopt their long legs from, so it
+      # must measure the long legs live — but it must also FINISH inside
+      # its own timeout (an external kill discards the one-line artifact;
+      # the per-workload timeouts alone can sum past any envelope).
+      # 13000 s measuring < 14400 s timeout leaves room for probes,
+      # retries and finalization.
+      # A stale partial from a previous run must not be promotable as
+      # this run's capture (freshness laundering) — clear it first.
+      rm -f BENCH_PARTIAL.json
+      KEYSTONE_BENCH_MEASURE_BUDGET=13000 \
+        timeout 14400 python bench.py > "$OUT.tmp" 2>> "$LOG"
       rc=$?
+      if [ "$rc" != 0 ] && [ -s BENCH_PARTIAL.json ]; then
+        # The run died before printing its line — promote the per-leg
+        # partial into an adoptable one-line capture (distinct name,
+        # still matching the *onchip_bench.json adoption glob; a later
+        # FULL capture is newer and wins) and KEEP POLLING for a
+        # healthy window that can measure everything.
+        python - "${OUT%.json}.partial_onchip_bench.json" <<'PYEOF' 2>> "$LOG" \
+          && echo "[$(stamp)] partial promoted to adoptable capture" >> "$LOG"
+import json, sys
+d = json.load(open("BENCH_PARTIAL.json"))
+if d.get("platform") == "cpu":
+    sys.exit(1)  # a CPU partial adds nothing as a capture
+d["promoted_from_partial"] = True
+open(sys.argv[1], "w").write(json.dumps(d) + "\n")
+PYEOF
+      fi
       if [ "$rc" = 0 ]; then
         mv "$OUT.tmp" "$OUT"
         echo "[$(stamp)] bench captured -> $OUT" >> "$LOG"

@@ -13,6 +13,15 @@ from keystone_tpu.parallel import linalg
 from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh, use_mesh
 
 
+@pytest.fixture(autouse=True)
+def _no_ambient_onchip_capture(monkeypatch):
+    """Leg adoption (r5) reads real watchdog captures from
+    docs/measurements/*onchip_bench.json; tests must not see whatever
+    this machine's watchdog happened to capture. Subprocess tests
+    inherit the pin through os.environ."""
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", "/nonexistent/onchip.json")
+
+
 # ------------------------------------------------------------ bench helpers
 
 
@@ -578,3 +587,141 @@ def test_bench_workload_filter_validation(monkeypatch):
             bench._selected_workloads()
     monkeypatch.delenv("KEYSTONE_BENCH_WORKLOADS")
     assert bench._selected_workloads() == list(bench.WORKLOADS)
+
+
+def test_bench_measure_budget_skips_and_adopts(monkeypatch, capsys, tmp_path):
+    """r5: the healthy path is budget-bounded too (the driver's envelope
+    is ~20 min; a cold full-leg run is hours). Legs past
+    KEYSTONE_BENCH_MEASURE_BUDGET are marked skipped, and skipped/failed
+    legs are adopted from the newest watchdog capture with in-leg file
+    provenance and a top-level workloads_from_capture listing."""
+    import json
+    import time as _t
+
+    import bench
+
+    monkeypatch.chdir(tmp_path)
+    capture = {
+        "platform": "tpu", "device_kind": "TPU v5 lite",
+        "imagenet_flagship": {"wall_s": 1234.0, "top5_err": 0.5},
+        "cifar_random_patch": {"end_to_end_fit_s": 99.0},
+        "imagenet_fv": {"error": "died on capture day"},  # must NOT adopt
+    }
+    cap = tmp_path / "cap_onchip_bench.json"
+    cap.write_text(json.dumps(capture) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(cap))
+    monkeypatch.setenv("KEYSTONE_BENCH_MEASURE_BUDGET", "0.4")
+
+    inner = _fake_child_factory("tpu")
+
+    def slow_child(env, small, timeout_s, workload=None):
+        # Spin (not sleep: time.sleep is no-op'd below) so each leg
+        # consumes real measuring budget.
+        t0 = _t.monotonic()
+        while _t.monotonic() - t0 < 0.15:
+            pass
+        return inner(env, small, timeout_s, workload)
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
+    monkeypatch.setattr(bench, "_run_child", slow_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    # Early (priority) legs measured live; late legs skipped by budget.
+    assert "error" not in out["timit_exact"] and "skipped" not in out["timit_exact"]
+    assert out["workloads_skipped_budget"], out
+    # Skipped flagship legs adopted from the capture, with provenance;
+    # the capture's own errored leg must NOT be adopted.
+    assert "imagenet_flagship" in out["workloads_from_capture"]
+    assert out["imagenet_flagship"]["top5_err"] == 0.5
+    assert out["imagenet_flagship"]["adopted_from_capture"]["source"] == str(cap)
+    assert "imagenet_fv" not in out["workloads_from_capture"]
+    # The headline itself came from a live measurement, not the capture.
+    assert out["value"] == 1.0
+
+
+def test_adopt_captured_legs_rejects_cpu_and_errored(tmp_path, monkeypatch):
+    """Adoption helper filters: a CPU capture adds nothing (never
+    adopted); error/skipped legs inside a capture stay dead; the
+    this_run reason is recorded for the audit trail."""
+    import json
+
+    import bench
+
+    cpu_cap = tmp_path / "cpu_onchip_bench.json"
+    cpu_cap.write_text(json.dumps({"platform": "cpu", "ingest": {"ips": 1}}) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(cpu_cap))
+    merged = {"ingest": {"error": "boom"}}
+    assert bench._adopt_captured_legs(merged, ["ingest"]) == []
+    assert merged["ingest"] == {"error": "boom"}
+
+    tpu_cap = tmp_path / "tpu_onchip_bench.json"
+    tpu_cap.write_text(json.dumps({
+        "platform": "tpu",
+        "ingest": {"ips": 800.0},
+        "gram_mfu": {"skipped": "budget"},
+    }) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(tpu_cap))
+    merged = {"ingest": {"error": "boom"}, "gram_mfu": {"skipped": "budget"}}
+    adopted = bench._adopt_captured_legs(merged, ["ingest", "gram_mfu"])
+    assert adopted == ["ingest"]
+    assert merged["ingest"]["ips"] == 800.0
+    assert merged["ingest"]["adopted_from_capture"]["this_run"] == "boom"
+    assert "skipped" in merged["gram_mfu"]  # capture's skipped leg: no adoption
+
+
+def test_bench_all_live_failures_not_masked_by_capture(monkeypatch, capsys, tmp_path):
+    """A run whose every live leg failed must fall back to insurance —
+    adopted capture data must not fabricate a clean accelerator run
+    (workloads_from_capture stays empty; errors are not laundered)."""
+    import json
+
+    import bench
+
+    monkeypatch.chdir(tmp_path)
+    cap = tmp_path / "cap_onchip_bench.json"
+    cap.write_text(json.dumps({
+        "platform": "tpu",
+        **{w: {"fit_ms": 7.0} for w in bench.WORKLOADS},
+    }) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(cap))
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        _fake_child_factory("tpu", fail_workloads=tuple(bench.WORKLOADS)))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    # Insurance result stands; nothing was adopted into the artifact.
+    assert out.get("workloads_from_capture", []) == []
+    assert out["small_shapes"] is True  # the insurance child's legs
+
+
+def test_adopt_captured_legs_preserves_chain(tmp_path, monkeypatch):
+    """A capture can itself contain adopted legs (watchdog runs share
+    main()); re-adoption must keep the whole provenance chain instead of
+    restamping old data as freshly captured."""
+    import json
+
+    import bench
+
+    cap = tmp_path / "chain_onchip_bench.json"
+    cap.write_text(json.dumps({
+        "platform": "tpu",
+        "ingest": {
+            "ips": 700.0,
+            "adopted_from_capture": {"source": "older.json",
+                                     "captured_mtime": "2026-07-30",
+                                     "this_run": "child timed out"},
+        },
+    }) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(cap))
+    merged = {"ingest": {"skipped": "budget"}}
+    assert bench._adopt_captured_legs(merged, ["ingest"]) == ["ingest"]
+    stamp = merged["ingest"]["adopted_from_capture"]
+    assert stamp["source"] == str(cap)
+    assert stamp["chain"]["source"] == "older.json"
