@@ -24,6 +24,11 @@ def test_two_process_rehearsal():
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")}
+    # The rehearsal must work from a bare checkout too (a fresh machine
+    # loses the editable install; sys.path[0] is scripts/, not the repo).
+    env["PYTHONPATH"] = REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(REPO, "scripts/multihost_rehearsal.py"),

@@ -725,3 +725,31 @@ def test_adopt_captured_legs_preserves_chain(tmp_path, monkeypatch):
     stamp = merged["ingest"]["adopted_from_capture"]
     assert stamp["source"] == str(cap)
     assert stamp["chain"]["source"] == "older.json"
+
+
+def test_adopt_captured_legs_falls_through_candidates(tmp_path, monkeypatch):
+    """Manual capture runs measure different leg subsets per file;
+    adoption takes each pending leg from the first (preferred) capture
+    that has a good entry, not only from the single newest file."""
+    import json
+    import os
+
+    import bench
+
+    a = tmp_path / "newer_onchip_bench.json"
+    a.write_text(json.dumps({"platform": "tpu",
+                             "imagenet_fv": {"solve_ms": 5.0}}) + "\n")
+    b = tmp_path / "older_onchip_bench.json"
+    b.write_text(json.dumps({"platform": "tpu",
+                             "imagenet_fv": {"solve_ms": 9.0},
+                             "imagenet_flagship": {"wall_s": 77.0}}) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", f"{a}{os.pathsep}{b}")
+    merged = {"imagenet_fv": {"error": "x"},
+              "imagenet_flagship": {"skipped": "budget"}}
+    adopted = bench._adopt_captured_legs(
+        merged, ["imagenet_fv", "imagenet_flagship"])
+    assert sorted(adopted) == ["imagenet_flagship", "imagenet_fv"]
+    assert merged["imagenet_fv"]["solve_ms"] == 5.0  # preferred file wins
+    assert merged["imagenet_fv"]["adopted_from_capture"]["source"] == str(a)
+    assert merged["imagenet_flagship"]["wall_s"] == 77.0
+    assert merged["imagenet_flagship"]["adopted_from_capture"]["source"] == str(b)
