@@ -809,7 +809,13 @@ def _bench_ingest(small: bool) -> dict:
 
     from keystone_tpu.data.ingest import build_jpeg_tar_fixture, measure_ingest
 
-    n = 512 if small else 10_000
+    # Fixture size scales with the host: the PIL build is serial and a
+    # 1-core host (r5: the rebooted attachment host) spends most of the
+    # leg's timeout building 10k JPEGs before measuring anything. The
+    # per-core decode rate is the figure of merit and n only needs to be
+    # large enough to time it stably.
+    ncpu0 = os.cpu_count() or 1
+    n = 512 if small else min(10_000, 2_500 * ncpu0)
     fixture = os.path.join(
         os.path.expanduser("~/.cache/keystone_tpu"),
         f"ingest_fixture_{n}.tar",
