@@ -75,6 +75,21 @@ import time
 
 TIMIT_BASELINE_MS = 7_323.0  # reference: scripts/solver-comparisons-final.csv:14
 
+_T0 = time.time()  # process start; in a --child this is child start
+
+
+def _child_deadline_left() -> float | None:
+    """Seconds left before this child's cooperative deadline, or None
+    when no deadline is set. Stage-structured legs check this BETWEEN
+    stages and return what they measured with a ``truncated`` marker
+    instead of overrunning into a SIGKILL — killed TPU claims first
+    poison the chip's allocator for later claims, then wedge the relay
+    (observed r5; see docs/PERFORMANCE.md round-5 post-mortem)."""
+    deadline = float(os.environ.get("KEYSTONE_BENCH_CHILD_DEADLINE", 0))
+    if not deadline:
+        return None
+    return deadline - (time.time() - _T0)
+
 # Known peak dense-matmul throughput per chip (TFLOP/s), for the MFU
 # figure. Keys are substrings of jax Device.device_kind. bf16 peaks from
 # public TPU specs; fp32 on TPU runs through the MXU at ~1/2 bf16 rate
@@ -460,6 +475,16 @@ def _bench_cifar_random_patch(small: bool) -> dict:
     )
     ips_device = chunk / per_chunk_s
 
+    left = _child_deadline_left()
+    if left is not None and left <= 120.0:
+        # The end-to-end fit is one long uninterruptible call — don't
+        # start it into a SIGKILL; keep the measured featurize rate.
+        return {
+            "featurize_images_per_sec_device": round(ips_device, 1),
+            "num_filters": num_filters,
+            "truncated": "child deadline before end-to-end fit",
+        }
+
     # End-to-end at the reference config via block REMATERIALIZATION:
     # images upload once; each solver block's features are recomputed on
     # device inside the BCD step (conv is MXU-cheap, HBM is the scarce
@@ -571,11 +596,24 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
         stages[name] = round((time.perf_counter() - t0) * 1000.0, 1)
         return out
 
+    def truncate_before(next_stage: str) -> bool:
+        # Graceful stage-boundary exit a margin before the SIGKILL —
+        # what was measured stays measured (see _child_deadline_left).
+        left = _child_deadline_left()
+        if left is not None and left <= 30.0:
+            stages["truncated"] = f"child deadline before {next_stage}"
+            stages["num_images"] = n_img
+            stages["image_size"] = size
+            return True
+        return False
+
     gray = GrayScaler().apply_arrays(PixelScaler().apply_arrays(images))
     sift = SIFTExtractor(scale_step=1)
     hell = SignedHellingerMapper()
     sift_desc = timed("sift_ms", jax.jit(lambda g: hell.apply_arrays(sift.apply_arrays(g))), gray)
 
+    if truncate_before("lcs"):
+        return stages
     lcs = LCSExtractor(stride=4, stride_start=16, sub_patch_size=6)
     lcs_desc = timed("lcs_ms", jax.jit(lcs.apply_arrays), images)
 
@@ -584,6 +622,8 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
     pca_components = timed("pca_fit_ms", jax.jit(lambda f: compute_pca(f, desc_dim)), flat)
     reduced = (flat @ pca_components).reshape(n_img, -1, desc_dim)
 
+    if truncate_before("gmm"):
+        return stages
     # Estimator fits are cold-timed (includes XLA compile — honest for a
     # first-ever run); the _warm_ms re-run is the steady-state cost a
     # user with a warm persistent compilation cache pays.
@@ -613,6 +653,8 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
     # row structure is what matters), then tiled + noise-augmented to the
     # target n with labels keyed to the source image so train error is a
     # meaningful conditioning probe.
+    if truncate_before("solve"):
+        return stages
     lcs_flat = lcs_desc.reshape(-1, lcs_desc.shape[-1])
     lcs_pca = jax.jit(lambda f: compute_pca(f, desc_dim))(lcs_flat)
     lcs_reduced = (lcs_flat @ lcs_pca).reshape(n_img, -1, desc_dim)
@@ -643,7 +685,7 @@ def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dic
     model = est.fit(ArrayDataset(xs), ArrayDataset(jnp.asarray(ys)))
     force(model.weights)
     stages["solve_warm_ms"] = round((time.perf_counter() - t0) * 1000.0, 1)
-    if not small:
+    if not small and not truncate_before("solve_dense_ab"):
         # Woodbury-vs-dense A/B (r4: the auto path shares one population
         # Cholesky per block instead of one per class — quantify it in
         # the artifact the claim rides on; dense is the r3 path. Skipped
@@ -779,6 +821,12 @@ def _bench_flagship_50k(small: bool) -> dict:
               (25_000, 2_500, 256, 32), (12_500, 1_250, 192, 32)]
     last_err = None
     for n_train, n_test, size, batch in ladder:
+        left = _child_deadline_left()
+        if left is not None and left <= 120.0:
+            why = (f" (last rung error: {last_err[:120]})" if last_err else "")
+            raise RuntimeError(
+                "child deadline before a flagship rung could start" + why
+            )
         try:
             out = run_flagship_ondevice(
                 num_train=n_train, num_test=n_test, num_classes=1_000,
@@ -826,19 +874,29 @@ def _bench_ingest(small: bool) -> dict:
 
     ncpu = os.cpu_count() or 1
     curve = {}
-    for threads in sorted({1, max(1, ncpu // 2), ncpu}):
-        curve[f"threads_{threads}"] = measure_ingest(fixture, threads=threads)
-
     out = {
         "num_images": n,
         "fixture_build_s": round(build_s, 1),
         "host_cpus": ncpu,
         "scaling": curve,
-        "images_per_sec_decode": curve[f"threads_{ncpu}"].get(
-            "images_per_sec_decode"
-        ),
     }
+    for threads in sorted({1, max(1, ncpu // 2), ncpu}):
+        left = _child_deadline_left()
+        if left is not None and left <= 30.0:
+            if not curve:  # nothing measured: this must stay an error
+                raise RuntimeError("child deadline before first decode point")
+            out["truncated"] = f"child deadline before threads_{threads}"
+            return out
+        curve[f"threads_{threads}"] = measure_ingest(fixture, threads=threads)
 
+    out["images_per_sec_decode"] = curve[f"threads_{ncpu}"].get(
+        "images_per_sec_decode"
+    )
+
+    left = _child_deadline_left()
+    if left is not None and left <= 60.0:
+        out["truncated"] = "child deadline before overlap leg"
+        return out
     # Overlap leg: decode feeding device SIFT featurization (skipped on
     # the CPU fallback where "device" work would fight decode for cores).
     import jax
@@ -976,6 +1034,15 @@ def _run_child(
         cmd.append("--small")
     if workload:
         cmd += ["--workload", workload]
+    # Cooperative deadline a margin under the hard timeout: legs that
+    # can stop between stages exit gracefully (releasing the TPU claim)
+    # instead of eating a SIGKILL mid-claim. Always computed from THIS
+    # child's timeout (an operator's exported value must not leak in),
+    # and always strictly inside the SIGKILL with a real margin, even
+    # for tight budget-capped timeouts.
+    env = dict(env)
+    margin = 90.0 if timeout_s >= 300.0 else max(10.0, 0.3 * timeout_s)
+    env["KEYSTONE_BENCH_CHILD_DEADLINE"] = str(max(10.0, timeout_s - margin))
     try:
         proc = subprocess.run(
             cmd, env=env, capture_output=True, text=True, timeout=timeout_s
@@ -1102,14 +1169,18 @@ def _adopt_captured_legs(merged: dict, names: list[str]) -> list[str]:
     for name in names:
         for path, mtime, captured in captures:  # newest first
             leg = captured.get(name)
-            if not isinstance(leg, dict) or "error" in leg or "skipped" in leg:
-                continue
+            if (not isinstance(leg, dict) or "error" in leg
+                    or "skipped" in leg or "truncated" in leg):
+                continue  # only COMPLETE captured legs are worth adopting
             replaced = merged.get(name)
+            this_run = (replaced or {}).get("error") \
+                or (replaced or {}).get("skipped") or "not run"
+            if replaced and "truncated" in replaced:
+                this_run = f"truncated: {replaced['truncated']}"
             stamp = {
                 "source": path,
                 "captured_mtime": mtime,
-                "this_run": (replaced or {}).get("error")
-                or (replaced or {}).get("skipped") or "not run",
+                "this_run": this_run,
             }
             # A capture can itself contain adopted legs (watchdog runs
             # use this same main()). Keep the WHOLE chain — restamping
@@ -1390,7 +1461,8 @@ def main() -> int:
             n for n in pending_names
             if not isinstance(merged.get(n), dict)
             or "error" in merged[n] or "skipped" in merged[n]
-        ]
+            or "truncated" in merged[n]  # a COMPLETE capture beats a
+        ]                                # live partial (reason stamped)
         adopted = _adopt_captured_legs(merged, pending)
     if report is None:
         run_cpu_insurance()  # no accelerator success and no insurance yet
@@ -1422,6 +1494,10 @@ def main() -> int:
         k for k, v in report.items()
         if isinstance(v, dict) and "skipped" in v
     )
+    truncated = sorted(
+        k for k, v in report.items()
+        if isinstance(v, dict) and "truncated" in v
+    )
     reduced = sorted(
         k for k, v in report.items()
         if isinstance(v, dict) and v.get("extrapolated")
@@ -1433,6 +1509,7 @@ def main() -> int:
         "vs_baseline": round(TIMIT_BASELINE_MS / ms, 3) if ms else None,
         "workloads_with_errors": failed,
         "workloads_skipped_budget": skipped,
+        "workloads_truncated": truncated,
         "workloads_from_capture": sorted(adopted),
         # The headline itself must not read as a live measurement when
         # timit_exact was adopted — flag it at the top level too.

@@ -762,3 +762,35 @@ def test_adopt_captured_legs_falls_through_candidates(tmp_path, monkeypatch):
     assert merged["imagenet_fv"]["adopted_from_capture"]["source"] == str(a)
     assert merged["imagenet_flagship"]["wall_s"] == 77.0
     assert merged["imagenet_flagship"]["adopted_from_capture"]["source"] == str(b)
+
+
+def test_adopt_handles_truncated_legs(tmp_path, monkeypatch):
+    """Truncated legs (graceful in-leg deadline exits) are a third
+    state: a truncated CAPTURE leg is incomplete and never adopted; a
+    truncated LIVE leg is adopted over by a complete capture with the
+    truncation reason stamped as this_run."""
+    import json
+
+    import bench
+
+    cap = tmp_path / "t_onchip_bench.json"
+    cap.write_text(json.dumps({
+        "platform": "tpu",
+        "imagenet_fv": {"sift_ms": 1.0, "truncated": "deadline"},
+        "cifar_random_patch": {"end_to_end_fit_s": 42.0},
+    }) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(cap))
+    merged = {
+        "imagenet_fv": {"error": "x"},
+        "cifar_random_patch": {
+            "featurize_images_per_sec_device": 5.0,
+            "truncated": "child deadline before end-to-end fit",
+        },
+    }
+    adopted = bench._adopt_captured_legs(
+        merged, ["imagenet_fv", "cifar_random_patch"])
+    assert adopted == ["cifar_random_patch"]
+    assert merged["cifar_random_patch"]["end_to_end_fit_s"] == 42.0
+    assert merged["cifar_random_patch"]["adopted_from_capture"][
+        "this_run"].startswith("truncated:")
+    assert "error" in merged["imagenet_fv"]
