@@ -822,7 +822,11 @@ def _bench_flagship_50k(small: bool) -> dict:
     last_err = None
     for n_train, n_test, size, batch in ladder:
         left = _child_deadline_left()
-        if left is not None and left <= 120.0:
+        # 360 s: a rung must fit codebook fit (phase A, unguarded inside
+        # the runner) AND clear the encode loop's own 180 s first check
+        # with something measured — entering with less just truncates at
+        # batch 0 having measured nothing past the codebook.
+        if left is not None and left <= 360.0:
             why = (f" (last rung error: {last_err[:120]})" if last_err else "")
             raise RuntimeError(
                 "child deadline before a flagship rung could start" + why
@@ -831,6 +835,7 @@ def _bench_flagship_50k(small: bool) -> dict:
             out = run_flagship_ondevice(
                 num_train=n_train, num_test=n_test, num_classes=1_000,
                 image_size=size, batch=batch, progress_s=60.0,
+                deadline_left_fn=_child_deadline_left,
             )
             if (n_train, n_test, size, batch) != ladder[0]:
                 out["extrapolated"] = True

@@ -158,3 +158,22 @@ def test_flagship_ondevice_learns_planted_classes():
     assert out["top5_err_percent"] <= 25.0
     assert out["encode_images_per_sec"] > 0
     assert out["fv_dim_combined"] == 4096
+
+
+def test_flagship_deadline_truncates_gracefully():
+    """A time-budgeted flagship run (deadline_left_fn) stops at a safe
+    boundary and returns measured phases with a truncated marker — the
+    mechanism that keeps bench children from being SIGKILLed mid-claim."""
+    import time
+
+    from keystone_tpu.pipelines.imagenet_streaming import run_flagship_ondevice
+
+    t0 = time.time()
+    r = run_flagship_ondevice(
+        num_train=48, num_test=16, num_classes=4, image_size=64, batch=16,
+        deadline_left_fn=lambda: 0.0,  # already expired: truncate at once
+    )
+    assert "truncated" in r
+    assert "codebook_fit_s" in r  # phase A was still measured
+    assert "top5_err_percent" not in r
+    assert time.time() - t0 < 120
