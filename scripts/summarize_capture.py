@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Summarize a bench capture/driver artifact: headline ratios vs the
+reference cluster, per-leg status, and the staged decisions that hang on
+the numbers (the SIFT bf16-binning default, NEXT_LEVERS item 2).
+
+Usage:
+    python scripts/summarize_capture.py [artifact.json ...]
+
+With no arguments, summarizes the newest docs/measurements/*onchip_bench.json
+plus BENCH_PARTIAL.json if present. Accepts both one-line captures and
+indented partial dumps (first JSON object found).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Reference cluster numbers (BASELINE.md; reference
+# scripts/solver-comparisons-final.csv lines 14 and 26).
+TIMIT_EXACT_16NODE_MS = 7_323.0
+TIMIT_WIDE_16NODE_MS = 580_555.0
+
+
+def load_artifact(path: str) -> dict | None:
+    try:
+        text = open(path).read()
+    except OSError as e:
+        print(f"  ! {path}: {e}")
+        return None
+    # One-line capture, driver tail, or an indented partial dump.
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError as e:
+        print(f"  ! {path}: no parseable JSON ({e})")
+        return None
+
+
+def leg_status(v) -> str:
+    if not isinstance(v, dict):
+        return "missing"
+    if "error" in v:
+        return "ERROR: " + " ".join(str(v["error"]).split())[:70]
+    if "skipped" in v:
+        return f"skipped: {str(v['skipped'])[:60]}"
+    bits = []
+    if "truncated" in v:
+        bits.append(f"TRUNCATED ({str(v['truncated'])[:50]})")
+    if "adopted_from_capture" in v:
+        src = os.path.basename(v["adopted_from_capture"].get("source", "?"))
+        bits.append(f"adopted<-{src}")
+    if v.get("extrapolated"):
+        bits.append("extrapolated")
+    bits.append("ok")
+    return ", ".join(bits)
+
+
+def summarize(path: str) -> None:
+    d = load_artifact(path)
+    if d is None:
+        return
+    print(f"\n=== {path}")
+    plat = d.get("platform", "?")
+    print(f"platform={plat} device={d.get('device_kind', '?')} "
+          f"partial={d.get('partial', False)}")
+
+    timit = d.get("timit_exact") or {}
+    ms = timit.get("fit_ms_extrapolated_full_shape", timit.get("fit_ms"))
+    if ms:
+        tag = " (extrapolated)" if timit.get("extrapolated") else ""
+        print(f"timit_exact headline: {ms:,.1f} ms -> "
+              f"{TIMIT_EXACT_16NODE_MS / ms:.2f}x the 16-node cluster{tag}")
+    wide = d.get("timit_wide_block") or {}
+    wms = wide.get("fit_ms")
+    if wms and not wide.get("extrapolated"):
+        print(f"timit_wide_block FULL n: {wms:,.1f} ms -> "
+              f"{TIMIT_WIDE_16NODE_MS / wms:.2f}x the 16-node cluster")
+
+    gram = d.get("gram_mfu") or {}
+    if "bf16_tflops" in gram:
+        note = " [PEAK MISMATCH FLAGGED]" if "peak_note" in gram else ""
+        print(f"gram: bf16 {gram['bf16_tflops']} TF/s, "
+              f"fp32_highest {gram.get('fp32_highest_tflops')} TF/s{note}")
+
+    flag = d.get("imagenet_flagship") or {}
+    if "top5_err_percent" in flag:
+        print(f"flagship: top5_err={flag['top5_err_percent']}% "
+              f"end_to_end={flag.get('end_to_end_fit_s')}s "
+              f"({flag.get('num_train')} imgs, {flag.get('num_classes')} classes)")
+
+    native = d.get("imagenet_native") or {}
+    ab = native.get("sift_binning_ab") or {}
+    if "speedup_bf16" in ab:
+        s = ab["speedup_bf16"]
+        verdict = ("FLIP the SIFTExtractor binning default to bf16"
+                   if s >= 1.1 else "keep fp32 binning default")
+        print(f"sift bf16-binning A/B: {s}x -> {verdict} "
+              "(docs/NEXT_LEVERS.md item 2, threshold 1.1)")
+
+    order = [k for k in d if isinstance(d.get(k), dict)
+             and ("wall_s" in d[k] or "error" in d[k] or "skipped" in d[k]
+                  or "fit_ms" in d[k] or "scaling" in d[k]
+                  or "end_to_end_fit_s" in d[k])]
+    if order:
+        print("legs:")
+        for k in order:
+            print(f"  {k:24s} {leg_status(d[k])}")
+    for key in ("workloads_with_errors", "workloads_skipped_budget",
+                "workloads_truncated", "workloads_from_capture"):
+        if d.get(key):
+            print(f"{key}: {d[key]}")
+    if d.get("best_onchip_run"):
+        b = d["best_onchip_run"]
+        print(f"best_onchip_run: {b.get('source')} ({b.get('captured_mtime')})")
+
+
+def main(argv: list[str]) -> int:
+    paths = argv[1:]
+    if not paths:
+        caps = sorted(
+            glob.glob(os.path.join(REPO, "docs/measurements/*onchip_bench.json")),
+            key=os.path.getmtime, reverse=True,
+        )
+        paths = caps[:1]
+        partial = os.path.join(REPO, "BENCH_PARTIAL.json")
+        if os.path.exists(partial):
+            paths.append(partial)
+        if not paths:
+            print("no artifacts found")
+            return 1
+    for p in paths:
+        summarize(p)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
