@@ -794,3 +794,33 @@ def test_adopt_handles_truncated_legs(tmp_path, monkeypatch):
     assert merged["cifar_random_patch"]["adopted_from_capture"][
         "this_run"].startswith("truncated:")
     assert "error" in merged["imagenet_fv"]
+
+
+def test_bench_headline_adoption_is_disclosed(monkeypatch, capsys, tmp_path):
+    """When timit_exact fails live but a capture supplies it, the
+    headline value comes from the capture — and the artifact must say so
+    at the top level (headline_from_capture), not only inside the leg."""
+    import json
+
+    import bench
+
+    monkeypatch.chdir(tmp_path)
+    cap = tmp_path / "h_onchip_bench.json"
+    cap.write_text(json.dumps({
+        "platform": "tpu",
+        "timit_exact": {"fit_ms": 250.0, "shape": [2_200_000, 1024, 138]},
+    }) + "\n")
+    monkeypatch.setenv("KEYSTONE_ONCHIP_CAPTURE", str(cap))
+
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda env, timeout_s=120: (True, "PROBE_OK tpu 1"))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        _fake_child_factory("tpu", fail_workloads=("timit_exact",)))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.main() == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["value"] == 250.0
+    assert out["headline_from_capture"] is True
+    assert "timit_exact" in out["workloads_from_capture"]
+    assert out["timit_exact"]["adopted_from_capture"]["source"] == str(cap)
