@@ -39,6 +39,12 @@ while true; do
       # A stale partial from a previous run must not be promotable as
       # this run's capture (freshness laundering) — clear it first.
       rm -f BENCH_PARTIAL.json
+      # Capture-run leg order ≠ the driver's: after the cheap headline
+      # trio, spend the window on the NORTH-STAR flagship (50k/1000-way/
+      # top-5) before the other long legs — if the relay dies mid-run
+      # again, the most valuable evidence is already banked. The env
+      # preserves listed order.
+      KEYSTONE_BENCH_WORKLOADS="timit_exact,gram_mfu,timit_wide_block,imagenet_flagship,imagenet_fv,imagenet_native,cifar_random_patch,ingest" \
       KEYSTONE_BENCH_MEASURE_BUDGET=13000 \
         timeout 14400 python bench.py > "$OUT.tmp" 2>> "$LOG"
       rc=$?
