@@ -824,3 +824,26 @@ def test_bench_headline_adoption_is_disclosed(monkeypatch, capsys, tmp_path):
     assert out["headline_from_capture"] is True
     assert "timit_exact" in out["workloads_from_capture"]
     assert out["timit_exact"]["adopted_from_capture"]["source"] == str(cap)
+
+
+def test_child_deadline_helpers(monkeypatch):
+    """_child_deadline_left / _deadline_within: unset -> no deadline;
+    set -> counts down from process start; margin comparison inclusive
+    of the boundary side that must truncate."""
+    import bench
+
+    monkeypatch.delenv("KEYSTONE_BENCH_CHILD_DEADLINE", raising=False)
+    assert bench._child_deadline_left() is None
+    assert bench._deadline_within(1e9) is False
+
+    # Far-future deadline: plenty left, nothing within a small margin.
+    monkeypatch.setenv("KEYSTONE_BENCH_CHILD_DEADLINE", "1000000")
+    left = bench._child_deadline_left()
+    assert left is not None and left > 900_000
+    assert bench._deadline_within(60.0) is False
+
+    # Already-expired deadline (negative: expired before process start
+    # regardless of how recently this process imported bench).
+    monkeypatch.setenv("KEYSTONE_BENCH_CHILD_DEADLINE", "-5")
+    assert bench._deadline_within(0.0) is True
+    assert bench._deadline_within(60.0) is True
