@@ -548,6 +548,14 @@ def _bench_imagenet_fv(small: bool) -> dict:
     ]
     last_err = None
     for n_img, size, num_classes in ladder:
+        # Same per-rung gate as the flagship ladder: a rung entered with
+        # no room measures nothing and risks the SIGKILL; the in-leg
+        # stage checks (truncate_before) handle everything after entry.
+        if _deadline_within(60.0 if small else 300.0):
+            why = (f" (last rung error: {last_err[:120]})" if last_err else "")
+            raise RuntimeError(
+                "child deadline before an imagenet_fv rung could start" + why
+            )
         try:
             out = _imagenet_fv_at(n_img, size, num_classes, small)
             if (n_img, size, num_classes) != ladder[0]:
