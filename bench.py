@@ -1364,7 +1364,8 @@ def main() -> int:
         # contract is an artifact, not a deadline miss) — but then only
         # at its floor allocation.
         child_budget = max(150.0, min(600.0, remaining()))
-        env["KEYSTONE_BENCH_CHILD_DEADLINE"] = str(child_budget - 90.0)
+        # (_run_child computes the child's cooperative deadline from
+        # timeout_s — no need to set it here.)
         t0 = time.monotonic()
         report, err = _run_child(env, small=True, timeout_s=child_budget)
         waited[0] += time.monotonic() - t0
