@@ -44,9 +44,25 @@ while true; do
       # top-5) before the other long legs — if the relay dies mid-run
       # again, the most valuable evidence is already banked. The env
       # preserves listed order.
+      #
+      # The measuring budget is computed from the WALL CLOCK so a
+      # late-in-the-round recovery cannot overrun into the driver's
+      # end-of-round bench (two TPU processes wedge the relay). The
+      # deadline is set via KEYSTONE_WATCHDOG_HANDOFF_EPOCH (unix
+      # seconds the chip must be free by); unset → 13000 s as before.
+      budget=13000
+      if [ -n "${KEYSTONE_WATCHDOG_HANDOFF_EPOCH:-}" ]; then
+        budget=$(( KEYSTONE_WATCHDOG_HANDOFF_EPOCH - $(date +%s) - 1800 ))
+        if [ "$budget" -lt 900 ]; then
+          echo "[$(stamp)] relay healthy but only ${budget}s of budget before handoff — leaving the chip to the driver" >> "$LOG"
+          exit 0
+        fi
+        if [ "$budget" -gt 13000 ]; then budget=13000; fi
+      fi
+      echo "[$(stamp)] capture measure budget: ${budget}s" >> "$LOG"
       KEYSTONE_BENCH_WORKLOADS="timit_exact,gram_mfu,timit_wide_block,imagenet_flagship,imagenet_fv,imagenet_native,cifar_random_patch,ingest" \
-      KEYSTONE_BENCH_MEASURE_BUDGET=13000 \
-        timeout 14400 python bench.py > "$OUT.tmp" 2>> "$LOG"
+      KEYSTONE_BENCH_MEASURE_BUDGET="$budget" \
+        timeout $(( budget + 1400 )) python bench.py > "$OUT.tmp" 2>> "$LOG"
       rc=$?
       if [ "$rc" != 0 ] && [ -s BENCH_PARTIAL.json ]; then
         # The run died before printing its line — promote the per-leg
