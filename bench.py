@@ -73,6 +73,11 @@ import subprocess
 import sys
 import time
 
+# stdlib-only at import time (keystone_tpu/__init__ is lazy; the
+# reliability package never imports jax) — safe before any backend probe.
+from keystone_tpu.reliability.degrade import DegradationLadder, halving_rungs
+from keystone_tpu.reliability.errors import DeadlineExceeded
+
 TIMIT_BASELINE_MS = 7_323.0  # reference: scripts/solver-comparisons-final.csv:14
 
 _T0 = time.time()  # process start; in a --child this is child start
@@ -173,61 +178,61 @@ def _bench_timit_exact(small: bool) -> dict:
     ndev = mesh.devices.size
     reg = 1e-2
 
-    n = full_n - full_n % ndev
-    x = y = model = None
-    while True:
-        try:
-            # ONE fused generation dispatch. The eager form
-            # (normal(...) * scales) materializes the raw normal AND the
-            # scaled product — two (n, d) buffers, 18 GB at the full
-            # TIMIT shape — which OOMs a 16 GB v5e before the solver
-            # ever runs (JAX's default preallocation leaves ~12 GB
-            # usable). Under jit, XLA fuses RNG→scale into a single
-            # write of x and signal+noise into a single write of y.
-            def _gen(key):
-                ka, kb, kw = jax.random.split(key, 3)
-                scales = jnp.logspace(0.0, -2.0, d, dtype=jnp.float32)
-                x = jax.random.normal(ka, (n, d), dtype=jnp.float32) * scales
-                w_true = jax.random.normal(kw, (d, k), dtype=jnp.float32)
-                y = jnp.matmul(x, w_true, precision=jax.lax.Precision.HIGHEST)
-                y = y + 0.1 * jax.random.normal(kb, (n, k), dtype=jnp.float32)
-                return x, y
+    # OOM ladder (shared DegradationLadder): halve n, aligned to the mesh,
+    # down to full_n/16. Between rungs the ladder retains only the error
+    # STRING, so the failed attempt's x/y/model buffers are freed before
+    # the next allocation (holding them across the retry is itself an OOM
+    # source — the r5 on-chip failure mode).
+    ladder = DegradationLadder(
+        halving_rungs(full_n - full_n % ndev, full_n // 16, align=ndev),
+        label="bench.timit_exact",
+    )
 
-            x, y = jax.jit(_gen)(jax.random.PRNGKey(0))
-            jax.block_until_ready((x, y))
+    def _attempt(n):
+        # ONE fused generation dispatch. The eager form
+        # (normal(...) * scales) materializes the raw normal AND the
+        # scaled product — two (n, d) buffers, 18 GB at the full
+        # TIMIT shape — which OOMs a 16 GB v5e before the solver
+        # ever runs (JAX's default preallocation leaves ~12 GB
+        # usable). Under jit, XLA fuses RNG→scale into a single
+        # write of x and signal+noise into a single write of y.
+        def _gen(key):
+            ka, kb, kw = jax.random.split(key, 3)
+            scales = jnp.logspace(0.0, -2.0, d, dtype=jnp.float32)
+            x = jax.random.normal(ka, (n, d), dtype=jnp.float32) * scales
+            w_true = jax.random.normal(kw, (d, k), dtype=jnp.float32)
+            y = jnp.matmul(x, w_true, precision=jax.lax.Precision.HIGHEST)
+            y = y + 0.1 * jax.random.normal(kb, (n, k), dtype=jnp.float32)
+            return x, y
 
-            est = LinearMapEstimator(reg=reg)
-            features, labels = ArrayDataset(x), ArrayDataset(y)
+        x, y = jax.jit(_gen)(jax.random.PRNGKey(0))
+        jax.block_until_ready((x, y))
 
-            def force(model):
-                return float(jnp.sum(model.weights))
+        est = LinearMapEstimator(reg=reg)
+        features, labels = ArrayDataset(x), ArrayDataset(y)
 
-            model = est.fit(features, labels)
-            force(model)  # compile warm-up (model reused for the mse below)
-            times = []
-            for _ in range(3):
-                start = time.perf_counter()
-                force(est.fit(features, labels))
-                times.append((time.perf_counter() - start) * 1000.0)
-            ms = float(np.median(times))
+        def force(model):
+            return float(jnp.sum(model.weights))
 
-            # Train mse on a head slice at FIXED HIGHEST eval precision.
-            head = min(n, 65_536)
-            xh = x[:head] - (model.feature_mean if model.feature_mean is not None else 0.0)
-            pred = jnp.matmul(xh, model.weights, precision=jax.lax.Precision.HIGHEST)
-            if model.intercept is not None:
-                pred = pred + model.intercept
-            mse = float(jnp.mean((pred - y[:head]) ** 2))
-            break
-        except Exception as e:  # OOM or shape-dependent failure: halve n
-            # Free THIS attempt's buffers before allocating the next —
-            # holding the failed n's x/y (directly or via the dataset
-            # wrappers) across the retry is itself an OOM source (the
-            # r5 on-chip failure mode).
-            x = y = model = features = labels = None
-            if n <= full_n // 16 or "RESOURCE_EXHAUSTED" not in str(e).upper():
-                raise
-            n = (n // 2) - ((n // 2) % ndev)
+        model = est.fit(features, labels)
+        force(model)  # compile warm-up (model reused for the mse below)
+        times = []
+        for _ in range(3):
+            start = time.perf_counter()
+            force(est.fit(features, labels))
+            times.append((time.perf_counter() - start) * 1000.0)
+        ms = float(np.median(times))
+
+        # Train mse on a head slice at FIXED HIGHEST eval precision.
+        head = min(n, 65_536)
+        xh = x[:head] - (model.feature_mean if model.feature_mean is not None else 0.0)
+        pred = jnp.matmul(xh, model.weights, precision=jax.lax.Precision.HIGHEST)
+        if model.intercept is not None:
+            pred = pred + model.intercept
+        mse = float(jnp.mean((pred - y[:head]) ** 2))
+        return n, x, y, est, model, ms, mse
+
+    n, x, y, est, model, ms, mse = ladder.run(_attempt)
 
     # Weight-space distance to the converged reference solution (HIGHEST
     # Gram + 2 IR steps — the best this chip can do; fp64 unavailable).
@@ -299,25 +304,25 @@ def _bench_timit_wide_block(small: bool) -> dict:
         kk = jax.random.fold_in(jax.random.fold_in(key, b), row_offset)
         return jax.random.normal(kk, (rows, bs), jnp.float32)
 
-    while True:
-        try:
-            ndev = mesh.devices.size
-            n_pad = ((n + ndev - 1) // ndev) * ndev
-            y = jax.random.normal(jax.random.PRNGKey(3), (n_pad, k), jnp.float32)
-            ys = linalg.prepare_row_sharded(y, mesh)
+    ladder = DegradationLadder(
+        halving_rungs(n, 8_192), label="bench.timit_wide_block"
+    )
 
-            def fit():
-                return linalg.block_coordinate_descent_rematerialized(
-                    block_fn, ys, reg=1e-2, num_epochs=1, block_size=bs,
-                    num_blocks=num_blocks, mesh=mesh,
-                )
+    def _attempt(n):
+        ndev = mesh.devices.size
+        n_pad = ((n + ndev - 1) // ndev) * ndev
+        y = jax.random.normal(jax.random.PRNGKey(3), (n_pad, k), jnp.float32)
+        ys = linalg.prepare_row_sharded(y, mesh)
 
-            ms = _timed(fit) * 1000.0  # shared warmup+median-of-3 timer
-            break
-        except Exception as e:
-            if n <= 8_192 or "RESOURCE_EXHAUSTED" not in str(e).upper():
-                raise
-            n //= 2
+        def fit():
+            return linalg.block_coordinate_descent_rematerialized(
+                block_fn, ys, reg=1e-2, num_epochs=1, block_size=bs,
+                num_blocks=num_blocks, mesh=mesh,
+            )
+
+        return n, _timed(fit) * 1000.0  # shared warmup+median-of-3 timer
+
+    n, ms = ladder.run(_attempt)
 
     out = {"fit_ms": round(ms, 2), "shape": [n, d, k], "block_size": bs,
            "num_epochs": 1,
@@ -499,26 +504,25 @@ def _bench_cifar_random_patch(small: bool) -> dict:
     # device inside the BCD step (conv is MXU-cheap, HBM is the scarce
     # resource), so the (n, 80000) feature matrix never exists and the
     # host link carries nothing but the images. Halve n on OOM.
-    n_do = n_train
-    while True:
+    ladder = DegradationLadder(
+        halving_rungs(n_train, n_train // 4), label="bench.cifar_random_patch"
+    )
+
+    def _attempt(n_do):
         images = rng.random((n_do, 32, 32, 3), dtype=np.float32)
-        try:
-            est = ConvBlockLeastSquaresEstimator(
-                featurizer, block_size=4096 if not small else 128,
-                num_iter=1, reg=3000.0,
-                image_chunk=2048 if not small else 256,
-            )
-            t0 = time.perf_counter()
-            model = est.fit(
-                ArrayDataset(images), ArrayDataset(labels_full[:n_do])
-            )
-            float(jnp.sum(model.weights))
-            fit_s = time.perf_counter() - t0
-            break
-        except Exception as e:
-            if n_do <= n_train // 4 or "RESOURCE_EXHAUSTED" not in str(e).upper():
-                raise
-            n_do //= 2
+        est = ConvBlockLeastSquaresEstimator(
+            featurizer, block_size=4096 if not small else 128,
+            num_iter=1, reg=3000.0,
+            image_chunk=2048 if not small else 256,
+        )
+        t0 = time.perf_counter()
+        model = est.fit(
+            ArrayDataset(images), ArrayDataset(labels_full[:n_do])
+        )
+        float(jnp.sum(model.weights))
+        return n_do, model, time.perf_counter() - t0
+
+    n_do, model, fit_s = ladder.run(_attempt)
 
     d_model = int(model.weights.shape[0])
     out = {
@@ -542,39 +546,43 @@ def _bench_imagenet_fv(small: bool) -> dict:
     reference: ImageNetSiftLcsFV.scala:132-167) over synthetic images.
     Walks a reduction ladder on RESOURCE_EXHAUSTED so an OOM at the
     flagship shape still yields a measured (marked) number."""
-    ladder = [(4, 64, 16)] if small else [
+    rungs = [(4, 64, 16)] if small else [
         (32, 256, 1000), (16, 256, 1000), (8, 256, 1000),
         (8, 128, 1000), (4, 64, 16),
     ]
-    last_err = None
-    for n_img, size, num_classes in ladder:
+    ladder = DegradationLadder(rungs, label="bench.imagenet_fv")
+
+    def _attempt(rung):
+        n_img, size, num_classes = rung
         # Same per-rung gate as the flagship ladder: a rung entered with
         # no room measures nothing and risks the SIGKILL; the in-leg
         # stage checks (truncate_before) handle everything after entry.
+        # DeadlineExceeded classifies by TYPE (before message patterns),
+        # so embedding a prior rung's RESOURCE_EXHAUSTED text below cannot
+        # make the ladder mistake this abort for an OOM and swallow it.
         if _deadline_within(60.0 if small else 300.0):
-            why = (f" (last rung error: {last_err[:120]})" if last_err else "")
-            raise RuntimeError(
+            why = (
+                f" (last rung error: {ladder.last_error[:120]})"
+                if ladder.last_error else ""
+            )
+            raise DeadlineExceeded(
                 "child deadline before an imagenet_fv rung could start" + why
             )
-        try:
-            out = _imagenet_fv_at(n_img, size, num_classes, small)
-            if (n_img, size, num_classes) != ladder[0]:
-                out["extrapolated"] = True
-                # Record the full rung (incl. num_classes — the solve cost
-                # scales with it, so a reader can't rescale by images alone).
-                out["reduced_from"] = {
-                    "num_images": ladder[0][0], "image_size": ladder[0][1],
-                    "num_classes": ladder[0][2],
-                }
-                out["num_classes"] = num_classes
-                if last_err:
-                    out["reduction_reason"] = last_err[:200]
-            return out
-        except Exception as e:
-            if "RESOURCE_EXHAUSTED" not in str(e).upper():
-                raise
-            last_err = f"{type(e).__name__}: {e}"
-    raise RuntimeError(f"imagenet_fv OOM at every ladder rung: {last_err}")
+        return _imagenet_fv_at(n_img, size, num_classes, small)
+
+    out = ladder.run(_attempt)
+    if ladder.reduced:
+        out["extrapolated"] = True
+        # Record the full rung (incl. num_classes — the solve cost
+        # scales with it, so a reader can't rescale by images alone).
+        first = ladder.record["first_rung"]
+        out["reduced_from"] = {
+            "num_images": first[0], "image_size": first[1],
+            "num_classes": first[2],
+        }
+        out["num_classes"] = ladder.record["rung"][2]
+        out["reduction_reason"] = ladder.record["reduction_reason"]
+    return out
 
 
 def _imagenet_fv_at(n_img: int, size: int, num_classes: int, small: bool) -> dict:
@@ -886,37 +894,38 @@ def _bench_flagship_50k(small: bool) -> dict:
         return run_flagship_ondevice(
             num_train=96, num_test=32, num_classes=8, image_size=64, batch=16
         )
-    ladder = [(50_000, 5_000, 256, 64), (50_000, 5_000, 256, 32),
-              (25_000, 2_500, 256, 32), (12_500, 1_250, 192, 32)]
-    last_err = None
-    for n_train, n_test, size, batch in ladder:
+    rungs = [(50_000, 5_000, 256, 64), (50_000, 5_000, 256, 32),
+             (25_000, 2_500, 256, 32), (12_500, 1_250, 192, 32)]
+    ladder = DegradationLadder(rungs, label="bench.imagenet_flagship")
+
+    def _attempt(rung):
+        n_train, n_test, size, batch = rung
         # 360 s: a rung must fit codebook fit (phase A, unguarded inside
         # the runner) AND clear the encode loop's own 180 s first check
         # with something measured — entering with less just truncates at
-        # batch 0 having measured nothing past the codebook.
+        # batch 0 having measured nothing past the codebook. Typed
+        # DeadlineExceeded so a quoted OOM string can't read as OOM.
         if _deadline_within(360.0):
-            why = (f" (last rung error: {last_err[:120]})" if last_err else "")
-            raise RuntimeError(
+            why = (
+                f" (last rung error: {ladder.last_error[:120]})"
+                if ladder.last_error else ""
+            )
+            raise DeadlineExceeded(
                 "child deadline before a flagship rung could start" + why
             )
-        try:
-            out = run_flagship_ondevice(
-                num_train=n_train, num_test=n_test, num_classes=1_000,
-                image_size=size, batch=batch, progress_s=60.0,
-                deadline_left_fn=_child_deadline_left,
-            )
-            if (n_train, n_test, size, batch) != ladder[0]:
-                out["extrapolated"] = True
-                out["reduced_from"] = {"num_train": ladder[0][0],
-                                       "image_size": ladder[0][2]}
-                if last_err:
-                    out["reduction_reason"] = last_err[:200]
-            return out
-        except Exception as e:
-            if "RESOURCE_EXHAUSTED" not in str(e).upper():
-                raise
-            last_err = f"{type(e).__name__}: {e}"
-    raise RuntimeError(f"flagship OOM at every ladder rung: {last_err}")
+        return run_flagship_ondevice(
+            num_train=n_train, num_test=n_test, num_classes=1_000,
+            image_size=size, batch=batch, progress_s=60.0,
+            deadline_left_fn=_child_deadline_left,
+        )
+
+    out = ladder.run(_attempt)
+    if ladder.reduced:
+        out["extrapolated"] = True
+        out["reduced_from"] = {"num_train": rungs[0][0],
+                               "image_size": rungs[0][2]}
+        out["reduction_reason"] = ladder.record["reduction_reason"]
+    return out
 
 
 def _bench_ingest(small: bool) -> dict:

@@ -86,6 +86,7 @@ def measure_ingest(
 
     t0 = time.perf_counter()
     done = 0
+    corrupt = 0  # undecodable entries: quarantined, never abort the stream
     raw_bytes = 0
     pending = None  # in-flight featurize result to force
     pool = ThreadPoolExecutor(max_workers=1)
@@ -116,6 +117,7 @@ def measure_ingest(
         images, ok = decode(c)
         decode_s += time.perf_counter() - td
         done += int(ok.sum())
+        corrupt += len(c) - int(ok.sum())
         if featurize is not None:
             tw = time.perf_counter()
             if pending is not None:
@@ -127,8 +129,15 @@ def measure_ingest(
     total_s = time.perf_counter() - t0
     pool.shutdown()
 
+    if corrupt:
+        from ..reliability.recovery import get_recovery_log
+
+        get_recovery_log().record(
+            "quarantine", "measure_ingest", count=corrupt, source=tar_path
+        )
     out = {
         "images": done,
+        "corrupt_skipped": corrupt,
         "tar_read_s": round(read_s, 2),
         "decode_s": round(decode_s, 2),
         "images_per_sec_decode": round(done / max(decode_s, 1e-9), 1),

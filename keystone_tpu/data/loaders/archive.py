@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tupl
 import numpy as np
 
 from ..dataset import ObjectDataset
+from ...reliability.faultinject import probe
+from ...reliability.recovery import QuarantineCounts
 from ...utils.image import load_image
 
 
@@ -129,22 +131,29 @@ def load_image_archives(
     "filename": entry_name}``.
 
     Entries whose ``label_fn`` raises KeyError or whose bytes fail to
-    decode are skipped, matching the reference's Option-typed loader
-    (reference: ImageLoaderUtils.scala:84-88).
+    decode are skipped-and-quarantined, matching the reference's
+    Option-typed loader (reference: ImageLoaderUtils.scala:84-88) — but
+    with the counts surfaced: the returned dataset carries a
+    ``.quarantine`` dict and the totals land in the process recovery log,
+    so a corrupt shard degrades a run's coverage visibly instead of
+    silently (or, pre-quarantine, fatally).
 
     With ``resize`` set and the native library built, decode+resize runs
     through the OpenMP libjpeg kernel (``use_native=None`` auto-detects;
     True requires it; False forces the PIL path).
     """
+    quarantine = QuarantineCounts()
 
     def decode(item: Tuple[str, bytes]) -> Optional[Dict[str, Any]]:
         name, raw = item
         try:
             label = label_fn(name)
         except KeyError:
+            quarantine.add("label_missing", name)
             return None
         img = load_image(raw)
         if img is None:
+            quarantine.add("decode_failed", name)
             return None
         if resize is not None:
             img = _resize_image(img, resize)
@@ -171,11 +180,13 @@ def load_image_archives(
                 batch = list(itertools.islice(entries, chunk * 8))
                 if not batch:
                     break
+                probe("ingest.decode_batch")
                 labeled = []
                 for name, raw in batch:
                     try:
                         labeled.append((name, raw, label_fn(name)))
                     except KeyError:
+                        quarantine.add("label_missing", name)
                         continue
                 if not labeled:
                     continue
@@ -199,7 +210,7 @@ def load_image_archives(
                         if rec is not None:
                             rec["image"] = rec["image"].astype(np.float32)
                             records.append(rec)
-        return ObjectDataset(records, num_shards=max(1, len(archives)))
+        return _finish(records, archives, quarantine)
 
     with ThreadPoolExecutor(max_workers=num_workers) as pool:
         for archive in archives:
@@ -208,7 +219,15 @@ def load_image_archives(
                 batch = list(itertools.islice(entries, chunk))
                 if not batch:
                     break
+                probe("ingest.decode_batch")
                 for rec in pool.map(decode, batch):
                     if rec is not None:
                         records.append(rec)
-    return ObjectDataset(records, num_shards=max(1, len(archives)))
+    return _finish(records, archives, quarantine)
+
+
+def _finish(records, archives, quarantine: QuarantineCounts) -> ObjectDataset:
+    quarantine.publish("load_image_archives")
+    ds = ObjectDataset(records, num_shards=max(1, len(archives)))
+    ds.quarantine = quarantine.as_dict()
+    return ds

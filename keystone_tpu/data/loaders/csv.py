@@ -18,10 +18,66 @@ from ..dataset import ArrayDataset
 
 
 def load_csv(path: str, dtype=np.float32) -> ArrayDataset:
-    """Load one CSV file, a directory of them, or a glob pattern."""
+    """Load one CSV file, a directory of them, or a glob pattern.
+
+    Malformed rows (unparsable fields, wrong column count) are
+    skipped-and-quarantined instead of aborting the load: the fast
+    ``np.loadtxt`` path runs first, and only a file that trips it is
+    re-parsed line-by-line. The returned dataset carries a ``.quarantine``
+    dict with counts, and totals land in the process recovery log. A file
+    with NO parsable rows still raises — an entirely-garbage input is a
+    wrong-path error, not a degraded read.
+    """
+    from ...reliability.recovery import QuarantineCounts
+
     files = _expand(path)
-    parts = [np.loadtxt(f, delimiter=",", dtype=dtype, ndmin=2) for f in files]
-    return ArrayDataset(np.concatenate(parts, axis=0))
+    quarantine = QuarantineCounts()
+    parts = [_load_one(f, dtype, quarantine) for f in files]
+    quarantine.publish("load_csv", source=path)
+    out = ArrayDataset(np.concatenate(parts, axis=0))
+    out.quarantine = quarantine.as_dict()
+    return out
+
+
+def _load_one(path: str, dtype, quarantine) -> np.ndarray:
+    try:
+        return np.loadtxt(path, delimiter=",", dtype=dtype, ndmin=2)
+    except ValueError:
+        return _tolerant_parse(path, dtype, quarantine)
+
+
+def _tolerant_parse(path: str, dtype, quarantine) -> np.ndarray:
+    """Line-by-line fallback parse. The row width is the MAJORITY width of
+    the parsable rows (a truncated first row must not redefine the file's
+    shape and quarantine everything after it); rows that disagree — and
+    rows with unparsable fields — are quarantined."""
+    from collections import Counter
+
+    parsed = []  # (lineno, row)
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            # Skip what np.loadtxt skips (blank lines, '#' comments —
+            # including inline ones): the fallback must not quarantine
+            # lines the fast path accepts.
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            try:
+                parsed.append((lineno, [dtype(v) for v in line.split(",")]))
+            except ValueError:
+                quarantine.add("unparsable_row", f"{path}:{lineno}")
+    if not parsed:
+        raise ValueError(
+            f"{path}: no parsable CSV rows ({quarantine.total} malformed)"
+        )
+    width = Counter(len(row) for _, row in parsed).most_common(1)[0][0]
+    rows = []
+    for lineno, row in parsed:
+        if len(row) == width:
+            rows.append(row)
+        else:
+            quarantine.add("wrong_width", f"{path}:{lineno}")
+    return np.asarray(rows, dtype=dtype)
 
 
 def _expand(path: str):

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ...data.dataset import ArrayDataset, Dataset
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
+from ...reliability import DegradationLadder, halving_rungs, probe
 from ...workflow.pipeline import BatchTransformer, LabelEstimator
 from ..stats.core import _as_array_dataset
 
@@ -131,25 +132,48 @@ class BlockLeastSquaresEstimator(LabelEstimator):
                 and raw.nbytes > _host_streaming_threshold_bytes()
                 and linalg.model_axis_size(mesh) == 1
             )
-        if stream:
-            reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(
-                np.asarray(raw[: min(features.num_examples, 4096)]),
-                features.num_examples,
-            )
-            w, mu_a, mu_b = linalg.block_coordinate_descent_streaming(
-                np.asarray(raw),
-                np.asarray(targets.data, np.float32),
-                reg=reg,
-                num_epochs=self.num_iter,
-                block_size=min(self.block_size, raw.shape[1]),
-                num_examples=features.num_examples,
-                mesh=mesh,
-            )
-            return BlockLinearMapper(
-                w, block_size=min(self.block_size, raw.shape[1]),
-                intercept=mu_b, feature_mean=mu_a,
-            )
 
+        d = raw.shape[1]
+        block0 = min(self.block_size, d)
+        # OOM degradation: a smaller block shrinks the live Gram workspace
+        # and (streaming) per-block device residency; two halvings cover
+        # the realistic headroom gap before the problem itself is too big.
+        ladder = DegradationLadder(
+            halving_rungs(block0, max(block0 // 4, 1)),
+            label="BlockLeastSquaresEstimator.fit",
+        )
+        if stream:
+            model = ladder.run(lambda block: self._fit_streaming(
+                features, targets, mesh, block))
+        else:
+            model = ladder.run(lambda block: self._fit_in_core(
+                features, targets, mesh, block))
+        if ladder.reduced:
+            model.degradation = dict(ladder.record)
+        return model
+
+    def _fit_streaming(self, features, targets, mesh, block) -> BlockLinearMapper:
+        probe("BlockLeastSquaresEstimator.solve")
+        raw = features.data
+        reg = self.reg if self.reg > 0 else _scale_aware_reg_floor(
+            np.asarray(raw[: min(features.num_examples, 4096)]),
+            features.num_examples,
+        )
+        w, mu_a, mu_b = linalg.block_coordinate_descent_streaming(
+            np.asarray(raw),
+            np.asarray(targets.data, np.float32),
+            reg=reg,
+            num_epochs=self.num_iter,
+            block_size=block,
+            num_examples=features.num_examples,
+            mesh=mesh,
+        )
+        return BlockLinearMapper(
+            w, block_size=block, intercept=mu_b, feature_mean=mu_a
+        )
+
+    def _fit_in_core(self, features, targets, mesh, block) -> BlockLinearMapper:
+        probe("BlockLeastSquaresEstimator.solve")
         x = jnp.asarray(features.data, dtype=jnp.float32)
         y = jnp.asarray(targets.data, dtype=jnp.float32)
         n = features.num_examples
@@ -171,7 +195,6 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         # inert: their Gram rows/cols are zero and λ keeps the solve PD).
         # On a 2-D (data, model) mesh each model group needs a whole number
         # of blocks, so pad to model_axis·block columns.
-        block = min(self.block_size, d)
         m = linalg.model_axis_size(mesh)
         d_pad = _round_up(d, block * m)
         if d_pad != d:

@@ -149,13 +149,33 @@ class KernelRidgeRegression(LabelEstimator):
         self.block_permuter = block_permuter
 
     def fit(self, data: Dataset, labels: Dataset) -> "KernelBlockLinearMapper":
+        from ...reliability import DegradationLadder, halving_rungs
+
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
+        n = features.num_examples
+
+        # OOM degradation: the live kernel panel is (n_pad, bs) — halving
+        # the block halves it (and the replicated bs×bs solve) while the
+        # Gauss-Seidel sweep still visits every training row.
+        bs0 = min(self.block_size, n)
+        ladder = DegradationLadder(
+            halving_rungs(bs0, max(bs0 // 4, 1)),
+            label="KernelRidgeRegression.fit",
+        )
+        model = ladder.run(lambda bs: self._fit_with_block(features, targets, bs))
+        if ladder.reduced:
+            model.degradation = dict(ladder.record)
+        return model
+
+    def _fit_with_block(self, features, targets, bs) -> "KernelBlockLinearMapper":
+        from ...reliability import probe
+
+        probe("KernelRidgeRegression.solve")
         mesh = get_mesh()
         n = features.num_examples
         gamma = self.kernel_generator.gamma
 
-        bs = min(self.block_size, n)
         ndev = row_shard_count(mesh)
         # pad rows to lcm-ish: multiple of both block size and shard count
         n_pad = _round_up_multiple(n, bs, ndev)

@@ -95,7 +95,43 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
 
     # default implementation when node-level optimization never ran
     def fit(self, data: Dataset, labels: Dataset) -> Transformer:
-        return self._default().fit(data, labels)
+        from ...reliability import DegradationLadder, probe
+
+        # Solver-grade degradation (the Panther mindset, PAPERS.md): when
+        # the preferred solver OOMs, fall through to the block solver —
+        # whose own internal ladder then shrinks its block size — rather
+        # than aborting the run. Non-OOM failures propagate from rung 1.
+        ladder = DegradationLadder(
+            [
+                ("dense_lbfgs", self._default),
+                (
+                    "block",
+                    lambda: BlockLeastSquaresEstimator(
+                        self.block_size, num_iter=self.block_iters, reg=self.reg
+                    ),
+                ),
+            ],
+            label="LeastSquaresEstimator.fit",
+        )
+
+        def attempt(rung):
+            _, factory = rung
+            probe("LeastSquaresEstimator.solve")
+            return factory().fit(data, labels)
+
+        model = ladder.run(attempt)
+        if ladder.reduced:
+            record = dict(
+                ladder.record, rung=ladder.record["rung"][0],
+                first_rung=ladder.record["first_rung"][0],
+            )
+            # The fallback solver may have degraded internally too (block
+            # halving in block.py) — nest its record, don't clobber it.
+            inner = getattr(model, "degradation", None)
+            if inner is not None:
+                record["inner"] = inner
+            model.degradation = record
+        return model
 
     def _default(self) -> LabelEstimator:
         return DenseLBFGSEstimator(reg=self.reg)

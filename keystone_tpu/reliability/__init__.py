@@ -1,0 +1,65 @@
+"""Fault-tolerant execution layer.
+
+KeystoneML's fault tolerance was Spark's: RDD lineage recomputed lost
+partitions, task retries absorbed flaky executors, and nobody had to name
+a failure mode. The TPU-native executor has no lineage, so this package
+makes failure handling explicit and test-injectable:
+
+- :mod:`errors`      — the failure taxonomy (`classify_error`).
+- :mod:`retry`       — `RetryPolicy` (classified retries, deterministic
+                       backoff), `Deadline` / `run_with_deadline` /
+                       `wait_until` watchdogs.
+- :mod:`degrade`     — `DegradationLadder`: shrink block/batch sizes on
+                       OOM, annotate results with what was given up.
+- :mod:`checkpoint`  — persist fitted prefix state; a killed run resumes
+                       past already-fit estimators in a fresh process.
+- :mod:`faultinject` — deterministic fault injection for tests.
+- :mod:`recovery`    — the process-wide ledger of how a run survived.
+
+Everything here is stdlib-only at import time (no jax) so bench.py and
+launch scripts can import it before any backend initializes.
+
+See docs/RELIABILITY.md for semantics and examples.
+"""
+
+from .checkpoint import CheckpointStore, enable_checkpointing, prefix_digest
+from .degrade import DegradationLadder, LadderExhausted, halving_rungs
+from .errors import (
+    CLASSIFICATION_TABLE,
+    CorruptRecordError,
+    DeadlineExceeded,
+    ErrorClass,
+    classify_error,
+    is_oom,
+)
+from .faultinject import FaultInjector, FaultSpec, InjectedOOM, InjectedTransient, injected, probe
+from .recovery import RecoveryLog, get_recovery_log, reset_recovery_log
+from .retry import Deadline, RetryPolicy, run_with_deadline, wait_until
+
+__all__ = [
+    "CLASSIFICATION_TABLE",
+    "CheckpointStore",
+    "CorruptRecordError",
+    "Deadline",
+    "DeadlineExceeded",
+    "DegradationLadder",
+    "ErrorClass",
+    "FaultInjector",
+    "FaultSpec",
+    "InjectedOOM",
+    "InjectedTransient",
+    "LadderExhausted",
+    "RecoveryLog",
+    "RetryPolicy",
+    "classify_error",
+    "enable_checkpointing",
+    "get_recovery_log",
+    "halving_rungs",
+    "injected",
+    "is_oom",
+    "prefix_digest",
+    "probe",
+    "reset_recovery_log",
+    "run_with_deadline",
+    "wait_until",
+]

@@ -1,0 +1,201 @@
+"""Checkpoint / restore of fitted pipeline state.
+
+The reference got resumability from Spark lineage: a killed job re-ran,
+and already-materialized RDD blocks short-circuited recomputation. Here
+the equivalent unit is the ``PipelineEnv`` prefix table — fitted estimator
+outputs keyed by the structural prefix of everything that produced them.
+This module persists those fitted transformers to disk so a killed run,
+restarted in a FRESH process, resumes past already-fit prefixes instead of
+refitting them.
+
+The in-memory table keys on :class:`~keystone_tpu.workflow.prefix.Prefix`,
+whose operators hash by object identity — useless across processes. The
+on-disk key is a *stable digest* of the same tree: operator class identity
+plus content-hashed state (ndarray bytes, dataset payloads, scalar config).
+Two structurally identical pipelines built in different processes over
+equal data produce equal digests; any attribute change (different reg,
+different training data) changes the digest and forces a refit.
+
+Values are pickled fitted transformers (the same contract as
+``FittedPipeline.save``). Writes are atomic (tmp + rename) so a kill
+mid-checkpoint never leaves a truncated entry — a torn file is treated as
+a miss and refit.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Callable, Dict, Optional
+
+from .recovery import get_recovery_log
+
+_MISS = object()
+
+
+# ------------------------------------------------------------ stable digests
+
+
+def _value_token(value: Any) -> Any:
+    """Deterministic, process-independent token for an operator attribute."""
+    if value is None or isinstance(value, (bool, int, str)):
+        return ("s", repr(value))
+    if isinstance(value, float):
+        return ("f", value.hex())
+    if isinstance(value, bytes):
+        return ("b", hashlib.sha1(value).hexdigest())
+    if isinstance(value, (list, tuple)):
+        return ("t", tuple(_value_token(v) for v in value))
+    if isinstance(value, (set, frozenset)):
+        # Explicit sorted branch: set iteration order follows per-process
+        # PYTHONHASHSEED, so letting sets reach the pickle fallback would
+        # silently defeat cross-process resume.
+        return ("set", tuple(sorted(repr(_value_token(v)) for v in value)))
+    if isinstance(value, dict):
+        return (
+            "d",
+            tuple(sorted((repr(k), _value_token(v)) for k, v in value.items())),
+        )
+    if callable(value) and hasattr(value, "__qualname__"):
+        return ("fn", getattr(value, "__module__", ""), value.__qualname__)
+    # Array-likes (numpy / jax / anything with shape+dtype): content hash.
+    # sha1 consumes the array's buffer directly — tobytes() would make a
+    # second full copy of a possibly multi-GB training matrix.
+    if hasattr(value, "dtype") and hasattr(value, "shape"):
+        import numpy as np
+
+        arr = np.ascontiguousarray(np.asarray(value))
+        return (
+            "arr",
+            str(arr.dtype),
+            tuple(arr.shape),
+            hashlib.sha1(arr).hexdigest(),
+        )
+    # Datasets: payload token + logical length.
+    data = getattr(value, "data", None)
+    if data is not None and hasattr(value, "num_examples"):
+        return ("ds", _value_token(data), int(value.num_examples))
+    if hasattr(value, "items") and hasattr(value, "collect"):
+        try:
+            return ("ods", tuple(_value_token(v) for v in value.collect()))
+        except Exception:
+            pass
+    try:
+        return ("pkl", hashlib.sha1(pickle.dumps(value)).hexdigest())
+    except Exception:
+        # Last resort: type identity only. Weaker than content hashing but
+        # still process-stable; collisions across *differently configured*
+        # operators of the same class are possible only when every other
+        # attribute also matches.
+        return ("type", type(value).__module__, type(value).__qualname__)
+
+
+def _op_token(op: Any) -> Any:
+    attrs = tuple(
+        sorted(
+            (name, _value_token(v))
+            for name, v in vars(op).items()
+            if not name.startswith("_")
+        )
+    )
+    return ("op", type(op).__module__, type(op).__qualname__, attrs)
+
+
+def prefix_digest(prefix: Any) -> str:
+    """Stable hex digest of a :class:`Prefix`'s operator tree."""
+
+    def walk(tree):
+        op, children = tree
+        return (_op_token(op), tuple(walk(c) for c in children))
+
+    token = walk(prefix.tree)
+    return hashlib.sha1(repr(token).encode()).hexdigest()
+
+
+# ------------------------------------------------------------------- store
+
+
+class CheckpointStore:
+    """Directory of ``<digest>.pkl`` fitted-state entries with hit/miss
+    accounting. Lookups tolerate torn/unreadable entries (treated as
+    misses); writes are atomic."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.hits = 0
+        self.misses = 0
+        self.writes = 0
+
+    def _entry(self, digest: str) -> str:
+        return os.path.join(self.path, f"{digest}.pkl")
+
+    def lookup(self, prefix: Any, digest: Optional[str] = None) -> Any:
+        """Stored value for ``prefix``, or the module ``_MISS`` sentinel.
+        Pass ``digest`` when already computed — digesting walks the prefix
+        tree and content-hashes its datasets, which is not free."""
+        entry = self._entry(digest or prefix_digest(prefix))
+        if not os.path.exists(entry):
+            self.misses += 1
+            return _MISS
+        try:
+            with open(entry, "rb") as f:
+                value = pickle.load(f)
+        except Exception:
+            self.misses += 1
+            return _MISS
+        self.hits += 1
+        return value
+
+    def save(self, prefix: Any, value: Any, digest: Optional[str] = None) -> bool:
+        """Persist ``value`` under ``prefix``; returns False (and leaves no
+        entry) when the value isn't picklable — unpicklable fits simply
+        don't resume."""
+        digest = digest or prefix_digest(prefix)
+        try:
+            blob = pickle.dumps(value)
+        except Exception:
+            return False
+        fd, tmp = tempfile.mkstemp(dir=self.path, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self._entry(digest))
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        self.writes += 1
+        return True
+
+    def get_or_compute(
+        self, prefix: Any, thunk: Callable[[], Any], label: str = "node"
+    ) -> Any:
+        digest = prefix_digest(prefix)  # once per force: lookup + save share it
+        value = self.lookup(prefix, digest=digest)
+        if value is not _MISS:
+            get_recovery_log().record("checkpoint_hit", label, digest=digest[:12])
+            return value
+        value = thunk()
+        self.save(prefix, value, digest=digest)
+        return value
+
+    def stats(self) -> Dict[str, int]:
+        return {"hits": self.hits, "misses": self.misses, "writes": self.writes}
+
+
+def enable_checkpointing(path: str, env: Optional[Any] = None) -> CheckpointStore:
+    """Attach a :class:`CheckpointStore` at ``path`` to the process
+    ``PipelineEnv`` (or a given env). Subsequent estimator fits write
+    through; fits whose prefix digest is already on disk are restored
+    without refitting."""
+    from ..workflow.executor import PipelineEnv
+
+    env = env or PipelineEnv.get_or_create()
+    store = CheckpointStore(path)
+    env.checkpoint = store
+    return store

@@ -9,7 +9,9 @@ forcing a ``DatasetExpression``'s ``get`` is what actually runs XLA
 computations, exactly as forcing an RDD ran Spark jobs in the reference.
 
 ``PipelineEnv`` holds the prefix-state table used for cross-pipeline reuse
-of fit estimators and cached datasets, plus the active optimizer stack.
+of fit estimators and cached datasets, plus the active optimizer stack and
+the reliability hooks (retry policy, checkpoint store) the executor
+consults per node — see keystone_tpu/reliability/ and docs/RELIABILITY.md.
 """
 
 from __future__ import annotations
@@ -17,8 +19,10 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from ..reliability import faultinject
+from ..reliability.recovery import reset_recovery_log
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
-from .operators import Expression
+from .operators import EstimatorOperator, Expression
 from .prefix import Prefix, find_prefix
 from .tracing import timed_execute
 
@@ -32,6 +36,13 @@ class PipelineEnv:
     def __init__(self):
         self.state: Dict[Prefix, Expression] = {}
         self._optimizer = None
+        # Reliability hooks — both default OFF (zero per-node overhead).
+        # retry_policy: a reliability.RetryPolicy applied to every node
+        # forcing (transient faults retried, per-node deadline enforced).
+        # checkpoint: a reliability.CheckpointStore; estimator fits write
+        # through and digest-matching fits restore instead of refitting.
+        self.retry_policy = None
+        self.checkpoint = None
 
     @classmethod
     def get_or_create(cls) -> "PipelineEnv":
@@ -43,9 +54,11 @@ class PipelineEnv:
     @classmethod
     def reset(cls) -> None:
         """Drop all global state — required between tests
-        (reference: test fixture PipelineContext.scala:9-25)."""
+        (reference: test fixture PipelineContext.scala:9-25). Clears the
+        recovery ledger too: it is per-run state like the prefix table."""
         with cls._lock:
             cls._instance = None
+        reset_recovery_log()
 
     @property
     def optimizer(self):
@@ -102,11 +115,68 @@ class GraphExecutor:
         op = graph.get_operator(graph_id)
         expression = timed_execute(op, deps)
 
+        prefix = self._prefixes.get(graph_id)
+        expression = _wrap_reliability(op, deps, expression, prefix)
+
         # Prefix write-back: make this node's result reusable by later
         # pipelines (reference: GraphExecutor.scala:65-71).
-        prefix = self._prefixes.get(graph_id)
         if prefix is not None:
             PipelineEnv.get_or_create().state[prefix] = expression
 
         self._memo[graph_id] = expression
         return expression
+
+
+def _wrap_reliability(
+    op, deps, expression: Expression, prefix: Optional[Prefix]
+) -> Expression:
+    """Layer the reliability hooks around a node's lazy result.
+
+    Expressions are call-by-name memoized and a failing thunk leaves the
+    memo unset, so re-forcing after a failure genuinely re-executes — which
+    is what makes wrapping the *expression* (not the eager execute call)
+    the right retry boundary: the heavy work happens at force time.
+
+    Wrapping order, innermost out:
+      1. fault injection — stands in for the op itself failing;
+      2. checkpoint — a digest hit skips the op (and any injected faults:
+         restored work is not re-executed, same as lineage recovery);
+      3. retry + per-node deadline — sees injected and real faults alike.
+    All three default off; with none active the original expression is
+    returned untouched.
+
+    Each attempt executes the op FRESH (``op.execute`` is cheap — it only
+    builds lazy thunks; deps stay memoized) rather than re-entering the
+    shared Expression: after a deadline abandonment the watchdog thread
+    may still be inside the old expression's unsynchronized ``get``, and a
+    retry re-entering it would race on its memo. The wrapper expression
+    below memoizes the one successful result for all downstream readers.
+    """
+    env = PipelineEnv.get_or_create()
+    injector = faultinject.current()
+    policy = env.retry_policy
+    store = env.checkpoint
+    checkpointable = (
+        store is not None and prefix is not None and isinstance(op, EstimatorOperator)
+    )
+    if injector is None and policy is None and not checkpointable:
+        return expression
+
+    label = str(getattr(op, "label", type(op).__name__))
+    first = expression
+
+    def thunk(_first=[first]):
+        # First attempt consumes the already-built expression; retries get
+        # a fresh one (see docstring).
+        inner = _first.pop() if _first else timed_execute(op, deps)
+        return inner.get()
+
+    if injector is not None:
+        thunk = injector.wrap(label, thunk)
+    if checkpointable:
+        inner_thunk = thunk
+        thunk = lambda: store.get_or_compute(prefix, inner_thunk, label=label)  # noqa: E731
+    if policy is not None:
+        attempt = thunk
+        thunk = lambda: policy.call(attempt, label=label)  # noqa: E731
+    return type(expression)(thunk)

@@ -14,6 +14,21 @@ The executable sanity check of the multi-host launch path
   5. checks the result against the closed form and prints
      ``REHEARSAL_OK rel_err=...``.
 
+Fallback (CPU rehearsal only): jax's CPU backend refuses multi-process
+computations ("Multiprocess computations aren't implemented on the CPU
+backend"), so when the psum path raises exactly that, the cross-process
+sum is rehearsed through the coordination service instead — each process
+publishes its local partial Gram to the distributed KV store and reduces
+everyone's partials, deadline-bounded by the reliability helpers. The
+collective still crosses process boundaries (through the coordinator
+rather than ICI), so the launch path, mesh, and data layout stay
+exercised code on every backend. On TPU the psum path runs as-is.
+
+Coordinator joins and KV waits use keystone_tpu.reliability
+(RetryPolicy / Deadline) — the same classified-retry machinery the
+executor uses — so a slow-starting peer process deflakes instead of
+failing the rehearsal.
+
 On a TPU pod slice (one process per host, auto-detected coordination):
     python scripts/multihost_rehearsal.py
 
@@ -54,8 +69,14 @@ def main() -> int:
             ).strip()
 
     from keystone_tpu.parallel.mesh import distributed_init, make_mesh
+    from keystone_tpu.reliability import RetryPolicy
 
-    distributed_init(args.coordinator, args.num_hosts, args.host_id)
+    # Coordinator join: classified retry — a peer process that hasn't
+    # bound its port yet surfaces as a transient connect/barrier error.
+    RetryPolicy(max_attempts=3, base_delay_s=1.0, max_delay_s=5.0).call(
+        distributed_init, args.coordinator, args.num_hosts, args.host_id,
+        label="distributed_init",
+    )
 
     import jax
     import jax.numpy as jnp  # noqa: F401  (backend init ordering)
@@ -84,13 +105,64 @@ def main() -> int:
     sharding = NamedSharding(mesh, P("data", None))
     x = jax.make_array_from_callback((n, d), sharding, lambda idx: full[idx])
 
-    ata, _ = linalg.gram(x, mesh=mesh)  # shard_map + psum across processes
-    got = np.asarray(ata.addressable_data(0), np.float64)
+    try:
+        ata, _ = linalg.gram(x, mesh=mesh)  # shard_map + psum across processes
+        got = np.asarray(ata.addressable_data(0), np.float64)
+        mode = "psum"
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" not in str(e):
+            raise
+        # CPU backend: rehearse the cross-process reduction through the
+        # coordination service instead (see module docstring).
+        got = _kv_allreduce_gram(x, d)
+        mode = "kv-allreduce"
+
     want = full.T.astype(np.float64) @ full
     rel = float(np.linalg.norm(got - want) / np.linalg.norm(want))
     assert rel < 1e-5, f"cross-process gram wrong: rel_err={rel:.3e}"
-    print(f"REHEARSAL_OK rel_err={rel:.2e}", flush=True)
+    print(f"REHEARSAL_OK rel_err={rel:.2e} mode={mode}", flush=True)
     return 0
+
+
+def _kv_allreduce_gram(x, d: int):
+    """Cross-process Gram allreduce over the distributed KV store: publish
+    the local partial AᵀA, fetch and sum every process's partial. The
+    fetches are deadline-bounded (reliability.Deadline) — a dead peer
+    fails the rehearsal loudly instead of hanging it."""
+    import base64
+
+    import jax
+    import numpy as np
+
+    from jax._src.distributed import global_state
+
+    from keystone_tpu.reliability import Deadline, DeadlineExceeded
+
+    client = global_state.client
+    assert client is not None, "distributed runtime not initialized"
+
+    local = np.zeros((d, d), np.float64)
+    for shard in x.addressable_shards:
+        a = np.asarray(shard.data, np.float64)
+        local += a.T @ a
+
+    pid = jax.process_index()
+    client.key_value_set(
+        f"rehearsal/gram/{pid}", base64.b64encode(local.tobytes()).decode()
+    )
+
+    deadline = Deadline.after(120.0)
+    total = np.zeros_like(local)
+    for p in range(jax.process_count()):
+        left_ms = int(max(deadline.remaining(), 0.001) * 1000)
+        try:
+            blob = client.blocking_key_value_get(f"rehearsal/gram/{p}", left_ms)
+        except Exception as e:
+            raise DeadlineExceeded(
+                f"peer {p}'s gram partial not published in time: {e}"
+            ) from None
+        total += np.frombuffer(base64.b64decode(blob), np.float64).reshape(d, d)
+    return total
 
 
 if __name__ == "__main__":

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Failure-path smoke suite: the fault-injection / recovery tests, runnable
+# standalone (tier-1 runs them as part of tests/; this script is the
+# focused local loop while working on reliability code).
+#
+#   scripts/run_failure_suite.sh            # full failure suite
+#   scripts/run_failure_suite.sh -k retry   # extra pytest args pass through
+#
+# Covers: retry classification + backoff, degradation ladders (incl. the
+# bench rung-sequence pins), checkpoint round-trip + killed-then-resumed
+# subprocess run, fault-injected end-to-end pipeline recovery, ingest
+# quarantine, and the bench OOM-ladder behavior tests.
+
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu python -m pytest \
+    tests/reliability \
+    tests/test_failure_paths.py \
+    -q -p no:cacheprovider "$@"

@@ -1,0 +1,35 @@
+"""Reliability-suite fixtures: fault injection + a clean recovery ledger.
+
+The ``injector`` fixture is the harness ISSUE/docs/RELIABILITY.md promise:
+activate deterministic faults (OOM / transient / hang / corrupt on the
+Nth call of a matched node or probe site) for the remainder of a test,
+with automatic deactivation."""
+
+import contextlib
+
+import pytest
+
+from keystone_tpu.reliability import faultinject
+
+
+@pytest.fixture
+def injector():
+    """Factory fixture: ``injector(FaultSpec(...), ...)`` activates a
+    FaultInjector (returned for call-count assertions) until test end."""
+    with contextlib.ExitStack() as stack:
+
+        def activate(*specs, **kwargs):
+            return stack.enter_context(faultinject.injected(*specs, **kwargs))
+
+        yield activate
+
+
+@pytest.fixture
+def no_sleep_policy():
+    """A RetryPolicy that never really sleeps but records what it would
+    have slept — keeps backoff assertions exact and tests instant."""
+    from keystone_tpu.reliability import RetryPolicy
+
+    slept = []
+    policy = RetryPolicy(max_attempts=3, seed=0, sleep=slept.append)
+    return policy, slept

@@ -1,0 +1,114 @@
+"""DegradationLadder semantics + the exact rung sequences the bench
+ladders walked before the extraction (they must not drift)."""
+
+import pytest
+
+from keystone_tpu.reliability import (
+    DegradationLadder,
+    LadderExhausted,
+    get_recovery_log,
+    halving_rungs,
+)
+
+
+def _oom():
+    raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+
+
+# ---------------------------------------------------------------- sequences
+
+
+def test_halving_rungs_match_bench_timit_exact():
+    # bench timit_exact: start n aligned to ndev, halve with alignment,
+    # last attemptable rung is the first value <= full_n // 16.
+    full_n, ndev = 2_200_000, 8
+    rungs = halving_rungs(full_n - full_n % ndev, full_n // 16, align=ndev)
+    assert rungs[0] == 2_200_000
+    for v in rungs:
+        assert v % ndev == 0
+    assert rungs[-1] <= full_n // 16 < rungs[-2]
+    # exactly the old loop: n = (n // 2) - ((n // 2) % ndev)
+    expect, n = [n0 := full_n - full_n % ndev], n0
+    while n > full_n // 16:
+        n = (n // 2) - ((n // 2) % ndev)
+        expect.append(n)
+    assert rungs == expect
+
+
+def test_halving_rungs_match_bench_cifar_and_wide_block():
+    assert halving_rungs(50_000, 50_000 // 4) == [50_000, 25_000, 12_500]
+    wide = halving_rungs(2_200_000, 8_192)
+    assert wide[0] == 2_200_000 and wide[-1] <= 8_192 < wide[-2]
+    assert halving_rungs(8_192, 8_192) == [8_192]  # small mode: one rung
+
+
+# ----------------------------------------------------------------- behavior
+
+
+def test_ladder_degrades_on_oom_and_annotates():
+    ladder = DegradationLadder([64, 32, 16], label="t")
+    tried = []
+
+    def attempt(b):
+        tried.append(b)
+        if b > 16:
+            _oom()
+        return {"block": b}
+
+    out = ladder.annotate(ladder.run(attempt))
+    assert tried == [64, 32, 16]
+    assert ladder.reduced
+    assert out["extrapolated"] is True
+    assert out["reduced_from"] == 64
+    assert "RESOURCE_EXHAUSTED" in out["reduction_reason"]
+    ev = get_recovery_log().events("degrade")
+    assert len(ev) == 1 and ev[0].detail["rung"] == 16
+
+
+def test_ladder_success_on_first_rung_adds_no_fields():
+    ladder = DegradationLadder([64, 32], label="t")
+    out = ladder.annotate(ladder.run(lambda b: {"block": b}))
+    assert not ladder.reduced
+    assert "extrapolated" not in out and "reduced_from" not in out
+    assert get_recovery_log().events("degrade") == []
+
+
+def test_ladder_reraises_non_oom_immediately():
+    ladder = DegradationLadder([64, 32], label="t")
+    tried = []
+
+    def attempt(b):
+        tried.append(b)
+        raise ValueError("not an OOM")
+
+    with pytest.raises(ValueError):
+        ladder.run(attempt)
+    assert tried == [64]
+
+
+def test_ladder_exhaustion_keeps_last_error():
+    ladder = DegradationLadder([8, 4], label="solver")
+    with pytest.raises(LadderExhausted, match="RESOURCE_EXHAUSTED"):
+        ladder.run(lambda b: _oom())
+    assert isinstance(LadderExhausted("x"), RuntimeError)  # bench contract
+
+
+def test_ladder_on_degrade_hook_and_last_error():
+    seen = []
+    ladder = DegradationLadder(
+        [2, 1], label="t", on_degrade=lambda rung, err: seen.append((rung, err))
+    )
+
+    def attempt(b):
+        if b == 2:
+            _oom()
+        assert "RESOURCE_EXHAUSTED" in ladder.last_error  # visible mid-run
+        return b
+
+    assert ladder.run(attempt) == 1
+    assert seen == [(2, "RuntimeError: RESOURCE_EXHAUSTED: fake OOM")]
+
+
+def test_ladder_rejects_empty_rungs():
+    with pytest.raises(ValueError, match="empty rung"):
+        DegradationLadder([], label="t")

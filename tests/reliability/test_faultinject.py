@@ -1,0 +1,73 @@
+"""Fault injector mechanics: spec matching, call counting, fault kinds."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.reliability import (
+    FaultSpec,
+    InjectedOOM,
+    InjectedTransient,
+    classify_error,
+    ErrorClass,
+    injected,
+    probe,
+)
+from keystone_tpu.reliability import faultinject
+
+
+def test_injected_errors_classify_correctly():
+    assert classify_error(InjectedOOM("x")) is ErrorClass.OOM
+    assert classify_error(InjectedTransient("x")) is ErrorClass.TRANSIENT
+
+
+def test_probe_is_noop_without_injector():
+    assert faultinject.current() is None
+    probe("anything")  # must not raise
+
+
+def test_oom_on_exact_calls(injector):
+    inj = injector(FaultSpec(match="site", kind="oom", calls=(2,)))
+    probe("site")  # call 1: clean
+    with pytest.raises(InjectedOOM):
+        probe("site")  # call 2: faulted
+    probe("site")  # call 3: clean again
+    assert inj.calls("site") == 3
+
+
+def test_first_n_prefix_faulting(injector):
+    injector(FaultSpec(match="s", kind="transient", first_n=2))
+    for _ in range(2):
+        with pytest.raises(InjectedTransient):
+            probe("s")
+    probe("s")  # third call clean
+
+
+def test_match_is_substring_and_star(injector):
+    injector(FaultSpec(match="Solver", kind="oom", calls=(1,)))
+    probe("unrelated-site")  # no match, no fault
+    with pytest.raises(InjectedOOM):
+        probe("BlockSolver.fit")
+
+
+def test_hang_uses_injector_sleep(injector):
+    slept = []
+    injector(FaultSpec(match="h", kind="hang", hang_s=9.0, calls=(1,)),
+             sleep=slept.append)
+    probe("h")  # hangs (recorded, not real)
+    assert slept == [9.0]
+
+
+def test_corrupt_nan_fills_wrapped_value(injector):
+    inj = injector(FaultSpec(match="node", kind="corrupt", calls=(1,)))
+    wrapped = inj.wrap("node", lambda: np.ones((2, 2), np.float32))
+    out = wrapped()
+    assert np.isnan(np.asarray(out)).all()
+    # next call returns clean data
+    assert np.asarray(wrapped()).sum() == 4.0
+
+
+def test_no_nested_injectors():
+    with injected(FaultSpec(match="a")):
+        with pytest.raises(RuntimeError, match="already active"):
+            with injected(FaultSpec(match="b")):
+                pass

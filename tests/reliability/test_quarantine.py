@@ -1,0 +1,135 @@
+"""Ingest graceful degradation: corrupt records are skipped-and-quarantined
+with counts surfaced, instead of aborting the load."""
+
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from keystone_tpu.reliability import get_recovery_log
+
+
+def _make_tar(path, entries):
+    with tarfile.open(path, "w") as tar:
+        for name, payload in entries:
+            info = tarfile.TarInfo(name=name)
+            info.size = len(payload)
+            tar.addfile(info, io.BytesIO(payload))
+
+
+def _jpeg_bytes(seed=0, size=24):
+    from PIL import Image
+
+    rng = np.random.default_rng(seed)
+    buf = io.BytesIO()
+    Image.fromarray(
+        rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+    ).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def test_archive_loader_quarantines_corrupt_and_unlabeled(tmp_path):
+    from keystone_tpu.data.loaders.archive import load_image_archives
+
+    tar = str(tmp_path / "data.tar")
+    _make_tar(tar, [
+        ("cls0/good_a.jpg", _jpeg_bytes(0)),
+        ("cls0/corrupt.jpg", b"\xff\xd8 this is not a real jpeg"),
+        ("cls1/good_b.jpg", _jpeg_bytes(1)),
+        ("unknown/no_label.jpg", _jpeg_bytes(2)),
+    ])
+
+    def label_fn(name):
+        return {"cls0": 0, "cls1": 1}[name.split("/")[0]]  # KeyError on unknown
+
+    ds = load_image_archives(tar, label_fn, use_native=False)
+    assert len(ds) == 2  # both good records survived
+    assert ds.quarantine["decode_failed"] == 1
+    assert ds.quarantine["label_missing"] == 1
+    assert ds.quarantine["quarantined"] == 2
+    assert any("corrupt" in e or "no_label" in e for e in ds.quarantine["examples"])
+    assert get_recovery_log().summary()["quarantined_records"] == 2
+
+
+def test_archive_loader_clean_tar_reports_zero(tmp_path):
+    from keystone_tpu.data.loaders.archive import load_image_archives
+
+    tar = str(tmp_path / "clean.tar")
+    _make_tar(tar, [("c/a.jpg", _jpeg_bytes(0))])
+    ds = load_image_archives(tar, lambda name: 0, use_native=False)
+    assert len(ds) == 1 and ds.quarantine["quarantined"] == 0
+    assert get_recovery_log().summary()["quarantined_records"] == 0
+
+
+def test_csv_loader_quarantines_malformed_rows(tmp_path):
+    from keystone_tpu.data.loaders.csv import load_csv
+
+    p = str(tmp_path / "rows.csv")
+    with open(p, "w") as f:
+        f.write("1,2,3\n4,notanumber,6\n7,8,9\n1,2\n10,11,12\n")
+    ds = load_csv(p)
+    np.testing.assert_allclose(
+        np.asarray(ds.data), [[1, 2, 3], [7, 8, 9], [10, 11, 12]]
+    )
+    assert ds.quarantine["quarantined"] == 2
+    assert len(ds.quarantine["examples"]) == 2
+    assert get_recovery_log().summary()["quarantined_records"] == 2
+
+
+def test_csv_loader_truncated_first_row_does_not_redefine_width(tmp_path):
+    # The majority width wins: a truncated FIRST row is the quarantined
+    # one, not every good row after it.
+    from keystone_tpu.data.loaders.csv import load_csv
+
+    p = str(tmp_path / "truncated_head.csv")
+    with open(p, "w") as f:
+        f.write("1,2\n" + "".join(f"{i},{i},{i}\n" for i in range(10)))
+    ds = load_csv(p)
+    assert np.asarray(ds.data).shape == (10, 3)
+    assert ds.quarantine["quarantined"] == 1
+    assert ds.quarantine["wrong_width"] == 1
+
+
+def test_csv_loader_fallback_skips_comments_like_loadtxt(tmp_path):
+    # '#' lines are loadtxt-skippable, so the tolerant fallback must not
+    # count them as quarantined just because another row was bad.
+    from keystone_tpu.data.loaders.csv import load_csv
+
+    p = str(tmp_path / "commented.csv")
+    with open(p, "w") as f:
+        f.write("# header comment\n1,2,3\nbad,row,here\n4,5,6\n")
+    ds = load_csv(p)
+    assert np.asarray(ds.data).shape == (2, 3)
+    assert ds.quarantine["quarantined"] == 1  # only the bad row
+
+
+def test_csv_loader_all_garbage_still_raises(tmp_path):
+    from keystone_tpu.data.loaders.csv import load_csv
+
+    p = str(tmp_path / "garbage.csv")
+    with open(p, "w") as f:
+        f.write("not,a\nnumber,anywhere\n")
+    with pytest.raises(ValueError, match="no parsable"):
+        load_csv(p)
+
+
+def test_measure_ingest_counts_corrupt_entries(tmp_path):
+    from keystone_tpu import native
+    from keystone_tpu.data.ingest import build_jpeg_tar_fixture, measure_ingest
+
+    if native.load() is None:
+        pytest.skip("native lib not built")
+    fix = str(tmp_path / "fix.tar")
+    build_jpeg_tar_fixture(fix, 6, size=48)
+    # append a corrupt member
+    with tarfile.open(fix, "a") as tar:
+        payload = b"not a jpeg at all"
+        info = tarfile.TarInfo(name="synset0000/corrupt.JPEG")
+        info.size = len(payload)
+        tar.addfile(info, io.BytesIO(payload))
+    out = measure_ingest(fix, resize=(48, 48), batch=4)
+    assert out["images"] == 6
+    assert out["corrupt_skipped"] == 1
+    assert get_recovery_log().summary()["quarantined_records"] == 1

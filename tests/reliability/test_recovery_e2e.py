@@ -1,0 +1,123 @@
+"""End-to-end recovery (ISSUE acceptance criterion): a pipeline run with an
+injected OOM (first N solver calls) AND an injected transient fault
+completes successfully and reports retry/degradation metadata."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.reliability import (
+    FaultSpec,
+    RetryPolicy,
+    enable_checkpointing,
+    get_recovery_log,
+)
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.pipeline import Transformer
+
+
+class _Center(Transformer):
+    """A stand-in featurize stage with a distinctive label to target."""
+
+    def __init__(self, shift):
+        self.shift = shift
+
+    def apply(self, datum):
+        return datum - self.shift
+
+    def apply_batch(self, ds):
+        return ArrayDataset(np.asarray(ds.data) - self.shift, ds.num_examples)
+
+
+def _problem(n=64, d=16, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, k)).astype(np.float32)
+    return x, x @ w
+
+
+def test_pipeline_completes_through_oom_and_transient(injector):
+    x, y = _problem()
+    env = PipelineEnv.get_or_create()
+    env.retry_policy = RetryPolicy(max_attempts=3, sleep=lambda s: None)
+
+    injector(
+        # OOM on the first solver attempt → the estimator's internal
+        # DegradationLadder halves the block and retries.
+        FaultSpec(match="BlockLeastSquaresEstimator.solve", kind="oom", first_n=1),
+        # One transient fault on the featurize node → executor-level retry.
+        FaultSpec(match="_Center", kind="transient", calls=(1,)),
+    )
+
+    pipe = _Center(0.5).to_pipeline().then_label_estimator(
+        BlockLeastSquaresEstimator(block_size=8, reg=1e-3),
+        ArrayDataset(x), ArrayDataset(y),
+    )
+    out = np.asarray(pipe.apply(ArrayDataset(x)).get().data)
+
+    assert out.shape == y.shape and np.isfinite(out).all()
+    summary = get_recovery_log().summary()
+    # Retry metadata: the transient fault was retried at least once.
+    assert summary["retries"] >= 1, summary
+    # Degradation metadata: the solver gave up one block-size rung.
+    assert summary["degradations"] == 1, summary
+    degrade = get_recovery_log().events("degrade")[0]
+    assert degrade.detail["first_rung"] == 8 and degrade.detail["rung"] == 4
+    assert "RESOURCE_EXHAUSTED" in degrade.detail["reason"]
+
+
+def test_recovered_run_matches_clean_run_with_checkpoint(tmp_path, injector):
+    """The full story in one test: a faulted run completes AND its
+    checkpointed fits are reused by a later clean run (no refit), with
+    identical outputs."""
+    x, y = _problem()
+
+    def build():
+        return _Center(0.5).to_pipeline().then_label_estimator(
+            BlockLeastSquaresEstimator(block_size=8, reg=1e-3),
+            ArrayDataset(x), ArrayDataset(y),
+        )
+
+    env = PipelineEnv.get_or_create()
+    env.retry_policy = RetryPolicy(max_attempts=3, sleep=lambda s: None)
+    store = enable_checkpointing(str(tmp_path / "ck"))
+    injector(
+        FaultSpec(match="BlockLeastSquaresEstimator.solve", kind="oom", first_n=1),
+    )
+    out_faulted = np.asarray(build().apply(ArrayDataset(x)).get().data)
+    assert store.writes >= 1
+
+    PipelineEnv.reset()
+    store2 = enable_checkpointing(str(tmp_path / "ck"))
+    out_resumed = np.asarray(build().apply(ArrayDataset(x)).get().data)
+    assert store2.hits >= 1  # fit restored, not recomputed
+    np.testing.assert_allclose(out_faulted, out_resumed)
+
+
+def test_meta_solver_fallback_nests_inner_degradation(injector):
+    """When the meta-solver falls to the block solver AND the block solver
+    itself halves its block on OOM, both reductions must survive in the
+    model's degradation record (outer solver switch + nested block rung)."""
+    from keystone_tpu.data.dataset import ArrayDataset as ADS
+    from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+
+    x, y = _problem()
+    injector(
+        FaultSpec(match="LeastSquaresEstimator.solve", kind="oom", first_n=1),
+        FaultSpec(match="BlockLeastSquaresEstimator.solve", kind="oom", first_n=1),
+    )
+    model = LeastSquaresEstimator(reg=1e-3, block_size=8).fit(ADS(x), ADS(y))
+    record = model.degradation
+    assert record["first_rung"] == "dense_lbfgs" and record["rung"] == "block"
+    assert record["inner"]["first_rung"] == 8 and record["inner"]["rung"] == 4
+
+
+def test_corrupt_node_output_is_caught_by_consumer(injector):
+    """Corrupt-data injection: NaN-poisoned node output flows to the
+    consumer, which is exactly what a validation layer must catch — the
+    harness makes that failure mode constructible on demand."""
+    x, _ = _problem()
+    injector(FaultSpec(match="_Center", kind="corrupt", calls=(1,)))
+    out = _Center(0.0).to_pipeline().apply(ArrayDataset(x)).get()
+    assert np.isnan(np.asarray(out.data)).all()

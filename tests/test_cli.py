@@ -84,7 +84,11 @@ def test_packaging_console_entry_point_resolves():
     reference analog: build.sbt:1-45 published artifact)."""
     import importlib
     import os
-    import tomllib
+
+    try:
+        import tomllib  # 3.11+ stdlib
+    except ModuleNotFoundError:  # 3.10: same API under the backport name
+        import tomli as tomllib
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(repo, "pyproject.toml"), "rb") as f:

@@ -48,6 +48,27 @@ def test_imagenet_bench_ladder_reduces_on_oom(monkeypatch):
     assert len(calls) == 5  # walked every >64 rung before succeeding
 
 
+def test_imagenet_bench_deadline_abort_not_swallowed_as_oom(monkeypatch):
+    """The per-rung deadline gate quotes the PRIOR rung's error, which may
+    contain RESOURCE_EXHAUSTED — the abort must still propagate (typed
+    DeadlineExceeded), not be misread as an OOM and walked through every
+    remaining rung."""
+    import bench
+
+    calls = []
+
+    def fake_at(n_img, size, num_classes, small):
+        calls.append((n_img, size))
+        raise RuntimeError("RESOURCE_EXHAUSTED: fake OOM")
+
+    monkeypatch.setattr(bench, "_imagenet_fv_at", fake_at)
+    gates = iter([False, True])  # rung 1 runs, rung 2 hits the deadline
+    monkeypatch.setattr(bench, "_deadline_within", lambda margin: next(gates))
+    with pytest.raises(bench.DeadlineExceeded, match="RESOURCE_EXHAUSTED"):
+        bench._bench_imagenet_fv(small=False)
+    assert calls == [(32, 256)]  # no phantom rungs after the abort
+
+
 def test_imagenet_bench_ladder_reraises_non_oom(monkeypatch):
     import bench
 
