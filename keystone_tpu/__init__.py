@@ -24,6 +24,9 @@ _EXPORTS = {
     "FittedPipeline": "keystone_tpu.workflow",
     "Identity": "keystone_tpu.workflow",
     "PipelineEnv": "keystone_tpu.workflow",
+    "ModelRegistry": "keystone_tpu.serving",
+    "PipelineServer": "keystone_tpu.serving",
+    "ServingConfig": "keystone_tpu.serving",
 }
 
 __all__ = sorted(_EXPORTS) + ["__version__"]

@@ -214,6 +214,17 @@ def main(argv: Optional[list] = None) -> int:
             resolved[name] = (config_cls, run_fn)
             add_config_arguments(sp, config_cls)
 
+    # The online serving front-end (docs/SERVING.md): JSON requests on
+    # stdin, responses on stdout. Flag wiring is plain argparse from the
+    # serving package (stdlib-only import — help stays jax-free).
+    from .serving.server import add_serve_arguments
+
+    serve_parser = sub.add_parser(
+        "serve",
+        help="serve a fitted pipeline: micro-batched inference over stdin/JSON",
+    )
+    add_serve_arguments(serve_parser)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -223,6 +234,7 @@ def main(argv: Optional[list] = None) -> int:
     if args.list or not args.workload:
         for name, entry in sorted(WORKLOADS.items()):
             print(f"{name:28s} {entry[-1]}")
+        print(f"{'serve':28s} online serving front-end (micro-batched, stdin/JSON)")
         return 0
 
     # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;
@@ -234,6 +246,11 @@ def main(argv: Optional[list] = None) -> int:
         from .parallel.mesh import distributed_init
 
         distributed_init()
+
+    if args.workload == "serve":
+        from .serving.server import serve_from_args
+
+        return serve_from_args(args)
 
     # Warm repeat runs: compiled XLA programs persist across processes
     # (KEYSTONE_COMPILATION_CACHE=off to disable). Enabled only on the

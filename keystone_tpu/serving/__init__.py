@@ -1,0 +1,87 @@
+"""Online serving layer: micro-batched, AOT-warmed inference for fitted
+pipelines.
+
+KeystoneML pipelines are fit once and applied per-datum; this package is
+the per-datum path at production traffic. It composes the repo's existing
+investments into one subsystem:
+
+- :mod:`registry`   — versioned models, atomic hot-swap, loading from
+                      ``FittedPipeline.save`` artifacts AND reliability
+                      checkpoints (structural-digest keyed).
+- :mod:`batcher`    — bounded queue + deadline-aware micro-batch assembly
+                      (max-batch / max-wait), shape-bucket padding so the
+                      apply path reuses pre-lowered AOT executables.
+- :mod:`admission`  — queue-depth backpressure; a DegradationLadder-driven
+                      shed policy degrades service level under sustained
+                      overload and then refuses loudly.
+- :mod:`telemetry`  — p50/p95/p99 latency, queue depth, batch occupancy,
+                      bucket-warmth hit rate, shed/timeout counters.
+- :mod:`server`     — the threaded front-end: ``submit``/``submit_many``
+                      plus the ``keystone-tpu serve`` stdin/JSON CLI.
+- :mod:`synthetic`  — synthetic fitted pipelines for bench/smoke tests
+                      (imports jax; resolved lazily below).
+
+Everything except :mod:`synthetic` is stdlib-only at import time (the
+reliability rule): ``serve --help`` and launch scripts never pay the jax
+import cost.
+
+See docs/SERVING.md for architecture and knobs.
+"""
+
+from .admission import DEFAULT_RUNGS, AdmissionController, AdmissionRung
+from .batcher import MicroBatcher
+from .config import (
+    Request,
+    RequestShed,
+    RequestTimeout,
+    ServerClosed,
+    ServingConfig,
+    ServingError,
+    UnknownModel,
+    bucket_for,
+    default_bucket_sizes,
+)
+from .registry import ModelEntry, ModelRegistry
+from .server import PipelineServer
+from .telemetry import ServingTelemetry, percentile
+
+_LAZY = {
+    "SyntheticDense": "keystone_tpu.serving.synthetic",
+    "synthetic_fitted_pipeline": "keystone_tpu.serving.synthetic",
+    "synthetic_requests": "keystone_tpu.serving.synthetic",
+}
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRung",
+    "DEFAULT_RUNGS",
+    "MicroBatcher",
+    "ModelEntry",
+    "ModelRegistry",
+    "PipelineServer",
+    "Request",
+    "RequestShed",
+    "RequestTimeout",
+    "ServerClosed",
+    "ServingConfig",
+    "ServingError",
+    "ServingTelemetry",
+    "SyntheticDense",
+    "UnknownModel",
+    "bucket_for",
+    "default_bucket_sizes",
+    "percentile",
+    "synthetic_fitted_pipeline",
+    "synthetic_requests",
+]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(_LAZY[name])
+        value = getattr(module, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

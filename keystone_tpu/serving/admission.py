@@ -1,0 +1,148 @@
+"""Admission control: queue-depth backpressure with a DegradationLadder-
+driven shed policy.
+
+The same mindset as the solver OOM ladders (reliability/degrade.py):
+when the full-service configuration doesn't fit, take the best rung that
+does and SAY SO. Here the scarce resource is queue room rather than HBM,
+and the rungs are service levels —
+
+    rung 0  normal    admit while depth < queue_frac·capacity, full wait
+    rung 1  pressure  admit deeper, but trim the assembly wait (bigger
+                      batches ship sooner; per-request latency budget is
+                      spent on the queue, not on holding batches open)
+    rung 2  overload  admit to the brim with minimal wait
+
+A request that no rung admits is SHED with :class:`RequestShed` — the
+queue never grows past capacity, so sustained overload degrades latency
+in stages and then refuses loudly instead of queueing unboundedly.
+
+Rung *transitions* (not per-request admits) run through the shared
+:class:`~keystone_tpu.reliability.degrade.DegradationLadder`, so each
+degradation lands one ``degrade`` event in the recovery ledger exactly
+like a solver shrinking its block size — bounded log growth even under a
+shed storm, and ``summary()["degradations"]`` counts service-level drops
+across training and serving alike.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from ..reliability.degrade import DegradationLadder
+from .config import RequestShed
+
+
+@dataclass(frozen=True)
+class AdmissionRung:
+    """One service level: admit below ``queue_frac``·capacity, scale the
+    batcher's max-wait by ``wait_scale``."""
+
+    queue_frac: float
+    wait_scale: float
+    name: str = "rung"
+
+
+DEFAULT_RUNGS = (
+    AdmissionRung(queue_frac=0.5, wait_scale=1.0, name="normal"),
+    AdmissionRung(queue_frac=0.75, wait_scale=0.5, name="pressure"),
+    AdmissionRung(queue_frac=1.0, wait_scale=0.25, name="overload"),
+)
+
+
+class _OverCapacity(RuntimeError):
+    """Internal: this rung's depth bound is exceeded (degradable)."""
+
+
+class AdmissionController:
+    """Decides, per submit, whether to enqueue and at what service level."""
+
+    def __init__(
+        self,
+        capacity: int,
+        rungs: Sequence[AdmissionRung] = DEFAULT_RUNGS,
+        label: str = "serving-admission",
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        fracs = [r.queue_frac for r in rungs]
+        if fracs != sorted(fracs):
+            raise ValueError("rung queue_fracs must be non-decreasing")
+        self.capacity = capacity
+        self.rungs: List[AdmissionRung] = list(rungs)
+        self.label = label
+        self._lock = threading.Lock()
+        self._rung_index = 0
+        # One ladder for the controller's lifetime; walked (under _lock)
+        # only on service-level transitions, where its reduced-success
+        # bookkeeping lands the standard `degrade` ledger event.
+        self._ladder = DegradationLadder(
+            self.rungs,
+            should_degrade=lambda e: isinstance(e, _OverCapacity),
+            label=label,
+        )
+        self.sheds = 0
+        self.consecutive_sheds = 0
+        self.admitted = 0
+
+    # ---------------------------------------------------------------- policy
+    def _match_index(self, depth: int) -> Optional[int]:
+        for i, rung in enumerate(self.rungs):
+            if depth < rung.queue_frac * self.capacity:
+                return i
+        return None
+
+    def admit(self, depth: int) -> AdmissionRung:
+        """Admit a request at queue depth ``depth`` or raise
+        :class:`RequestShed`. Returns the service-level rung in effect."""
+        with self._lock:
+            index = self._match_index(depth)
+            if index is None:
+                self.sheds += 1
+                self.consecutive_sheds += 1
+                raise RequestShed(
+                    f"queue depth {depth}/{self.capacity} at every rung "
+                    f"({self.consecutive_sheds} consecutive)"
+                )
+            if index != self._rung_index:
+                # Walk the ladder only on transitions: one recovery-ledger
+                # event per service-level change, not per request. The
+                # walk re-evaluates the same depth _match_index matched,
+                # so it lands on `index` by construction — the ladder is
+                # here for its degradation bookkeeping, not the search.
+                def attempt(rung: AdmissionRung) -> AdmissionRung:
+                    if depth >= rung.queue_frac * self.capacity:
+                        raise _OverCapacity(
+                            f"depth {depth} >= {rung.queue_frac:g}x{self.capacity}"
+                        )
+                    return rung
+
+                self._ladder.run(attempt)
+                self._rung_index = index
+            self.admitted += 1
+            self.consecutive_sheds = 0
+            return self.rungs[self._rung_index]
+
+    # -------------------------------------------------------------- observers
+    @property
+    def rung_index(self) -> int:
+        with self._lock:
+            return self._rung_index
+
+    def wait_scale(self) -> float:
+        """Assembly-wait multiplier for the current service level — the
+        batcher reads this each batch so sustained pressure ships batches
+        sooner."""
+        with self._lock:
+            return self.rungs[self._rung_index].wait_scale
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rung": self.rungs[self._rung_index].name,
+                "rung_index": self._rung_index,
+                "admitted": self.admitted,
+                "sheds": self.sheds,
+                "consecutive_sheds": self.consecutive_sheds,
+            }

@@ -1,0 +1,162 @@
+"""Model registry: versioned fitted pipelines with atomic hot-swap.
+
+The serving analog of the reference's ``FittedPipeline`` persistence
+(save a transformer-only pipeline, load it in a serving process): models
+come in through three doors —
+
+- :meth:`ModelRegistry.publish` — an in-process fitted pipeline object;
+- :meth:`ModelRegistry.load_fitted` — a ``FittedPipeline.save`` pickle;
+- :meth:`ModelRegistry.load_checkpoint` — a reliability checkpoint entry
+  (``<digest>.pkl`` under a :class:`~keystone_tpu.reliability.checkpoint.
+  CheckpointStore` directory), the structural-digest-keyed fitted state a
+  training run persisted. Training and serving share one artifact format.
+
+Hot-swap contract: ``resolve`` returns an immutable :class:`ModelEntry`;
+the worker holds that entry for the whole batch it is applying, so a
+concurrent ``publish`` of a newer version never drops or retypes
+in-flight work — requests already assembled finish on the version they
+resolved, later batches resolve the new current version.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .config import UnknownModel
+
+
+@dataclass(frozen=True)
+class ModelEntry:
+    """One published (name, version) — immutable; safe to hold across a
+    batch while the registry is concurrently swapped."""
+
+    name: str
+    version: int
+    model: Any
+    source: str = "publish"
+    published_at: float = field(default_factory=time.time)
+
+    def batch_apply(self, dataset: Any) -> Any:
+        """Apply the model to an ArrayDataset, normalizing over the three
+        shapes a model arrives in: a FittedPipeline (compiled_apply — the
+        graph-bound fast path), a Transformer (apply_batch), or a bare
+        fitted TransformerOperator out of a reliability checkpoint
+        (batch_transform)."""
+        compiled = getattr(self.model, "compiled_apply", None)
+        if compiled is not None:
+            return compiled()(dataset)
+        apply_batch = getattr(self.model, "apply_batch", None)
+        if apply_batch is not None:
+            return apply_batch(dataset)
+        batch_transform = getattr(self.model, "batch_transform", None)
+        if batch_transform is not None:
+            return batch_transform([dataset])
+        raise TypeError(
+            f"model {self.name}@v{self.version} ({type(self.model).__name__}) "
+            "has no apply path (expected compiled_apply / apply_batch / "
+            "batch_transform)"
+        )
+
+
+class ModelRegistry:
+    """Thread-safe name → version list with an atomically swappable
+    'current' pointer per name."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._versions: Dict[str, List[ModelEntry]] = {}
+        self._current: Dict[str, ModelEntry] = {}
+        self.swaps = 0
+
+    # ---------------------------------------------------------------- publish
+    def publish(self, name: str, model: Any, source: str = "publish") -> ModelEntry:
+        """Register ``model`` as the next version of ``name`` and make it
+        current. Returns the new entry."""
+        with self._lock:
+            history = self._versions.setdefault(name, [])
+            entry = ModelEntry(
+                name=name,
+                version=history[-1].version + 1 if history else 1,
+                model=model,
+                source=source,
+            )
+            history.append(entry)
+            if name in self._current:
+                self.swaps += 1
+            self._current[name] = entry
+            return entry
+
+    def load_fitted(self, name: str, path: str) -> ModelEntry:
+        """Publish a ``FittedPipeline.save`` artifact."""
+        from ..workflow.pipeline import FittedPipeline
+
+        return self.publish(name, FittedPipeline.load(path), source=f"fitted:{path}")
+
+    def load_checkpoint(self, name: str, store_path: str, digest: str) -> ModelEntry:
+        """Publish a fitted value out of a reliability checkpoint store.
+
+        ``digest`` may be a unique prefix of the full structural digest
+        (the recovery log prints 12-hex prefixes)."""
+        matches = [
+            f for f in sorted(os.listdir(store_path))
+            if f.endswith(".pkl") and f.startswith(digest)
+        ]
+        if not matches:
+            raise FileNotFoundError(
+                f"no checkpoint entry matching digest {digest!r} in {store_path}"
+            )
+        if len(matches) > 1:
+            raise ValueError(
+                f"digest prefix {digest!r} is ambiguous in {store_path}: {matches}"
+            )
+        with open(os.path.join(store_path, matches[0]), "rb") as f:
+            model = pickle.load(f)
+        return self.publish(
+            name, model, source=f"checkpoint:{store_path}/{matches[0]}"
+        )
+
+    # ---------------------------------------------------------------- resolve
+    def resolve(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        with self._lock:
+            if name not in self._current:
+                raise UnknownModel(name, self._current.keys())
+            if version is None:
+                return self._current[name]
+            for entry in self._versions[name]:
+                if entry.version == version:
+                    return entry
+            raise UnknownModel(f"{name}@v{version}", self._current.keys())
+
+    def rollback(self, name: str, version: int) -> ModelEntry:
+        """Point 'current' back at an older published version (the entry
+        list is append-only; rollback is just a pointer swap)."""
+        entry = self.resolve(name, version)
+        with self._lock:
+            self._current[name] = entry
+            self.swaps += 1
+        return entry
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._current)
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return [e.version for e in self._versions.get(name, [])]
+
+    def describe(self) -> Dict[str, Any]:
+        """Snapshot for telemetry / the serve CLI stats line."""
+        with self._lock:
+            return {
+                name: {
+                    "current": self._current[name].version,
+                    "versions": [e.version for e in self._versions[name]],
+                    "source": self._current[name].source,
+                }
+                for name in sorted(self._current)
+            }

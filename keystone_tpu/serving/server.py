@@ -1,0 +1,494 @@
+"""In-process model server: threaded front-end over the micro-batcher.
+
+One worker thread assembles micro-batches, pads them to the nearest
+shape bucket (so the apply path reuses pre-lowered executables instead of
+recompiling per batch size), applies the resolved model version under the
+configured RetryPolicy, and distributes per-row results to request
+futures. ``submit``/``submit_many`` are plain Python — no network stack;
+the ``keystone-tpu serve`` CLI drives the same API over stdin/stdout
+JSON lines.
+
+Request lifecycle:
+
+    submit → admission (shed?) → bounded queue → batch assembly
+           → pad to bucket → resolve model version → retrying apply
+           → slice rows → future.set_result
+
+Fault handling composes the reliability layer: transient errors inside
+apply are retried per ``config.retry_policy`` (the ``serving.apply``
+probe site makes this fault-injectable in tests); request deadlines
+expire in-queue via the batcher; sustained overload walks the admission
+ladder and finally sheds.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..reliability.faultinject import probe
+from .admission import AdmissionController
+from .batcher import MicroBatcher
+from .config import (
+    Request,
+    RequestShed,
+    RequestTimeout,
+    ServerClosed,
+    ServingConfig,
+    ServingError,
+    bucket_for,
+)
+from .registry import ModelEntry, ModelRegistry
+from .telemetry import ServingTelemetry
+
+logger = logging.getLogger("keystone_tpu.serving")
+
+
+def _settle_result(future: Future, value: Any) -> None:
+    """set_result tolerating an already-settled future (a request can be
+    raced by shutdown settling — exactly one outcome wins, never a crash
+    in the worker)."""
+    try:
+        future.set_result(value)
+    except Exception:
+        pass
+
+
+def _settle_exception(future: Future, exc: Exception) -> None:
+    try:
+        future.set_exception(exc)
+    except Exception:
+        pass
+
+
+class PipelineServer:
+    """Micro-batched inference server over a :class:`ModelRegistry`."""
+
+    def __init__(
+        self,
+        model: Any = None,
+        config: ServingConfig = None,
+        registry: Optional[ModelRegistry] = None,
+        name: str = "default",
+        telemetry: Optional[ServingTelemetry] = None,
+    ):
+        self.config = config or ServingConfig()
+        self.registry = registry or ModelRegistry()
+        if model is not None:
+            self.registry.publish(name, model)
+        self.default_model = name
+        self.telemetry = telemetry or ServingTelemetry(window=self.config.telemetry_window)
+        self.admission = AdmissionController(self.config.queue_depth)
+        self.batcher = MicroBatcher(
+            self.config.queue_depth,
+            on_expired=lambda _req: self.telemetry.record_timeout(),
+        )
+        self._buckets = self.config.buckets()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._accepting = False
+        self._compile_baseline: Optional[int] = None
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> "PipelineServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._stop.clear()  # restartable: a stop()ed server can start() again
+        self._accepting = True
+        self._thread = threading.Thread(
+            target=self._worker, name="keystone-serving-worker", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop accepting; by default finish everything queued first."""
+        self._accepting = False
+        if not drain:
+            self.batcher.fail_all(ServerClosed())
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            if self._thread.is_alive():
+                # Worker still draining past the timeout: keep the handle
+                # so a premature start() raises instead of spawning a
+                # second worker against the same queue.
+                logger.warning(
+                    "serving worker still draining after %.0fs; "
+                    "server is not restartable until it exits", timeout_s,
+                )
+                return
+            self._thread = None
+
+    def __enter__(self) -> "PipelineServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ----------------------------------------------------------------- warmup
+    def warmup(self, example: Any, models: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+        """AOT-drive every shape bucket through each model's apply path so
+        no request size compiles at serve time. ``example`` is one request
+        payload (array or pytree). Returns per-model per-bucket seconds
+        and stamps the compile-counter baseline for ``stats()``."""
+        from ..utils.aot import warm_buckets
+        from ..utils.compilation_cache import compile_count, install_compile_counter
+
+        install_compile_counter()
+        out: Dict[str, Any] = {}
+        for model_name in models or self.registry.names():
+            entry = self.registry.resolve(model_name)
+            out[model_name] = warm_buckets(entry.batch_apply, example, self._buckets)
+        for bucket in self._buckets:
+            self.telemetry.mark_bucket_warm(bucket)
+        self._compile_baseline = compile_count()
+        return out
+
+    # ----------------------------------------------------------------- submit
+    def submit(
+        self,
+        payload: Any,
+        deadline_s: Optional[float] = None,
+        model: Optional[str] = None,
+    ) -> Future:
+        """Enqueue one request; returns its Future. Raises
+        :class:`RequestShed` under overload and :class:`ServerClosed`
+        after stop() — backpressure is synchronous and loud."""
+        if not self._accepting:
+            raise ServerClosed()
+        deadline = None
+        seconds = deadline_s if deadline_s is not None else self.config.default_deadline_s
+        if seconds is not None:
+            from ..reliability.retry import Deadline
+
+            deadline = Deadline(seconds)
+        try:
+            self.admission.admit(self.batcher.depth())
+        except RequestShed:
+            self.telemetry.record_shed()
+            raise
+        request = Request(
+            payload=payload, model=model or self.default_model, deadline=deadline
+        )
+        if not self.batcher.offer(request):  # raced to hard-full
+            self.telemetry.record_shed()
+            raise RequestShed(f"queue hard-full ({self.batcher.capacity})")
+        if self._stop.is_set():
+            # Raced stop(): the worker may already have passed its final
+            # drain check, so nobody would ever serve this request. Settle
+            # the future loudly (no-op if the worker did win the race).
+            _settle_exception(request.future, ServerClosed())
+            raise ServerClosed()
+        return request.future
+
+    def submit_many(
+        self,
+        payloads: Sequence[Any],
+        deadline_s: Optional[float] = None,
+        model: Optional[str] = None,
+    ) -> List[Future]:
+        """submit() each payload; sheds come back as completed futures
+        carrying :class:`RequestShed` so the result list stays aligned
+        with the input order."""
+        futures: List[Future] = []
+        for payload in payloads:
+            try:
+                futures.append(self.submit(payload, deadline_s=deadline_s, model=model))
+            except (RequestShed, ServerClosed) as exc:
+                f: Future = Future()
+                f.set_exception(exc)
+                futures.append(f)
+        return futures
+
+    # ------------------------------------------------------------------ stats
+    def stats(self) -> Dict[str, Any]:
+        out = self.telemetry.snapshot(queue_depth=self.batcher.depth())
+        out["admission"] = self.admission.stats()
+        out["models"] = self.registry.describe()
+        if self._compile_baseline is not None:
+            from ..utils.compilation_cache import compile_count
+
+            out["xla_compiles_since_warmup"] = compile_count() - self._compile_baseline
+        return out
+
+    # ----------------------------------------------------------------- worker
+    def _worker(self) -> None:
+        while True:
+            wait_s = (self.config.max_wait_ms / 1e3) * self.admission.wait_scale()
+            batch = self.batcher.next_batch(
+                self.config.max_batch, wait_s, stop=self._stop
+            )
+            if not batch:
+                if self._stop.is_set() and self.batcher.depth() == 0:
+                    # Close the submit/stop race: anything offered after
+                    # the depth check above fails instead of stranding.
+                    self.batcher.fail_all(ServerClosed())
+                    return
+                continue
+            for group in self._group_batch(batch):
+                self._apply_group(group[0].model, group)
+            self.telemetry.maybe_log(
+                self.config.log_interval_s, queue_depth=self.batcher.depth()
+            )
+
+    @staticmethod
+    def _group_batch(batch: List[Request]) -> List[List[Request]]:
+        """Split a batch into stackable groups: same model AND same
+        payload structure/shape/dtype. One wrong-shaped request then
+        fails (or serves) alone instead of poisoning the whole batch's
+        np.stack."""
+        import jax
+
+        def signature(req: Request):
+            try:
+                leaves, treedef = jax.tree_util.tree_flatten(req.payload)
+                import numpy as np
+
+                shapes = tuple(
+                    (np.asarray(leaf).shape, str(np.asarray(leaf).dtype))
+                    for leaf in leaves
+                )
+                return (req.model, str(treedef), shapes)
+            except Exception:
+                return (req.model, "unstackable", id(req))
+
+        groups: Dict[Any, List[Request]] = {}
+        for req in batch:
+            groups.setdefault(signature(req), []).append(req)
+        return list(groups.values())
+
+    def _apply_group(self, model_name: str, group: List[Request]) -> None:
+        t_apply = time.monotonic()
+        try:
+            entry = self.registry.resolve(model_name)
+            rows = self._apply_padded(entry, [r.payload for r in group])
+        except Exception as exc:
+            self.telemetry.record_failure(len(group))
+            for req in group:
+                _settle_exception(req.future, exc)
+            return
+        done = time.monotonic()
+        if len(rows) < len(group):
+            # A model may legally return fewer logical rows than it was
+            # given (e.g. a filtering ObjectDataset transformer) — the
+            # unmatched tail must fail loudly, never hang unsettled.
+            self.telemetry.record_failure(len(group) - len(rows))
+            for req in group[len(rows):]:
+                _settle_exception(
+                    req.future,
+                    ServingError(
+                        f"model {model_name!r} returned {len(rows)} rows "
+                        f"for a batch of {len(group)}"
+                    ),
+                )
+            group = group[: len(rows)]
+        for req, row in zip(group, rows):
+            # A deadline that expired DURING apply still gets its result —
+            # the work is done; deadlines bound queue/assembly wait.
+            _settle_result(req.future, row)
+            self.telemetry.record_request(
+                latency_s=done - req.enqueued_at,
+                queue_wait_s=t_apply - req.enqueued_at,
+            )
+
+    def _apply_padded(self, entry: ModelEntry, payloads: List[Any]) -> List[Any]:
+        """Stack payloads, zero-pad to the nearest bucket, apply with
+        retries, slice the real rows back out (host-side)."""
+        import jax
+        import numpy as np
+
+        from ..data.dataset import ArrayDataset
+
+        n = len(payloads)
+        bucket = bucket_for(n, self._buckets)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *payloads
+        )
+
+        def pad(a: np.ndarray) -> np.ndarray:
+            if a.shape[0] == bucket:
+                return a
+            widths = [(0, bucket - a.shape[0])] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, widths)
+
+        dataset = ArrayDataset(jax.tree_util.tree_map(pad, stacked), num_examples=n)
+
+        attempts = {"n": 0}
+
+        def attempt():
+            attempts["n"] += 1
+            probe("serving.apply")
+            return entry.batch_apply(dataset)
+
+        policy = self.config.retry_policy
+        try:
+            if policy is not None:
+                out = policy.call(attempt, label=f"serving.apply:{entry.name}")
+            else:
+                out = attempt()
+        finally:
+            # Count retries whether or not the batch ultimately succeeded:
+            # a fault storm that exhausts the policy must still show up.
+            for _ in range(attempts["n"] - 1):
+                self.telemetry.record_retry()
+        self.telemetry.record_batch(n, bucket, self.config.max_batch)
+        # Slice the real rows HOST-side: Dataset.take would device-slice
+        # a[:n], and that dynamic_slice compiles per (bucket, n) pair —
+        # exactly the steady-state recompile this layer exists to avoid.
+        # Results leave the device anyway to become response payloads.
+        data = getattr(out, "data", None)
+        if data is not None and hasattr(out, "num_examples"):
+            host = jax.tree_util.tree_map(np.asarray, data)
+            return [
+                jax.tree_util.tree_map(lambda a, i=i: a[i], host) for i in range(n)
+            ]
+        return out.take(n)
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def add_serve_arguments(parser) -> None:
+    """Flags for the ``keystone-tpu serve`` subcommand (plain argparse —
+    the CLI's --help path must stay jax-free)."""
+    parser.add_argument("--model", help="FittedPipeline.save artifact to serve")
+    parser.add_argument(
+        "--checkpoint-dir", help="reliability CheckpointStore directory to load from"
+    )
+    parser.add_argument(
+        "--digest", help="structural digest (or unique prefix) inside --checkpoint-dir"
+    )
+    parser.add_argument(
+        "--synthetic", type=int, default=None, metavar="D",
+        help="serve a synthetic D-dim dense pipeline (smoke tests, no artifact)",
+    )
+    parser.add_argument("--model-name", default="default")
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--max-wait-ms", type=float, default=2.0)
+    parser.add_argument("--queue-depth", type=int, default=64)
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        help="default per-request deadline")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip AOT bucket warmup before serving")
+
+
+def serve_from_args(args) -> int:
+    """Run the stdin/JSON front-end: one request per line
+    (``{"id": ..., "x": [...]}`` or a bare array), one response line per
+    request as it completes, then a final ``SERVE_STATS:{...}`` line."""
+    import numpy as np
+
+    from ..reliability.retry import RetryPolicy
+    from ..utils.compilation_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    config = ServingConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        queue_depth=args.queue_depth,
+        default_deadline_s=(args.deadline_ms / 1e3) if args.deadline_ms else None,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.05),
+    )
+    registry = ModelRegistry()
+    if args.synthetic is not None:
+        from .synthetic import synthetic_fitted_pipeline
+
+        registry.publish(
+            args.model_name,
+            synthetic_fitted_pipeline(d=args.synthetic),
+            source=f"synthetic:d={args.synthetic}",
+        )
+        example = np.zeros((args.synthetic,), np.float32)
+    elif args.model:
+        registry.load_fitted(args.model_name, args.model)
+        example = None
+    elif args.checkpoint_dir and args.digest:
+        registry.load_checkpoint(args.model_name, args.checkpoint_dir, args.digest)
+        example = None
+    else:
+        print(
+            "serve: need --model, --checkpoint-dir + --digest, or --synthetic D",
+            file=sys.stderr,
+        )
+        return 2
+
+    server = PipelineServer(config=config, registry=registry, name=args.model_name)
+    server.start()
+
+    out_lock = threading.Lock()
+
+    def emit(obj: Dict[str, Any]) -> None:
+        with out_lock:
+            print(json.dumps(obj), flush=True)
+
+    def on_done(request_id, t0):
+        def callback(future: Future) -> None:
+            try:
+                row = future.result()
+                emit({
+                    "id": request_id,
+                    "y": np.asarray(row).tolist(),
+                    "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+                })
+            except Exception as exc:
+                emit({"id": request_id, "error": f"{type(exc).__name__}: {exc}"})
+
+        return callback
+
+    warmed = False
+    pending: List[Future] = []
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as exc:
+            emit({"error": f"bad request line: {exc}"})
+            continue
+        if isinstance(obj, dict):
+            request_id, x = obj.get("id"), obj.get("x")
+            deadline_s = (obj["deadline_ms"] / 1e3) if obj.get("deadline_ms") else None
+        else:
+            request_id, x, deadline_s = None, obj, None
+        try:
+            payload = np.asarray(x, np.float32)
+            if x is None or payload.ndim == 0:
+                raise ValueError(f"x must be an array, got {x!r}")
+        except (TypeError, ValueError) as exc:
+            # One malformed request must not take the server down for
+            # every later request on the stream.
+            emit({"id": request_id, "error": f"bad payload: {exc}"})
+            continue
+        if not warmed and not args.no_warmup:
+            server.warmup(example if example is not None else payload)
+            warmed = True
+        t0 = time.monotonic()
+        try:
+            future = server.submit(payload, deadline_s=deadline_s)
+        except (RequestShed, RequestTimeout, ServerClosed) as exc:
+            emit({"id": request_id, "error": f"{type(exc).__name__}: {exc}"})
+            continue
+        future.add_done_callback(on_done(request_id, t0))
+        pending.append(future)
+        if len(pending) >= 4096:
+            # Responses were already emitted by on_done; keep only the
+            # unsettled tail so a long-lived stream doesn't grow RSS
+            # linearly with total requests served.
+            pending = [f for f in pending if not f.done()]
+
+    server.stop(drain=True)
+    for future in pending:  # callbacks already emitted; just settle
+        try:
+            future.result(timeout=1.0)
+        except Exception:
+            pass
+    with out_lock:
+        print("SERVE_STATS:" + json.dumps(server.stats()), flush=True)
+    return 0

@@ -1,0 +1,83 @@
+"""Synthetic fitted pipelines for serving benchmarks, smoke tests, and the
+``serve --synthetic`` CLI path — a stand-in for a real featurize+solve
+pipeline with tunable compute per request and a trace counter that makes
+"no recompile after warmup" directly assertable (the Python body of a
+jitted function runs only when XLA traces a new shape).
+
+Unlike the rest of the serving package this module imports the workflow
+layer (and therefore jax) at module scope: ``SyntheticDense`` must be a
+module-level class for ``FittedPipeline.save`` artifacts to unpickle in a
+fresh process. Import it lazily (the serving ``__init__`` does).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from ..workflow.pipeline import BatchTransformer, FittedPipeline
+
+
+class SyntheticDense(BatchTransformer):
+    """A depth-layer tanh MLP with pickle-safe jit state."""
+
+    def __init__(self, weights: List[Any], trace_log: Optional[list] = None):
+        self.weights = weights
+        self.trace_log = trace_log
+        self._fn = None
+
+    @property
+    def label(self) -> str:
+        return f"SyntheticDense[d={self.weights[0].shape[0]}x{len(self.weights)}]"
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_fn"] = None  # jitted callables don't pickle
+        return state
+
+    def apply_arrays(self, x):
+        if self._fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            ws = [jnp.asarray(w) for w in self.weights]
+            trace_log = self.trace_log
+
+            def compute(x):
+                if trace_log is not None:
+                    # Trace-time side effect: appends once per new shape,
+                    # never on cached executions.
+                    trace_log.append(tuple(x.shape))
+                for w in ws[:-1]:
+                    x = jnp.tanh(x @ w)
+                return x @ ws[-1]
+
+            self._fn = jax.jit(compute)
+        return self._fn(x)
+
+
+def synthetic_fitted_pipeline(
+    d: int = 64,
+    depth: int = 2,
+    seed: int = 0,
+    trace_log: Optional[list] = None,
+) -> FittedPipeline:
+    """A transformer-only FittedPipeline: ``depth`` dense tanh layers of
+    width ``d`` (float32). Deterministic in ``seed``."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    scale = 1.0 / np.sqrt(d)
+    weights = [
+        (rng.standard_normal((d, d)) * scale).astype(np.float32)
+        for _ in range(max(1, depth))
+    ]
+    pipeline = SyntheticDense(weights, trace_log=trace_log).to_pipeline()
+    return FittedPipeline(pipeline.graph, pipeline.source, pipeline.sink)
+
+
+def synthetic_requests(n: int, d: int = 64, seed: int = 1) -> List[Any]:
+    """``n`` request payloads of shape (d,), deterministic in ``seed``."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal(d).astype(np.float32) for _ in range(n)]

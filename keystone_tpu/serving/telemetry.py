@@ -1,0 +1,147 @@
+"""Serving telemetry: latency percentiles, batch occupancy, bucket-warmth
+hit rate, shed/timeout counters.
+
+Snapshot-oriented (``snapshot()`` returns a plain dict the CLI prints and
+the bench embeds in ``BENCH_*.json``) plus a rate-limited periodic log
+line for long-running servers. Stdlib-only: percentiles are computed from
+a bounded ring of samples with ``statistics``-free interpolation so the
+module imports before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Sequence
+
+
+def percentile(samples: Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile (q in [0, 100]) of ``samples``."""
+    if not samples:
+        return 0.0
+    data = sorted(samples)
+    if len(data) == 1:
+        return float(data[0])
+    rank = (q / 100.0) * (len(data) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(data) - 1)
+    frac = rank - lo
+    return float(data[lo] * (1.0 - frac) + data[hi] * frac)
+
+
+class ServingTelemetry:
+    """Thread-safe counters + bounded latency/occupancy windows."""
+
+    def __init__(
+        self,
+        window: int = 2048,
+        clock: Callable[[], float] = time.monotonic,
+        log: Optional[logging.Logger] = None,
+    ):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._log = log or logging.getLogger("keystone_tpu.serving")
+        self._latencies_s: deque = deque(maxlen=window)
+        self._queue_waits_s: deque = deque(maxlen=window)
+        self._occupancies: deque = deque(maxlen=window)
+        self._started_at = clock()
+        self._last_log_at = clock()
+        self.served = 0
+        self.batches = 0
+        self.sheds = 0
+        self.timeouts = 0
+        self.retries = 0
+        self.failures = 0
+        self.bucket_hits = 0      # batch padded to an already-warm bucket
+        self.bucket_compiles = 0  # first batch at a bucket (warm-up compile)
+        self._warm_buckets: set = set()
+
+    # --------------------------------------------------------------- recording
+    def record_request(self, latency_s: float, queue_wait_s: float) -> None:
+        with self._lock:
+            self.served += 1
+            self._latencies_s.append(latency_s)
+            self._queue_waits_s.append(queue_wait_s)
+
+    def record_batch(self, size: int, bucket: int, max_batch: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self._occupancies.append(size / float(max_batch))
+            if bucket in self._warm_buckets:
+                self.bucket_hits += 1
+            else:
+                self._warm_buckets.add(bucket)
+                self.bucket_compiles += 1
+
+    def mark_bucket_warm(self, bucket: int) -> None:
+        """Pre-declare a bucket as compiled (AOT warmup path), so the
+        first real batch at it counts as a hit."""
+        with self._lock:
+            self._warm_buckets.add(bucket)
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.sheds += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def record_failure(self, n: int = 1) -> None:
+        with self._lock:
+            self.failures += n
+
+    # --------------------------------------------------------------- snapshots
+    def snapshot(self, queue_depth: Optional[int] = None) -> Dict[str, object]:
+        with self._lock:
+            lat = list(self._latencies_s)
+            waits = list(self._queue_waits_s)
+            occ = list(self._occupancies)
+            uptime = self._clock() - self._started_at
+            out: Dict[str, object] = {
+                "served": self.served,
+                "batches": self.batches,
+                "sheds": self.sheds,
+                "timeouts": self.timeouts,
+                "retries": self.retries,
+                "failures": self.failures,
+                "uptime_s": round(uptime, 3),
+                "throughput_rps": round(self.served / uptime, 2) if uptime > 0 else 0.0,
+                "p50_ms": round(percentile(lat, 50) * 1e3, 3),
+                "p95_ms": round(percentile(lat, 95) * 1e3, 3),
+                "p99_ms": round(percentile(lat, 99) * 1e3, 3),
+                "queue_wait_p50_ms": round(percentile(waits, 50) * 1e3, 3),
+                "batch_occupancy": round(sum(occ) / len(occ), 4) if occ else 0.0,
+                "bucket_hits": self.bucket_hits,
+                "bucket_compiles": self.bucket_compiles,
+                "bucket_hit_rate": round(
+                    self.bucket_hits / max(1, self.bucket_hits + self.bucket_compiles), 4
+                ),
+            }
+        if queue_depth is not None:
+            out["queue_depth"] = queue_depth
+        return out
+
+    def maybe_log(self, interval_s: float, queue_depth: Optional[int] = None) -> bool:
+        """Emit one INFO line at most every ``interval_s``; returns whether
+        a line was emitted (the worker calls this once per batch)."""
+        with self._lock:
+            now = self._clock()
+            if now - self._last_log_at < interval_s:
+                return False
+            self._last_log_at = now
+        snap = self.snapshot(queue_depth=queue_depth)
+        self._log.info(
+            "serving: served=%d rps=%.1f p50=%.2fms p99=%.2fms occupancy=%.2f "
+            "queue=%s sheds=%d timeouts=%d retries=%d bucket_hit_rate=%.2f",
+            snap["served"], snap["throughput_rps"], snap["p50_ms"], snap["p99_ms"],
+            snap["batch_occupancy"], snap.get("queue_depth", "?"), snap["sheds"],
+            snap["timeouts"], snap["retries"], snap["bucket_hit_rate"],
+        )
+        return True

@@ -16,9 +16,51 @@ TPU-specific cost and a TPU-specific fix.
 from __future__ import annotations
 
 import time
-from typing import Iterable, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence, Tuple
 
 import numpy as np
+
+
+def warm_buckets(
+    batch_apply: Callable[[Any], Any],
+    example: Any,
+    bucket_sizes: Sequence[int],
+    enable_persistent_cache: bool = True,
+) -> Dict[str, float]:
+    """Drive ``batch_apply`` (dataset → dataset, e.g. a serving model's
+    apply path) through every batch-size bucket AHEAD of traffic, so no
+    request size compiles at serve time.
+
+    ``example`` is one request payload (array or pytree of arrays); each
+    bucket runs a zero batch of that shape stacked ``bucket`` high with
+    ``num_examples=1`` — logical rows < physical rows, which also warms
+    the pad-row masking ops a partial serving batch executes (a
+    full-occupancy batch skips them, so warming at full occupancy would
+    leave the partial-batch path cold). Returns per-bucket seconds; with
+    the persistent cache enabled the warmed executables outlive this
+    process, so a restarted server's warmup is a disk load."""
+    import jax
+
+    from ..data.dataset import ArrayDataset
+
+    if enable_persistent_cache:
+        from .compilation_cache import enable_persistent_cache as _enable
+
+        _enable()
+
+    out: Dict[str, float] = {}
+    for bucket in sorted(set(int(b) for b in bucket_sizes)):
+        if bucket < 1:
+            raise ValueError(f"bucket sizes must be >= 1, got {bucket}")
+        zeros = jax.tree_util.tree_map(
+            lambda a: np.zeros((bucket,) + np.asarray(a).shape, np.asarray(a).dtype),
+            example,
+        )
+        t0 = time.perf_counter()
+        result = batch_apply(ArrayDataset(zeros, num_examples=1))
+        jax.block_until_ready(getattr(result, "data", result))
+        out[f"bucket_{bucket}_s"] = round(time.perf_counter() - t0, 4)
+    return out
 
 
 def warm_flagship(

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import os
+from typing import Callable
 
 _DEFAULT_DIR = os.path.join(
     os.path.expanduser("~"), ".cache", "keystone_tpu", "xla-cache"
@@ -51,3 +52,46 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
             "persistent compilation cache unavailable (%s)", e
         )
         return None
+
+
+# ------------------------------------------------------- compile accounting
+
+# Backend-compile event counter. The serving layer warms a fixed bucket
+# set and then asserts (in tests) / reports (in telemetry) that steady-
+# state traffic triggers ZERO further XLA compiles — the counter is the
+# evidence. jax.monitoring fires one
+# "/jax/core/compile/backend_compile_duration" event per executable
+# actually built (cache hits, persistent or in-memory, don't fire).
+_COMPILE_EVENT_SUBSTRING = "backend_compile"
+_compile_events = {"count": 0}
+_counter_installed = False
+
+
+def install_compile_counter() -> Callable[[], int]:
+    """Idempotently register a jax.monitoring listener counting backend
+    compiles; returns :func:`compile_count`. Registration is permanent
+    for the process (jax.monitoring has no unregister), which is fine:
+    the listener is one substring check per compile event."""
+    global _counter_installed
+    if not _counter_installed:
+        try:
+            import jax.monitoring
+
+            def _listener(event: str, duration: float, **kw) -> None:
+                if _COMPILE_EVENT_SUBSTRING in event:
+                    _compile_events["count"] += 1
+
+            jax.monitoring.register_event_duration_secs_listener(_listener)
+            _counter_installed = True
+        except Exception as e:  # same contract as the cache: never fatal
+            logging.getLogger(__name__).warning(
+                "compile counter unavailable (%s)", e
+            )
+    return compile_count
+
+
+def compile_count() -> int:
+    """Backend compiles observed since :func:`install_compile_counter`
+    (0 if never installed — callers diff snapshots, so a dead counter
+    reads as 'no recompiles' rather than an error)."""
+    return _compile_events["count"]

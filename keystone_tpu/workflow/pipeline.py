@@ -442,18 +442,23 @@ class FittedPipeline(Transformer):
         self._datum_op: Optional[DatumOperator] = None
         self._datum_graph: Optional[Graph] = None
         self._datum_lock = threading.Lock()
+        self._compiled: Optional["CompiledApply"] = None
 
     def __getstate__(self):
-        # save() must not pickle the last served datum (or the lock).
+        # save() must not pickle the last served datum (or the lock, or
+        # the serving handle's bound graph/payload).
         state = self.__dict__.copy()
         state["_datum_op"] = None
         state["_datum_graph"] = None
         state["_datum_lock"] = None
+        state["_compiled"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._datum_lock = threading.Lock()
+        # Artifacts saved before the serving layer existed lack the slot.
+        self._compiled = None
 
     def apply(self, datum: Any) -> Any:
         with self._datum_lock:
@@ -474,6 +479,15 @@ class FittedPipeline(Transformer):
         executor = GraphExecutor(graph, optimize=False)
         return executor.execute(self.sink).get()
 
+    def compiled_apply(self) -> "CompiledApply":
+        """The serving-loop batch handle: graph bound once, only the
+        dataset payload swapped per call (the batch analog of the datum
+        fast path above). Cached on the pipeline — all servers applying
+        this fitted pipeline share one handle."""
+        if self._compiled is None:
+            self._compiled = CompiledApply(self)
+        return self._compiled
+
     # ---------------------------------------------------------- serialization
     def save(self, path: str) -> None:
         with open(path, "wb") as f:
@@ -486,3 +500,43 @@ class FittedPipeline(Transformer):
         if not isinstance(out, FittedPipeline):
             raise TypeError(f"{path} does not contain a FittedPipeline")
         return out
+
+
+class CompiledApply:
+    """Reusable batch-apply handle over a :class:`FittedPipeline`.
+
+    ``apply_batch`` rebuilds the datum-bound graph on every call; a
+    serving loop calls apply thousands of times per second, so this
+    handle binds the graph ONCE and swaps only the ``DatasetOperator``
+    payload per call, under a lock (same contract as the datum fast
+    path: per-call execution runs optimize=False with a fresh executor,
+    so no cross-call memo or prefix write-back sees the mutation).
+
+    Shape discipline is the caller's job: feeding batches whose padded
+    physical shapes cycle through a small bucket set means the jitted
+    transformer bodies underneath hit XLA's executable cache instead of
+    recompiling — see serving/batcher.py and utils/aot.warm_buckets.
+    """
+
+    def __init__(self, fitted: FittedPipeline):
+        self._fitted = fitted
+        self._op: Optional[DatasetOperator] = None
+        self._graph: Optional[Graph] = None
+        self._lock = threading.Lock()
+        self.calls = 0
+
+    def __call__(self, dataset: Union[Dataset, Any]) -> Dataset:
+        if not isinstance(dataset, Dataset):
+            dataset = as_dataset(dataset)
+        fitted = self._fitted
+        with self._lock:
+            if self._graph is None:
+                self._op = DatasetOperator(dataset)
+                graph, node = fitted.graph.add_node(self._op, [])
+                graph = graph.replace_dependency(fitted.source, node)
+                self._graph = graph.remove_source(fitted.source)
+            else:
+                self._op.dataset = dataset
+            self.calls += 1
+            executor = GraphExecutor(self._graph, optimize=False)
+            return executor.execute(fitted.sink).get()

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Serving smoke test: start the stdin/JSON server on a synthetic pipeline,
+# fire 100 requests, assert every one answered, p99 under budget, zero
+# sheds, and zero XLA compiles after warmup. Exercises the exact
+# `keystone-tpu serve` path docs/SERVING.md documents.
+#
+# Usage: scripts/serve_smoke.sh [p99_budget_ms]   (default 250 on CPU)
+set -euo pipefail
+
+P99_BUDGET_MS="${1:-250}"
+N=100
+D=16
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+OUT="$(mktemp)"
+trap 'rm -f "$OUT"' EXIT
+
+python - "$N" "$D" <<'EOF' | timeout -k 10 280 python -m keystone_tpu serve \
+    --synthetic "$D" --max-batch 8 --max-wait-ms 2 --queue-depth 256 > "$OUT"
+import json, sys
+n, d = int(sys.argv[1]), int(sys.argv[2])
+for i in range(n):
+    print(json.dumps({"id": i, "x": [float(i % 7)] * d}))
+EOF
+
+python - "$OUT" "$N" "$P99_BUDGET_MS" <<'EOF'
+import json, sys
+path, n, p99_budget = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+lines = [l for l in open(path).read().splitlines() if l.strip()]
+stats = [l for l in lines if l.startswith("SERVE_STATS:")]
+assert len(stats) == 1, f"expected one stats line, got {len(stats)}"
+stats = json.loads(stats[0][len("SERVE_STATS:"):])
+responses = [json.loads(l) for l in lines if not l.startswith("SERVE_STATS:")]
+errors = [r for r in responses if "error" in r]
+assert not errors, f"{len(errors)} errored responses, first: {errors[0]}"
+assert len(responses) == n, f"expected {n} responses, got {len(responses)}"
+assert stats["served"] == n, stats
+assert stats["sheds"] == 0, f"sheds under smoke load: {stats['sheds']}"
+assert stats["timeouts"] == 0, f"timeouts under smoke load: {stats['timeouts']}"
+assert stats.get("xla_compiles_since_warmup", 0) == 0, \
+    f"recompiled after warmup: {stats['xla_compiles_since_warmup']}"
+assert stats["p99_ms"] <= p99_budget, \
+    f"p99 {stats['p99_ms']}ms over {p99_budget}ms budget"
+print(f"serve_smoke OK: {n} requests, p50={stats['p50_ms']}ms "
+      f"p99={stats['p99_ms']}ms occupancy={stats['batch_occupancy']} "
+      f"sheds=0 recompiles=0")
+EOF

@@ -1,0 +1,60 @@
+"""Admission control: ladder-driven service degradation + loud sheds."""
+
+import pytest
+
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.serving.admission import AdmissionController, AdmissionRung
+from keystone_tpu.serving.config import RequestShed
+
+pytestmark = pytest.mark.serving
+
+
+def controller(capacity=10):
+    return AdmissionController(capacity=capacity)
+
+
+def test_normal_admission_at_low_depth():
+    a = controller()
+    rung = a.admit(depth=0)
+    assert rung.name == "normal" and rung.wait_scale == 1.0
+    assert a.stats()["rung"] == "normal"
+
+
+def test_degrades_under_pressure_and_records_once():
+    a = controller(capacity=10)
+    assert a.admit(depth=6).name == "pressure"  # past 0.5x10, under 0.75x10
+    assert a.wait_scale() == 0.5
+    events = get_recovery_log().events("degrade")
+    assert len(events) == 1 and events[0].label == "serving-admission"
+    # Steady-state admits at the same rung must NOT append more events
+    # (a long-running server under load cannot grow the ledger per request).
+    for _ in range(50):
+        a.admit(depth=6)
+    assert len(get_recovery_log().events("degrade")) == 1
+
+
+def test_overload_rung_then_shed_at_capacity():
+    a = controller(capacity=10)
+    assert a.admit(depth=9).name == "overload"
+    with pytest.raises(RequestShed):
+        a.admit(depth=10)
+    assert a.stats()["sheds"] == 1
+    assert a.stats()["consecutive_sheds"] == 1
+    a.admit(depth=1)  # success resets the consecutive counter
+    assert a.stats()["consecutive_sheds"] == 0
+
+
+def test_recovers_to_normal_when_queue_drains():
+    a = controller(capacity=10)
+    a.admit(depth=9)
+    assert a.rung_index == 2
+    assert a.admit(depth=0).name == "normal"
+    assert a.wait_scale() == 1.0
+
+
+def test_rung_fracs_must_be_monotone():
+    with pytest.raises(ValueError):
+        AdmissionController(
+            capacity=4,
+            rungs=[AdmissionRung(0.9, 1.0), AdmissionRung(0.5, 0.5)],
+        )

@@ -1,0 +1,87 @@
+"""Micro-batcher: bounded queue, max-wait, deadline-aware assembly."""
+
+import time
+
+import pytest
+
+from keystone_tpu.reliability.retry import Deadline
+from keystone_tpu.serving.batcher import MicroBatcher
+from keystone_tpu.serving.config import Request, RequestTimeout
+
+pytestmark = pytest.mark.serving
+
+
+def req(payload=0, deadline_s=None):
+    return Request(
+        payload=payload,
+        model="m",
+        deadline=Deadline(deadline_s) if deadline_s is not None else None,
+    )
+
+
+def test_offer_is_bounded():
+    b = MicroBatcher(capacity=2)
+    assert b.offer(req()) and b.offer(req())
+    assert not b.offer(req())
+    assert b.refused == 1 and b.depth() == 2
+
+
+def test_full_batch_dispatches_before_max_wait():
+    b = MicroBatcher(capacity=8)
+    for i in range(4):
+        b.offer(req(i))
+    t0 = time.monotonic()
+    batch = b.next_batch(max_batch=4, max_wait_s=5.0)
+    elapsed = time.monotonic() - t0
+    assert [r.payload for r in batch] == [0, 1, 2, 3]
+    assert elapsed < 1.0  # did NOT hold the full 5 s max-wait
+
+
+def test_partial_batch_respects_max_wait():
+    b = MicroBatcher(capacity=8)
+    b.offer(req("solo"))
+    t0 = time.monotonic()
+    batch = b.next_batch(max_batch=4, max_wait_s=0.08)
+    elapsed = time.monotonic() - t0
+    assert [r.payload for r in batch] == ["solo"]
+    assert 0.06 <= elapsed < 2.0
+
+
+def test_expired_request_fails_at_assembly_not_on_device():
+    expired_seen = []
+    b = MicroBatcher(capacity=8, on_expired=expired_seen.append)
+    dead = req("dead", deadline_s=0.0)
+    live = req("live")
+    time.sleep(0.01)  # the 0-second deadline is now past
+    b.offer(dead)
+    b.offer(live)
+    batch = b.next_batch(max_batch=2, max_wait_s=0.01)
+    assert [r.payload for r in batch] == ["live"]
+    assert b.expired == 1 and expired_seen == [dead]
+    with pytest.raises(RequestTimeout):
+        dead.future.result(timeout=0)
+
+
+def test_batch_closes_early_for_member_deadline():
+    """A queued request about to expire closes the batch instead of the
+    batch's max-wait expiring it: deadline-aware assembly."""
+    b = MicroBatcher(capacity=8)
+    b.offer(req("urgent", deadline_s=0.08))
+    t0 = time.monotonic()
+    batch = b.next_batch(max_batch=4, max_wait_s=10.0)
+    elapsed = time.monotonic() - t0
+    assert [r.payload for r in batch] == ["urgent"]
+    assert not batch[0].future.done()  # dispatched, not expired
+    assert elapsed < 5.0  # nowhere near the 10 s max-wait
+
+
+def test_fail_all_drains_queue():
+    b = MicroBatcher(capacity=4)
+    requests = [req(i) for i in range(3)]
+    for r in requests:
+        b.offer(r)
+    assert b.fail_all(RuntimeError("shutdown")) == 3
+    assert b.depth() == 0
+    for r in requests:
+        with pytest.raises(RuntimeError):
+            r.future.result(timeout=0)

@@ -1,0 +1,83 @@
+"""Model registry: versioning, hot-swap atomicity, checkpoint loading."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.serving.config import UnknownModel
+from keystone_tpu.serving.registry import ModelRegistry
+
+pytestmark = pytest.mark.serving
+
+
+def test_publish_versions_and_rollback():
+    r = ModelRegistry()
+    v1 = r.publish("m", "model-one")
+    v2 = r.publish("m", "model-two")
+    assert (v1.version, v2.version) == (1, 2)
+    assert r.resolve("m").model == "model-two"
+    assert r.resolve("m", version=1).model == "model-one"
+    assert r.versions("m") == [1, 2]
+    r.rollback("m", 1)
+    assert r.resolve("m").model == "model-one"
+    assert r.swaps == 2  # publish-over + rollback
+
+
+def test_unknown_model_raises():
+    r = ModelRegistry()
+    with pytest.raises(UnknownModel):
+        r.resolve("missing")
+    r.publish("m", object())
+    with pytest.raises(UnknownModel):
+        r.resolve("m", version=99)
+
+
+def test_load_fitted_artifact(tmp_path):
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    path = str(tmp_path / "model.pkl")
+    synthetic_fitted_pipeline(d=4, seed=3).save(path)
+    r = ModelRegistry()
+    entry = r.load_fitted("m", path)
+    assert entry.source == f"fitted:{path}"
+    out = entry.batch_apply(ArrayDataset(np.ones((2, 4), np.float32)))
+    assert np.asarray(out.data).shape == (2, 4)
+
+
+def test_load_checkpoint_by_digest_prefix(tmp_path):
+    """Training persists fitted state into a CheckpointStore; serving
+    loads the same artifact by structural digest — one format, two uses
+    (the RELIABILITY.md -> SERVING.md handoff path)."""
+    from keystone_tpu.reliability.checkpoint import CheckpointStore, prefix_digest
+    from keystone_tpu.workflow.pipeline import Identity
+    from keystone_tpu.workflow.prefix import Prefix
+
+    store = CheckpointStore(str(tmp_path))
+    fitted = Identity()
+    prefix = Prefix((fitted, ()))
+    digest = prefix_digest(prefix)
+    assert store.save(prefix, fitted, digest=digest)
+
+    r = ModelRegistry()
+    entry = r.load_checkpoint("m", str(tmp_path), digest[:12])
+    assert entry.source.endswith(f"{digest}.pkl")
+    ds = ArrayDataset(np.arange(8, dtype=np.float32).reshape(2, 4))
+    out = entry.batch_apply(ds)
+    np.testing.assert_array_equal(np.asarray(out.data), np.asarray(ds.data))
+
+
+def test_load_checkpoint_missing_or_ambiguous(tmp_path):
+    (tmp_path / "abc111.pkl").write_bytes(b"x")
+    (tmp_path / "abc222.pkl").write_bytes(b"x")
+    r = ModelRegistry()
+    with pytest.raises(FileNotFoundError):
+        r.load_checkpoint("m", str(tmp_path), "fff")
+    with pytest.raises(ValueError):
+        r.load_checkpoint("m", str(tmp_path), "abc")
+
+
+def test_entry_without_apply_path_raises():
+    r = ModelRegistry()
+    entry = r.publish("m", object())
+    with pytest.raises(TypeError):
+        entry.batch_apply(ArrayDataset(np.ones((1, 2), np.float32)))

@@ -1,0 +1,49 @@
+"""`keystone-tpu serve` front-end over stdin/JSON (subprocess; slow-marked
+— scripts/serve_smoke.sh runs the same path out-of-band and CI's tier-1
+stays inside its budget)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = [pytest.mark.serving, pytest.mark.slow]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_serve_synthetic_roundtrip(tmp_path):
+    requests = "\n".join(
+        [json.dumps({"id": i, "x": [float(i)] * 8}) for i in range(20)]
+        # Malformed payloads must answer with an error line, not kill the
+        # stream for the valid requests around them.
+        + [json.dumps({"id": 98, "x": "abc"}), json.dumps({"id": 97, "x": None})]
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               KEYSTONE_COMPILATION_CACHE=str(tmp_path / "cache"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "keystone_tpu", "serve",
+         "--synthetic", "8", "--max-batch", "4", "--max-wait-ms", "5"],
+        input=requests, capture_output=True, text=True, timeout=300,
+        env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    stats_lines = [l for l in lines if l.startswith("SERVE_STATS:")]
+    assert len(stats_lines) == 1
+    stats = json.loads(stats_lines[0][len("SERVE_STATS:"):])
+    responses = [json.loads(l) for l in lines if not l.startswith("SERVE_STATS:")]
+    assert len(responses) == 22
+    by_id = {r["id"]: r for r in responses}
+    assert set(by_id) == set(range(20)) | {97, 98}
+    for i in range(20):
+        r = by_id[i]
+        assert "error" not in r, r
+        assert len(r["y"]) == 8 and r["latency_ms"] >= 0
+    assert "bad payload" in by_id[98]["error"]
+    assert "bad payload" in by_id[97]["error"]
+    assert stats["served"] == 20
+    assert stats["sheds"] == 0 and stats["failures"] == 0
+    assert stats["models"]["default"]["source"] == "synthetic:d=8"

@@ -1,0 +1,236 @@
+"""PipelineServer acceptance: warm-bucket no-recompile, overload shedding,
+hot-swap with zero drops, deadline expiry, fault-injected retry."""
+
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.reliability.faultinject import FaultSpec, injected
+from keystone_tpu.reliability.retry import RetryPolicy
+from keystone_tpu.serving import (
+    PipelineServer,
+    RequestShed,
+    RequestTimeout,
+    ServerClosed,
+    ServingConfig,
+)
+from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline, synthetic_requests
+from keystone_tpu.workflow.pipeline import Transformer
+
+pytestmark = pytest.mark.serving
+
+D = 8
+
+
+class ScaleModel(Transformer):
+    """k·x with an optional pre-apply sleep (stands in for heavy compute:
+    makes queue buildup and in-flight batches controllable in tests)."""
+
+    def __init__(self, k, delay_s=0.0):
+        self.k = k
+        self.delay_s = delay_s
+
+    def apply(self, x):
+        return np.asarray(x) * self.k
+
+    def apply_batch(self, dataset):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return ArrayDataset(np.asarray(dataset.data) * self.k, dataset.num_examples)
+
+
+def serve(model, **kw):
+    defaults = dict(max_batch=8, max_wait_ms=10.0, queue_depth=64)
+    defaults.update(kw)
+    return PipelineServer(model, config=ServingConfig(**defaults))
+
+
+def test_results_match_direct_apply():
+    fp = synthetic_fitted_pipeline(d=D, seed=2)
+    payloads = synthetic_requests(13, d=D)
+    expected = np.asarray(fp.apply_batch(ArrayDataset(np.stack(payloads))).data)
+    with serve(fp) as server:
+        futures = server.submit_many(payloads)
+        results = np.stack([f.result(timeout=30) for f in futures])
+    np.testing.assert_allclose(results, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_bucket_padding_never_recompiles_after_warmup():
+    """The tentpole property: after AOT bucket warmup, NO request size
+    triggers an XLA compile — asserted two ways (a trace-time counter in
+    the jitted body, and the jax.monitoring backend-compile counter)."""
+    trace = []
+    fp = synthetic_fitted_pipeline(d=D, trace_log=trace)
+    with serve(fp) as server:
+        server.warmup(np.zeros((D,), np.float32))
+        buckets = server.config.buckets()
+        assert len(trace) == len(buckets)  # one trace per bucket
+        traces_after_warmup = len(trace)
+        for n in (3, 5, 2, 7, 1, 8):  # sizes that all pad to some bucket
+            futures = server.submit_many(synthetic_requests(n, d=D, seed=n))
+            for f in futures:
+                f.result(timeout=30)
+        stats = server.stats()
+    assert len(trace) == traces_after_warmup, f"recompiled: {trace}"
+    assert stats["xla_compiles_since_warmup"] == 0
+    assert stats["bucket_compiles"] == 0  # every batch hit a warm bucket
+    assert stats["bucket_hit_rate"] == 1.0
+    assert stats["served"] == 26 and stats["failures"] == 0
+
+
+def test_overload_sheds_instead_of_queueing_unboundedly():
+    model = ScaleModel(2, delay_s=0.05)
+    with serve(model, queue_depth=8, max_wait_ms=1.0) as server:
+        futures = server.submit_many(synthetic_requests(80, d=D))
+        assert server.batcher.depth() <= 8  # the queue never grew past capacity
+        outcomes = []
+        for f in futures:
+            try:
+                f.result(timeout=30)
+                outcomes.append("ok")
+            except RequestShed:
+                outcomes.append("shed")
+        stats = server.stats()
+    assert "shed" in outcomes and "ok" in outcomes  # degraded, not dead
+    assert stats["sheds"] == outcomes.count("shed") > 0
+    assert stats["admission"]["sheds"] > 0
+    assert stats["failures"] == 0  # sheds are refusals, not apply failures
+
+
+def test_hot_swap_serves_new_version_with_zero_dropped_requests():
+    with serve(ScaleModel(1), max_wait_ms=2.0) as server:
+        payloads = synthetic_requests(60, d=D)
+        first = server.submit_many(payloads[:30])
+        server.registry.publish("default", ScaleModel(3))  # hot-swap mid-stream
+        second = server.submit_many(payloads[30:])
+        results = [f.result(timeout=30) for f in first + second]  # zero drops
+    for x, y in zip(payloads, results):
+        ratio = np.asarray(y) / np.asarray(x)
+        # Every request was served by exactly one version, never a mix.
+        assert np.allclose(ratio, 1.0) or np.allclose(ratio, 3.0)
+    # Requests submitted after the swap resolve the new version.
+    for x, y in zip(payloads[30:], results[30:]):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 3, rtol=1e-6)
+    assert server.registry.swaps == 1
+
+
+def test_deadline_expires_in_queue_while_worker_busy():
+    model = ScaleModel(2, delay_s=0.3)
+    with serve(model, max_wait_ms=1.0) as server:
+        blocker = server.submit(synthetic_requests(1, d=D)[0])
+        time.sleep(0.05)  # the blocker's batch is now on the worker
+        doomed = server.submit(synthetic_requests(1, d=D, seed=9)[0], deadline_s=0.05)
+        with pytest.raises(RequestTimeout):
+            doomed.result(timeout=30)
+        blocker.result(timeout=30)  # the in-flight batch still completes
+        assert server.stats()["timeouts"] == 1
+
+
+def test_transient_fault_in_apply_is_retried_per_policy():
+    fp = synthetic_fitted_pipeline(d=D)
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.02)
+    with injected(
+        FaultSpec(match="serving.apply", kind="transient", calls=(1,))
+    ) as injector:
+        with serve(fp, retry_policy=policy) as server:
+            futures = server.submit_many(synthetic_requests(3, d=D))
+            results = [f.result(timeout=30) for f in futures]
+            stats = server.stats()
+    assert len(results) == 3 and all(np.asarray(r).shape == (D,) for r in results)
+    # One probe call per batch plus exactly one retried attempt (only the
+    # first call faults), regardless of how the 3 requests batched up.
+    assert injector.calls("serving.apply") == stats["batches"] + 1
+    assert stats["retries"] == 1 and stats["failures"] == 0
+    from keystone_tpu.reliability.recovery import get_recovery_log
+
+    assert len(get_recovery_log().events("retry")) == 1
+
+
+def test_exhausted_retries_fail_the_batch_loudly():
+    fp = synthetic_fitted_pipeline(d=D)
+    policy = RetryPolicy(max_attempts=2, base_delay_s=0.01, max_delay_s=0.02)
+    with injected(FaultSpec(match="serving.apply", kind="transient", first_n=5)):
+        with serve(fp, retry_policy=policy) as server:
+            future = server.submit(synthetic_requests(1, d=D)[0])
+            with pytest.raises(ConnectionError):
+                future.result(timeout=30)
+            assert server.stats()["failures"] == 1
+
+
+def test_model_returning_short_rows_fails_tail_instead_of_hanging():
+    """A model that returns fewer rows than its batch (filtering
+    ObjectDataset) must fail the unmatched requests loudly — a zip
+    truncation would leave their futures unsettled forever."""
+    from keystone_tpu.data.dataset import ObjectDataset
+
+    class FirstRowOnly(Transformer):
+        def apply(self, x):
+            return np.asarray(x)
+
+        def apply_batch(self, dataset):
+            return ObjectDataset(dataset.collect()[:1])
+
+    with serve(FirstRowOnly(), max_wait_ms=30.0) as server:
+        futures = server.submit_many(synthetic_requests(3, d=D))
+        outcomes = []
+        for f in futures:
+            try:
+                f.result(timeout=10)
+                outcomes.append("ok")
+            except Exception as exc:
+                assert "returned 1 rows for a batch of" in str(exc)
+                outcomes.append("short")
+        stats = server.stats()
+    # One "ok" per assembled batch; every other request fails loudly —
+    # and critically, ALL futures settled (no result() hang above).
+    assert outcomes.count("short") >= 1
+    assert outcomes.count("ok") + outcomes.count("short") == 3
+    assert stats["failures"] == outcomes.count("short")
+
+
+def test_submit_after_stop_raises():
+    server = serve(ScaleModel(1)).start()
+    server.stop()
+    with pytest.raises(ServerClosed):
+        server.submit(np.zeros((D,), np.float32))
+
+
+def test_restart_after_stop_serves_again():
+    server = serve(ScaleModel(2))
+    server.start()
+    assert server.submit(np.ones((D,), np.float32)).result(timeout=30) is not None
+    server.stop()
+    server.start()  # must clear the stop signal: a restarted worker serves
+    out = server.submit(np.ones((D,), np.float32)).result(timeout=30)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    server.stop()
+
+
+def test_wrong_shaped_request_fails_alone_not_its_batchmates():
+    """One client sending shape (D+1,) into a batch of (D,) requests must
+    not poison np.stack for everyone: groups stack per payload signature."""
+    with serve(synthetic_fitted_pipeline(d=D), max_wait_ms=30.0) as server:
+        good = server.submit_many(synthetic_requests(3, d=D))
+        bad = server.submit(np.zeros((D + 1,), np.float32))
+        for f in good:
+            assert np.asarray(f.result(timeout=30)).shape == (D,)
+        with pytest.raises(Exception):
+            bad.result(timeout=30)
+        assert server.stats()["failures"] == 1
+
+
+def test_stop_without_drain_fails_queued_requests():
+    model = ScaleModel(1, delay_s=0.2)
+    server = serve(model, max_wait_ms=1.0).start()
+    futures = server.submit_many(synthetic_requests(12, d=D))
+    server.stop(drain=False)
+    settled = 0
+    for f in futures:
+        try:
+            f.result(timeout=5)
+            settled += 1
+        except (ServerClosed, RequestShed):
+            settled += 1
+    assert settled == 12  # every future resolves one way or the other

@@ -1,0 +1,51 @@
+"""Telemetry: percentile math, snapshot shape, bucket-warmth accounting."""
+
+import pytest
+
+from keystone_tpu.serving.telemetry import ServingTelemetry, percentile
+
+pytestmark = pytest.mark.serving
+
+
+def test_percentile_interpolation():
+    data = [1.0, 2.0, 3.0, 4.0]
+    assert percentile(data, 0) == 1.0
+    assert percentile(data, 100) == 4.0
+    assert percentile(data, 50) == 2.5
+    assert percentile([], 50) == 0.0
+    assert percentile([7.0], 99) == 7.0
+
+
+def test_snapshot_fields_and_percentiles():
+    t = ServingTelemetry(window=16)
+    for ms in (1, 2, 3, 4, 5, 6, 7, 8, 9, 10):
+        t.record_request(latency_s=ms / 1e3, queue_wait_s=ms / 2e3)
+    t.record_batch(size=5, bucket=8, max_batch=10)
+    t.record_shed()
+    t.record_timeout()
+    snap = t.snapshot(queue_depth=3)
+    assert snap["served"] == 10 and snap["batches"] == 1
+    assert snap["sheds"] == 1 and snap["timeouts"] == 1
+    assert snap["queue_depth"] == 3
+    assert snap["p50_ms"] == pytest.approx(5.5, abs=0.01)
+    assert snap["p99_ms"] <= 10.0 and snap["p99_ms"] >= snap["p50_ms"]
+    assert snap["batch_occupancy"] == 0.5
+
+
+def test_bucket_warmth_hit_rate():
+    t = ServingTelemetry()
+    t.mark_bucket_warm(4)
+    t.record_batch(3, bucket=4, max_batch=8)   # warm → hit
+    t.record_batch(7, bucket=8, max_batch=8)   # cold → compile
+    t.record_batch(8, bucket=8, max_batch=8)   # now warm → hit
+    assert t.bucket_hits == 2 and t.bucket_compiles == 1
+    assert t.snapshot()["bucket_hit_rate"] == pytest.approx(2 / 3, abs=1e-4)
+
+
+def test_maybe_log_rate_limited():
+    clock = {"t": 0.0}
+    t = ServingTelemetry(clock=lambda: clock["t"])
+    assert not t.maybe_log(interval_s=30.0)  # within the first interval
+    clock["t"] = 31.0
+    assert t.maybe_log(interval_s=30.0)
+    assert not t.maybe_log(interval_s=30.0)  # immediately after: limited

@@ -4,6 +4,33 @@ from keystone_tpu.pipelines.imagenet import ImageNetSiftLcsFVConfig
 from keystone_tpu.utils.aot import warm_flagship
 
 
+def test_warm_buckets_covers_full_and_partial_batches(tmp_path, monkeypatch):
+    """Serving warmup: every declared bucket compiles ahead of traffic,
+    including the partial-batch pad-mask path (warmed at num_examples=1),
+    so steady-state request sizes never compile (asserted end-to-end in
+    tests/serving/test_server.py)."""
+    import numpy as np
+
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+    from keystone_tpu.utils.aot import warm_buckets
+    from keystone_tpu.utils.compilation_cache import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    monkeypatch.setenv("KEYSTONE_COMPILATION_CACHE", str(tmp_path / "cache"))
+    install_compile_counter()
+    fp = synthetic_fitted_pipeline(d=4, seed=5)
+    apply_fn = fp.compiled_apply()
+    out = warm_buckets(apply_fn, np.zeros((4,), np.float32), (1, 2, 4))
+    assert sorted(out) == ["bucket_1_s", "bucket_2_s", "bucket_4_s"]
+    assert all(v >= 0 for v in out.values())
+    # Re-warming the same buckets is pure cache hits: zero new compiles.
+    before = compile_count()
+    warm_buckets(apply_fn, np.zeros((4,), np.float32), (1, 2, 4))
+    assert compile_count() == before
+
+
 def test_warm_flagship_compiles_declared_shapes(tmp_path, monkeypatch):
     # Point the persistent cache somewhere disposable so the test leaves
     # no shared state.

@@ -11,6 +11,7 @@ from keystone_tpu.ops.util.misc import CacherOperator
 from keystone_tpu.workflow.autocache import AutoCacheRule, Profile, _fit_linear, SampleProfile
 from keystone_tpu.workflow.graph import Graph
 from keystone_tpu.workflow.operators import DatasetOperator, TransformerOperator
+from keystone_tpu.workflow.pipeline import Estimator, Transformer
 
 
 class FakeClock:
@@ -178,3 +179,57 @@ def test_linear_fit_extrapolates():
     p = _fit_linear(samples, 100)
     assert abs(p.run_time_s - 10.0) < 1e-6
     assert p.size_bytes == 10_000
+
+
+# ----------------------------------------------------- serving reuse pattern
+
+
+class CountingEstimator(Estimator):
+    """Estimator that counts fits."""
+
+    def __init__(self):
+        self.fit_calls = 0
+
+    def fit(self, data):
+        self.fit_calls += 1
+        return Transformer.from_fn(lambda x: x, name="fitted")
+
+
+def test_repeated_apply_of_fitted_prefix_does_not_refit():
+    """The serving reuse pattern: one fitted prefix applied per-request,
+    many times. The prefix table must hand every application the SAME
+    fitted transformer — refitting per request would put estimator cost
+    on the serving hot path."""
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    est = CountingEstimator()
+    data = ArrayDataset(np.ones((8, 4), dtype=np.float32))
+    pipeline = est.with_data(data)
+    for i in range(5):
+        result = pipeline.apply(ArrayDataset(np.full((2, 4), float(i), np.float32)))
+        assert len(result.get()) == 2
+    assert est.fit_calls == 1
+    # The fitted expression lives in the process-wide prefix table — a
+    # SECOND structurally identical pipeline over the same data reuses it.
+    pipeline2 = est.with_data(data)
+    pipeline2.apply(ArrayDataset(np.zeros((2, 4), np.float32))).get()
+    assert est.fit_calls == 1
+    assert len(PipelineEnv.get_or_create().state) >= 1
+
+
+def test_cache_decisions_stable_across_repeated_planning():
+    """Serving re-plans the same graph repeatedly (hot-swap republish,
+    restart): with identical profiles the greedy planner must pick the
+    identical cache set every time — nondeterministic placement would
+    recompile the serving path on every swap."""
+    chosen = []
+    for _ in range(3):
+        clock = FakeClock()
+        g, shared_id, _ = diamond_graph(delay_s=0.01, clock=clock)
+        out, _ = AutoCacheRule(
+            budget_bytes=1 << 30, strategy="greedy", clock=clock
+        ).apply(g, {})
+        chosen.append(
+            tuple(sorted(out.get_dependencies(c)[0] for c in cacher_nodes(out)))
+        )
+    assert chosen[0] == chosen[1] == chosen[2] == (shared_id,)
