@@ -1118,6 +1118,49 @@ def _selected_workloads() -> list[str]:
     return names
 
 
+def _leg_obs_before() -> dict:
+    """Per-leg observability baseline: metrics snapshot + compile count.
+    Diffed by :func:`_leg_obs_snapshot` after the leg so every BENCH leg
+    payload carries its own counters (docs/OBSERVABILITY.md)."""
+    from keystone_tpu.obs import metrics as obs_metrics
+    from keystone_tpu.utils.compilation_cache import compile_count
+
+    from keystone_tpu.obs import device as obs_device
+
+    return {
+        "metrics": obs_metrics.get_registry().snapshot(),
+        "compiles": compile_count(),
+        "bytes_in_use": obs_device.memory_snapshot()["bytes_in_use"],
+    }
+
+
+def _leg_obs_snapshot(before: dict) -> dict:
+    """What the leg changed: compile count, memory, and every metric
+    series that moved (serving counters for the serving leg, quarantine/
+    reliability events for ingest, solver/executor counters for fit legs).
+    Node wall-time histograms appear only for legs that ran under a trace
+    session — the bench deliberately never forces per-node execution, so
+    per-node timings come from ``keystone-tpu profile``, not from here."""
+    from keystone_tpu.obs import device as obs_device
+    from keystone_tpu.obs import metrics as obs_metrics
+    from keystone_tpu.utils.compilation_cache import compile_count
+
+    mem = obs_device.memory_snapshot()
+    moved = obs_metrics.delta(
+        obs_metrics.get_registry().snapshot(), before["metrics"]
+    )
+    return {
+        "xla_compiles": compile_count() - before["compiles"],
+        # peak_bytes_in_use never resets between legs, so it is the
+        # PROCESS-lifetime high-water mark at leg end — name it that way;
+        # the in-use delta is what this leg itself retained/freed.
+        "lifetime_peak_memory_bytes": mem["peak_bytes_in_use"],
+        "memory_in_use_delta_bytes": mem["bytes_in_use"] - before["bytes_in_use"],
+        "memory_source": mem["source"],
+        "metrics_delta": moved,
+    }
+
+
 def child_main(small: bool, workload: str | None = None) -> int:
     import jax
 
@@ -1125,9 +1168,13 @@ def child_main(small: bool, workload: str | None = None) -> int:
     # processes, so a workload's second-ever run skips XLA compilation.
     # Reported in the JSON so a reader knows whether compile-heavy stages
     # could have hit a warm cache.
-    from keystone_tpu.utils.compilation_cache import enable_persistent_cache
+    from keystone_tpu.utils.compilation_cache import (
+        enable_persistent_cache,
+        install_compile_counter,
+    )
 
     cache_dir = enable_persistent_cache()
+    install_compile_counter()  # per-leg compile deltas in the obs snapshot
 
     t_init = time.time()
     devices = jax.devices()
@@ -1159,11 +1206,13 @@ def child_main(small: bool, workload: str | None = None) -> int:
             }
             continue
         t0 = time.time()
+        obs_before = _leg_obs_before()
         try:
             report[name] = workloads[name](small)
         except Exception as e:  # record, keep going — partial data beats none
             report[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
         report[name]["wall_s"] = round(time.time() - t0, 1)
+        report[name]["obs"] = _leg_obs_snapshot(obs_before)
         if partial_path:
             _dump_partial(
                 {"partial": True, "phase": "cpu_insurance", **report},

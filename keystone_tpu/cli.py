@@ -225,6 +225,17 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_serve_arguments(serve_parser)
 
+    # Observability harness (docs/OBSERVABILITY.md): run the synthetic
+    # pipeline under full instrumentation, write a Perfetto-loadable
+    # Chrome trace + a Prometheus snapshot. Stdlib-only flag wiring.
+    from .obs.profile import add_profile_arguments
+
+    profile_parser = sub.add_parser(
+        "profile",
+        help="profile a pipeline: spans + metrics → Chrome trace + Prometheus",
+    )
+    add_profile_arguments(profile_parser)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -235,6 +246,7 @@ def main(argv: Optional[list] = None) -> int:
         for name, entry in sorted(WORKLOADS.items()):
             print(f"{name:28s} {entry[-1]}")
         print(f"{'serve':28s} online serving front-end (micro-batched, stdin/JSON)")
+        print(f"{'profile':28s} instrumented run → Chrome trace + Prometheus snapshot")
         return 0
 
     # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;
@@ -251,6 +263,17 @@ def main(argv: Optional[list] = None) -> int:
         from .serving.server import serve_from_args
 
         return serve_from_args(args)
+
+    if args.workload == "profile":
+        from .obs.profile import profile_from_args
+        from .utils.compilation_cache import (
+            enable_persistent_cache,
+            install_compile_counter,
+        )
+
+        enable_persistent_cache()
+        install_compile_counter()  # compile counts belong in the profile
+        return profile_from_args(args)
 
     # Warm repeat runs: compiled XLA programs persist across processes
     # (KEYSTONE_COMPILATION_CACHE=off to disable). Enabled only on the

@@ -23,6 +23,8 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from ..obs import names as _names
+from ..obs import spans as _spans
 from .loaders.archive import iter_tar_entries, native_decode_batch
 
 
@@ -96,42 +98,51 @@ def measure_ingest(
     def decode(chunk):
         return native_decode_batch([r for _, r in chunk], resize)
 
-    entries = iter_tar_entries(tar_path)
-    chunk: list = []
-    futures = []
-    for name, raw in entries:
-        chunk.append((name, raw))
-        raw_bytes += len(raw)
-        if len(chunk) == batch:
+    with _spans.span("ingest:read", source=tar_path):
+        entries = iter_tar_entries(tar_path)
+        chunk: list = []
+        futures = []
+        for name, raw in entries:
+            chunk.append((name, raw))
+            raw_bytes += len(raw)
+            if len(chunk) == batch:
+                futures.append(chunk)
+                chunk = []
+                if max_images and sum(len(c) for c in futures) + done >= max_images:
+                    break
+        if chunk:
             futures.append(chunk)
-            chunk = []
-            if max_images and sum(len(c) for c in futures) + done >= max_images:
-                break
-    if chunk:
-        futures.append(chunk)
 
     read_s = time.perf_counter() - t0
     t0 = time.perf_counter()
-    for c in futures:
-        td = time.perf_counter()
-        images, ok = decode(c)
-        decode_s += time.perf_counter() - td
-        done += int(ok.sum())
-        corrupt += len(c) - int(ok.sum())
-        if featurize is not None:
-            tw = time.perf_counter()
-            if pending is not None:
-                pending.result()  # force previous device batch
-            feat_wait_s += time.perf_counter() - tw
-            pending = pool.submit(featurize, images)
-    if pending is not None:
-        pending.result()
+    with _spans.span(
+        "ingest:decode", batches=len(futures), overlapped=featurize is not None
+    ):
+        for c in futures:
+            td = time.perf_counter()
+            images, ok = decode(c)
+            decode_s += time.perf_counter() - td
+            done += int(ok.sum())
+            corrupt += len(c) - int(ok.sum())
+            if featurize is not None:
+                tw = time.perf_counter()
+                if pending is not None:
+                    pending.result()  # force previous device batch
+                feat_wait_s += time.perf_counter() - tw
+                pending = pool.submit(featurize, images)
+        if pending is not None:
+            pending.result()
     total_s = time.perf_counter() - t0
     pool.shutdown()
+
+    _names.metric(_names.INGEST_IMAGES).inc(done)
+    _names.metric(_names.INGEST_BYTES).inc(raw_bytes)
+    _names.metric(_names.INGEST_DECODE_SECONDS).inc(decode_s)
 
     if corrupt:
         from ..reliability.recovery import get_recovery_log
 
+        _names.metric(_names.INGEST_CORRUPT).inc(corrupt)
         get_recovery_log().record(
             "quarantine", "measure_ingest", count=corrupt, source=tar_path
         )

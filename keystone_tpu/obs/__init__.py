@@ -1,0 +1,52 @@
+"""keystone_tpu.obs — the unified observability layer.
+
+One subsystem answering "where did this pipeline spend its time and
+memory" end to end, replacing the three telemetry fragments the system
+grew (flat per-op tracing, serving-local percentiles, the reliability
+ledger's counts):
+
+- :mod:`.spans` — hierarchical spans with trace ids, attributes, events,
+  and cross-thread context handoff; free when no session is active.
+- :mod:`.metrics` — process-wide registry of labeled counters / gauges /
+  histograms; :mod:`.names` declares the stable, tested name schema.
+- :mod:`.device` — device/host memory sampling, per-stage peak
+  attribution, optional ``jax.profiler.TraceAnnotation`` wrapping.
+- :mod:`.export` — Chrome trace-event JSON (Perfetto), Prometheus text,
+  and a human span-tree report.
+- :mod:`.profile` — the ``keystone-tpu profile`` harness.
+
+The package is stdlib-only at import time (jax is imported lazily inside
+functions), so bench.py and the CLI can import it before any backend
+initializes. See docs/OBSERVABILITY.md.
+"""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    percentile,
+    reset_registry,
+)
+from .spans import (
+    NOOP_SPAN,
+    Span,
+    TraceSession,
+    active_session,
+    add_span_event,
+    attach,
+    current_context,
+    current_span,
+    record_span,
+    span,
+    tracing_session,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
+    "percentile", "reset_registry",
+    "NOOP_SPAN", "Span", "TraceSession", "active_session", "add_span_event",
+    "attach", "current_context", "current_span", "record_span", "span",
+    "tracing_session",
+]

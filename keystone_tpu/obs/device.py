@@ -1,0 +1,140 @@
+"""Device/profiling hooks: memory sampling, peak-memory attribution, and
+optional XLA trace annotations.
+
+Memory sampling prefers the accelerator's own accounting
+(``Device.memory_stats()`` — bytes_in_use / peak_bytes_in_use on TPU) and
+falls back to host RSS (``/proc/self/statm``, then ``resource``) on CPU
+test meshes, where XLA allocates out of the process heap anyway. Either
+way the snapshot says which source it used, so a reader never mistakes
+RSS for HBM.
+
+``device_annotation`` wraps a code region in
+``jax.profiler.TraceAnnotation`` so per-node executor work shows up
+inside ``jax.profiler.trace`` captures (TensorBoard/XProf). It is gated —
+default off — because annotations are only useful under an active XLA
+profiler session and cost a host call each.
+
+Imports jax lazily; importable before any backend initializes.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager, nullcontext
+from typing import Any, Dict, Iterator, Optional
+
+from . import names, spans
+
+_PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+_annotations_enabled = os.environ.get(
+    "KEYSTONE_DEVICE_ANNOTATIONS", ""
+).lower() in ("1", "true", "on")
+
+
+def set_device_annotations(enabled: bool) -> None:
+    global _annotations_enabled
+    _annotations_enabled = bool(enabled)
+
+
+def annotations_enabled() -> bool:
+    return _annotations_enabled
+
+
+def device_annotation(name: str):
+    """Context manager: ``jax.profiler.TraceAnnotation(name)`` when
+    enabled and jax is importable, else a no-op."""
+    if not _annotations_enabled:
+        return nullcontext()
+    try:
+        import jax.profiler
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()
+
+
+def rss_bytes() -> int:
+    """Resident set size of this process (0 if unavailable)."""
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return int(f.read().split()[1]) * _PAGE_SIZE
+    except Exception:
+        pass
+    try:
+        import resource
+
+        # ru_maxrss is the PEAK, in KiB on Linux — last resort only.
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
+def peak_rss_bytes() -> int:
+    """Process-lifetime peak RSS (0 if unavailable)."""
+    try:
+        import resource
+
+        return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:
+        return 0
+
+
+def memory_snapshot() -> Dict[str, Any]:
+    """Best-available memory numbers right now.
+
+    Returns ``{"source": "device"|"rss", "bytes_in_use": int,
+    "peak_bytes_in_use": int}``; device stats only when the backend
+    exposes them (TPU/GPU — CPU meshes report RSS)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_in_use" in stats:
+            return {
+                "source": "device",
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0))
+                ),
+            }
+    except Exception:
+        pass
+    return {
+        "source": "rss",
+        "bytes_in_use": rss_bytes(),
+        "peak_bytes_in_use": peak_rss_bytes(),
+    }
+
+
+def publish_memory(stage: Optional[str] = None) -> Dict[str, Any]:
+    """Sample memory and publish it to the registry: the in-use gauge
+    always, plus per-stage peak attribution when ``stage`` is given."""
+    snap = memory_snapshot()
+    names.metric(names.MEMORY_IN_USE_BYTES).set(
+        snap["bytes_in_use"], source=snap["source"]
+    )
+    if stage is not None:
+        names.metric(names.PEAK_MEMORY_BYTES).max(
+            snap["peak_bytes_in_use"], stage=stage
+        )
+    return snap
+
+
+@contextmanager
+def stage_memory(stage: str) -> Iterator[None]:
+    """Attribute peak memory to a pipeline stage: snapshot before/after,
+    stamp the delta and peak onto the current span, and keep the per-stage
+    peak gauge. Cheap enough for per-node use only under tracing — callers
+    gate on an active span session."""
+    before = publish_memory(stage=stage)
+    try:
+        yield
+    finally:
+        after = publish_memory(stage=stage)
+        sp = spans.current_span()
+        sp.set_attribute("mem_bytes_before", before["bytes_in_use"])
+        sp.set_attribute("mem_bytes_after", after["bytes_in_use"])
+        sp.set_attribute("mem_peak_bytes", after["peak_bytes_in_use"])
+        sp.set_attribute("mem_source", after["source"])

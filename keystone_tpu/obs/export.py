@@ -1,0 +1,204 @@
+"""Exporters: Chrome trace-event JSON (Perfetto-loadable), Prometheus
+text exposition, and a human-readable span report.
+
+Chrome format: one complete event (``"ph": "X"``) per span with
+microsecond ``ts``/``dur`` relative to the session start, one instant
+event (``"ph": "i"``) per span event, plus thread-name metadata events so
+Perfetto's track labels read "keystone-serving-worker" instead of a bare
+tid. Span ids/parent ids ride in ``args`` — the visual nesting Perfetto
+draws from ts/dur containment matches the parent chain because children
+are opened and closed inside their parents by construction.
+
+Prometheus format follows the text exposition rules: ``# HELP`` /
+``# TYPE`` headers for every registered metric (including zero-series
+ones — an exported schema with no samples is itself information),
+histograms as cumulative ``_bucket{le=...}`` plus ``_sum``/``_count``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .spans import Span, TraceSession
+
+
+# ------------------------------------------------------------- chrome trace
+
+
+def _json_safe(value: Any) -> Any:
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    return str(value)
+
+
+def chrome_trace(session: TraceSession) -> Dict[str, Any]:
+    """The session's spans as a Chrome trace-event JSON object."""
+    import os
+
+    pid = os.getpid()
+    base = session.started_s
+    events: List[Dict[str, Any]] = []
+    seen_threads: Dict[int, str] = {}
+    for span in session.spans():
+        tid = span.thread_id or 0
+        if tid not in seen_threads:
+            seen_threads[tid] = span.thread_name
+        end = span.end_s if span.end_s is not None else span.start_s
+        args = {k: _json_safe(v) for k, v in span.attributes.items()}
+        args["span_id"] = span.span_id
+        if span.parent_id:
+            args["parent_id"] = span.parent_id
+        args["trace_id"] = span.trace_id
+        if span.status != "ok":
+            args["status"] = span.status
+        events.append(
+            {
+                "name": span.name,
+                "cat": span.name.split(":", 1)[0] or "span",
+                "ph": "X",
+                "ts": round((span.start_s - base) * 1e6, 3),
+                "dur": round((end - span.start_s) * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            }
+        )
+        for event in span.events:
+            events.append(
+                {
+                    "name": event.name,
+                    "cat": "event",
+                    "ph": "i",
+                    "s": "t",  # thread-scoped instant
+                    "ts": round((event.ts_s - base) * 1e6, 3),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": {k: _json_safe(v) for k, v in event.attributes.items()},
+                }
+            )
+    for tid, thread_name in seen_threads.items():
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {"name": thread_name or f"thread-{tid}"},
+            }
+        )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "trace_id": session.trace_id,
+            "session": session.name,
+            "started_unix": session.started_unix,
+            "dropped_spans": session.dropped,
+        },
+    }
+
+
+def write_chrome_trace(session: TraceSession, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(session), f)
+    return path
+
+
+# -------------------------------------------------------------- prometheus
+
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def _fmt_labels(key, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(str(v))}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus text exposition of every registered metric."""
+    registry = registry or get_registry()
+    lines: List[str] = []
+    for metric in registry.collect():
+        lines.append(f"# HELP {metric.name} {metric.help or metric.name}")
+        lines.append(f"# TYPE {metric.name} {metric.kind}")
+        series = metric.series()
+        if isinstance(metric, Histogram):
+            for key, s in sorted(series.items()):
+                cumulative = 0
+                for bound, count in zip(metric.buckets, s.bucket_counts):
+                    cumulative += count
+                    le = 'le="%r"' % (bound,)
+                    lines.append(
+                        f"{metric.name}_bucket{_fmt_labels(key, le)} {cumulative}"
+                    )
+                cumulative += s.bucket_counts[-1]
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{metric.name}_bucket{_fmt_labels(key, inf)} {cumulative}"
+                )
+                lines.append(f"{metric.name}_sum{_fmt_labels(key)} {repr(float(s.sum))}")
+                lines.append(f"{metric.name}_count{_fmt_labels(key)} {s.count}")
+        elif isinstance(metric, (Counter, Gauge)):
+            if not series and not metric.label_names:
+                lines.append(f"{metric.name} 0")
+            for key, value in sorted(series.items()):
+                lines.append(f"{metric.name}{_fmt_labels(key)} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str, registry: Optional[MetricsRegistry] = None) -> str:
+    with open(path, "w") as f:
+        f.write(prometheus_text(registry))
+    return path
+
+
+# ------------------------------------------------------------ human report
+
+
+def report(session: TraceSession, max_depth: int = 6) -> str:
+    """Indented span tree, children in start order, slowest roots first."""
+    spans = session.spans()
+    children: Dict[Optional[str], List[Span]] = {}
+    by_id = {s.span_id: s for s in spans}
+    for s in spans:
+        parent = s.parent_id if s.parent_id in by_id else None
+        children.setdefault(parent, []).append(s)
+    for siblings in children.values():
+        siblings.sort(key=lambda s: s.start_s)
+    roots = sorted(children.get(None, []), key=lambda s: -s.duration_s)
+
+    width = max(
+        [len("span")] + [min(len(s.name), 48) + 2 * max_depth for s in spans]
+    )
+    lines = [f"{'span':<{width}}  {'ms':>10}  {'self ms':>10}"]
+
+    def walk(span: Span, depth: int) -> None:
+        kids = children.get(span.span_id, [])
+        child_s = sum(k.duration_s for k in kids)
+        label = ("  " * depth) + span.name[:48]
+        flag = " !" if span.status != "ok" else ""
+        lines.append(
+            f"{label:<{width}}  {span.duration_s * 1e3:>10.3f}  "
+            f"{max(span.duration_s - child_s, 0.0) * 1e3:>10.3f}{flag}"
+        )
+        if depth + 1 < max_depth:
+            for kid in kids:
+                walk(kid, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    if session.dropped:
+        lines.append(f"... {session.dropped} spans dropped (session cap)")
+    return "\n".join(lines)

@@ -1,0 +1,138 @@
+"""The stable metric-name registry.
+
+Every metric the system publishes is declared here — name, kind, help,
+label names — so dashboards and tests have one source of truth. Names
+follow Prometheus conventions (``_total`` counters, ``_seconds`` /
+``_bytes`` base units). docs/OBSERVABILITY.md documents every name in
+this table and ``tests/obs/test_metrics.py`` enforces that the two stay
+in sync: renaming a metric is an API change, not a refactor.
+
+:func:`register_all` pre-registers the whole schema into a registry so a
+Prometheus export is complete (zero-valued series are legitimate data:
+"no retries happened" is an answer) — the profile CLI calls it before
+running anything.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .metrics import DEFAULT_BUCKETS, RATIO_BUCKETS, MetricsRegistry, get_registry
+
+# ----------------------------------------------------------- executor/workflow
+NODES_EXECUTED = "keystone_executor_nodes_executed_total"
+MEMO_HITS = "keystone_executor_memo_hits_total"
+NODE_SECONDS = "keystone_executor_node_seconds"
+OPTIMIZE_SECONDS = "keystone_optimizer_seconds"
+RULE_RUNS = "keystone_optimizer_rule_runs_total"
+RULE_REWRITES = "keystone_optimizer_rule_rewrites_total"
+
+# ------------------------------------------------------------------- autocache
+AUTOCACHE_CACHED_NODES = "keystone_autocache_cached_nodes_total"
+AUTOCACHE_HITS = "keystone_autocache_hits_total"
+AUTOCACHE_MISSES = "keystone_autocache_misses_total"
+AUTOCACHE_PROFILE_SECONDS = "keystone_autocache_profile_seconds"
+
+# --------------------------------------------------------------------- solvers
+SOLVER_FIT_SECONDS = "keystone_solver_fit_seconds"
+SOLVER_RUNG_ATTEMPTS = "keystone_solver_rung_attempts_total"
+SOLVER_ITERATIONS = "keystone_solver_iterations_total"
+
+# ---------------------------------------------------------------------- ingest
+INGEST_IMAGES = "keystone_ingest_images_total"
+INGEST_CORRUPT = "keystone_ingest_corrupt_total"
+INGEST_BYTES = "keystone_ingest_bytes_total"
+INGEST_DECODE_SECONDS = "keystone_ingest_decode_seconds_total"
+
+# ----------------------------------------------------------------- reliability
+RELIABILITY_EVENTS = "keystone_reliability_events_total"
+CHECKPOINT_HITS = "keystone_checkpoint_hits_total"
+CHECKPOINT_MISSES = "keystone_checkpoint_misses_total"
+CHECKPOINT_WRITES = "keystone_checkpoint_writes_total"
+
+# ----------------------------------------------------------------- compilation
+XLA_COMPILES = "keystone_xla_compiles_total"
+
+# --------------------------------------------------------------------- serving
+SERVING_REQUESTS = "keystone_serving_requests_total"
+SERVING_BATCHES = "keystone_serving_batches_total"
+SERVING_SHEDS = "keystone_serving_sheds_total"
+SERVING_TIMEOUTS = "keystone_serving_timeouts_total"
+SERVING_RETRIES = "keystone_serving_retries_total"
+SERVING_FAILURES = "keystone_serving_failures_total"
+SERVING_BUCKET_HITS = "keystone_serving_bucket_hits_total"
+SERVING_BUCKET_COMPILES = "keystone_serving_bucket_compiles_total"
+SERVING_LATENCY_SECONDS = "keystone_serving_latency_seconds"
+SERVING_QUEUE_WAIT_SECONDS = "keystone_serving_queue_wait_seconds"
+SERVING_BATCH_OCCUPANCY = "keystone_serving_batch_occupancy"
+
+# ---------------------------------------------------------------------- memory
+MEMORY_IN_USE_BYTES = "keystone_memory_in_use_bytes"
+PEAK_MEMORY_BYTES = "keystone_peak_memory_bytes"
+
+
+# name → (kind, help, label names). Histograms may carry a 4th element
+# naming a bucket preset ("ratio" → RATIO_BUCKETS).
+SCHEMA: Dict[str, Tuple] = {
+    NODES_EXECUTED: ("counter", "Graph nodes executed (memo misses)", ()),
+    MEMO_HITS: ("counter", "Graph-node memo table hits", ()),
+    NODE_SECONDS: ("histogram", "Per-node forced execution wall time (traced runs)", ("op",)),
+    OPTIMIZE_SECONDS: ("histogram", "Whole optimizer-stack runs", ()),
+    RULE_RUNS: ("counter", "Optimizer rule applications", ("rule",)),
+    RULE_REWRITES: ("counter", "Optimizer rule applications that changed the graph", ("rule",)),
+    AUTOCACHE_CACHED_NODES: ("counter", "Cacher nodes inserted by the auto-cache planner", ()),
+    AUTOCACHE_HITS: ("counter", "Re-reads of a cached (Cacher) node's memoized result", ()),
+    AUTOCACHE_MISSES: ("counter", "First executions of a Cacher node", ()),
+    AUTOCACHE_PROFILE_SECONDS: ("histogram", "Auto-cache sample-profiling passes", ()),
+    SOLVER_FIT_SECONDS: ("histogram", "Solver fit wall time", ("solver",)),
+    SOLVER_RUNG_ATTEMPTS: ("counter", "Degradation-ladder rung attempts inside solvers", ("solver",)),
+    SOLVER_ITERATIONS: ("counter", "Host-level solver iterations (e.g. L-BFGS steps)", ("solver",)),
+    INGEST_IMAGES: ("counter", "Records successfully decoded by ingest", ()),
+    INGEST_CORRUPT: ("counter", "Records quarantined by ingest", ()),
+    INGEST_BYTES: ("counter", "Raw bytes read by ingest", ()),
+    INGEST_DECODE_SECONDS: ("counter", "Cumulative decode wall time", ()),
+    RELIABILITY_EVENTS: ("counter", "Recovery-ledger events", ("kind",)),
+    CHECKPOINT_HITS: ("counter", "CheckpointStore lookups that restored a fit", ()),
+    CHECKPOINT_MISSES: ("counter", "CheckpointStore lookups that missed", ()),
+    CHECKPOINT_WRITES: ("counter", "CheckpointStore entries written", ()),
+    XLA_COMPILES: ("counter", "Backend XLA compiles observed by jax.monitoring", ()),
+    SERVING_REQUESTS: ("counter", "Requests served to completion", ()),
+    SERVING_BATCHES: ("counter", "Micro-batches dispatched", ()),
+    SERVING_SHEDS: ("counter", "Requests shed by admission control", ()),
+    SERVING_TIMEOUTS: ("counter", "Requests expired before batch assembly", ()),
+    SERVING_RETRIES: ("counter", "Apply-path retry attempts", ()),
+    SERVING_FAILURES: ("counter", "Requests failed by apply errors", ()),
+    SERVING_BUCKET_HITS: ("counter", "Batches padded onto an already-warm bucket", ()),
+    SERVING_BUCKET_COMPILES: ("counter", "First batches at a cold bucket", ()),
+    SERVING_LATENCY_SECONDS: ("histogram", "End-to-end request latency", ()),
+    SERVING_QUEUE_WAIT_SECONDS: ("histogram", "Submit-to-apply queue wait", ()),
+    SERVING_BATCH_OCCUPANCY: ("histogram", "Batch size / max_batch", (), "ratio"),
+    MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source",)),
+    PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage",)),
+}
+
+ALL_METRIC_NAMES: Tuple[str, ...] = tuple(sorted(SCHEMA))
+
+
+def metric(name: str, registry: MetricsRegistry = None):
+    """Get-or-create a schema metric by name — kind, help text, label
+    names, and bucket preset all come from :data:`SCHEMA`, so call sites
+    can never drift from the documented registry."""
+    registry = registry or get_registry()
+    spec = SCHEMA[name]
+    kind, help_text, labels = spec[0], spec[1], spec[2]
+    if kind == "counter":
+        return registry.counter(name, help_text, labels)
+    if kind == "gauge":
+        return registry.gauge(name, help_text, labels)
+    buckets = RATIO_BUCKETS if len(spec) > 3 and spec[3] == "ratio" else DEFAULT_BUCKETS
+    return registry.histogram(name, help_text, labels, buckets=buckets)
+
+
+def register_all(registry: MetricsRegistry = None) -> MetricsRegistry:
+    """Pre-register every schema metric (idempotent) so exports include
+    zero-valued series. Returns the registry."""
+    registry = registry or get_registry()
+    for name in SCHEMA:
+        metric(name, registry)
+    return registry

@@ -1,0 +1,54 @@
+"""Solver instrumentation helpers: fit spans, ladder-rung iteration spans,
+and host-loop iteration counting.
+
+The solvers' inner loops live in three places with different shapes: the
+compiled BCD/KRR epoch×block scans (one XLA computation — only the whole
+solve is observable from the host), the degradation-ladder rung loop
+(host-level: each rung attempt is a real iteration of the solve-or-shrink
+loop), and scipy's L-BFGS callback (host-level per-step). These helpers
+give all three one vocabulary:
+
+- :func:`fit_span` — ``solver:fit`` span + ``keystone_solver_fit_seconds``
+  histogram around a whole fit;
+- :func:`rung_span` — ``solver:iteration`` child span +
+  ``keystone_solver_rung_attempts_total`` per ladder rung attempt;
+- :func:`count_iteration` — ``keystone_solver_iterations_total`` +
+  a span event per host-level optimizer step.
+
+All are free when neither a span session nor the metric has consumers —
+counters are cheap dict increments; spans no-op without a session.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+from . import names, spans
+
+
+@contextmanager
+def fit_span(solver: str, **attributes: Any) -> Iterator[None]:
+    t0 = time.perf_counter()
+    try:
+        with spans.span("solver:fit", solver=solver, **attributes):
+            yield
+    finally:
+        names.metric(names.SOLVER_FIT_SECONDS).observe(
+            time.perf_counter() - t0, solver=solver
+        )
+
+
+@contextmanager
+def rung_span(solver: str, rung: Any, index: int) -> Iterator[None]:
+    names.metric(names.SOLVER_RUNG_ATTEMPTS).inc(solver=solver)
+    with spans.span(
+        "solver:iteration", solver=solver, rung=str(rung), rung_index=index
+    ):
+        yield
+
+
+def count_iteration(solver: str, n: int = 1, **attributes: Any) -> None:
+    names.metric(names.SOLVER_ITERATIONS).inc(n, solver=solver)
+    spans.add_span_event("solver:step", solver=solver, **attributes)

@@ -1,0 +1,290 @@
+"""Hierarchical spans: the trace substrate every layer reports into.
+
+One :class:`TraceSession` collects the spans of one instrumented run
+(a ``keystone-tpu profile`` invocation, a ``workflow.tracing.trace()``
+block, a bench leg). Spans nest through a per-thread stack —
+``span("fit")`` inside ``span("pipeline")`` parents automatically — and
+cross *threads* through explicit context handoff: a serving request
+captures :func:`current_context` at submit time and the worker thread
+re-parents its batch/request spans under it via :func:`attach`, so a
+request's trace id survives submit → batch assembly → apply.
+
+Design constraints (the serving 5%-overhead budget):
+
+- **Inactive is free.** With no session installed, ``span()`` yields a
+  shared no-op without allocating a record, and ``add_span_event`` is a
+  single global read. Instrumentation can therefore stay in hot paths
+  permanently.
+- **Stdlib-only at import.** Like ``reliability/``, this module must be
+  importable before any jax backend initializes (bench and CLI import it
+  pre-backend).
+
+Spans use ``time.perf_counter`` timestamps relative to the session start;
+the session records a wall-clock anchor so exporters can emit absolute
+times.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+TraceContext = Tuple[str, str]  # (trace_id, span_id)
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@dataclass
+class SpanEvent:
+    name: str
+    ts_s: float  # perf_counter timestamp
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Span:
+    """One finished (or in-flight) timed operation."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: Optional[str]
+    start_s: float
+    end_s: Optional[float] = None
+    attributes: Dict[str, Any] = field(default_factory=dict)
+    events: List[SpanEvent] = field(default_factory=list)
+    status: str = "ok"
+    thread_id: int = 0
+    thread_name: str = ""
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_s if self.end_s is not None else self.start_s) - self.start_s
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        self.events.append(SpanEvent(name, time.perf_counter(), dict(attributes)))
+
+    def context(self) -> TraceContext:
+        return (self.trace_id, self.span_id)
+
+
+class _NoopSpan:
+    """Shared do-nothing span yielded when no session is active."""
+
+    __slots__ = ()
+    name = ""
+    span_id = ""
+    trace_id = ""
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attributes: Any) -> None:
+        pass
+
+    def context(self) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class TraceSession:
+    """Bounded collector of the spans of one instrumented run."""
+
+    def __init__(self, name: str = "trace", max_spans: int = 100_000):
+        self.name = name
+        self.trace_id = _new_id()
+        self.started_unix = time.time()
+        self.started_s = time.perf_counter()
+        self.max_spans = max_spans
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._spans: List[Span] = []
+
+    def add(self, span: Span) -> None:
+        with self._lock:
+            if len(self._spans) >= self.max_spans:
+                self.dropped += 1
+                return
+            self._spans.append(span)
+
+    def spans(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def find(self, name_prefix: str) -> List[Span]:
+        return [s for s in self.spans() if s.name.startswith(name_prefix)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+# ------------------------------------------------------------ active state
+
+_session: Optional[TraceSession] = None
+_session_lock = threading.Lock()
+_state = threading.local()  # .stack: List[Span], .attached: TraceContext
+
+
+def active_session() -> Optional[TraceSession]:
+    return _session
+
+
+def _stack() -> List[Span]:
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = []
+        _state.stack = stack
+    return stack
+
+
+@contextmanager
+def tracing_session(
+    name: str = "trace", max_spans: int = 100_000
+) -> Iterator[TraceSession]:
+    """Install a process-wide :class:`TraceSession`. Nested calls reuse the
+    outer session (the yielded object is the ACTIVE session, which is what
+    exporters should read)."""
+    global _session
+    with _session_lock:
+        if _session is not None:
+            outer = _session
+            nested = True
+        else:
+            outer = TraceSession(name, max_spans=max_spans)
+            _session = outer
+            nested = False
+    try:
+        yield outer
+    finally:
+        if not nested:
+            with _session_lock:
+                _session = None
+
+
+@contextmanager
+def span(name: str, **attributes: Any):
+    """Open a child span of the current thread's active span (or of the
+    attached remote context, or a session root). No-op without a session."""
+    session = _session
+    if session is None:
+        yield NOOP_SPAN
+        return
+    stack = _stack()
+    if stack:
+        trace_id, parent_id = stack[-1].trace_id, stack[-1].span_id
+    else:
+        attached: Optional[TraceContext] = getattr(_state, "attached", None)
+        if attached is not None:
+            trace_id, parent_id = attached
+        else:
+            trace_id, parent_id = session.trace_id, None
+    thread = threading.current_thread()
+    record = Span(
+        name=name,
+        trace_id=trace_id,
+        span_id=_new_id(),
+        parent_id=parent_id,
+        start_s=time.perf_counter(),
+        attributes=dict(attributes),
+        thread_id=thread.ident or 0,
+        thread_name=thread.name,
+    )
+    stack.append(record)
+    try:
+        yield record
+    except BaseException as exc:
+        record.status = "error"
+        record.add_event(
+            "exception", type=type(exc).__name__, message=str(exc)[:200]
+        )
+        raise
+    finally:
+        record.end_s = time.perf_counter()
+        stack.pop()
+        session.add(record)
+
+
+def record_span(
+    name: str,
+    start_s: float,
+    end_s: float,
+    parent: Optional[TraceContext] = None,
+    **attributes: Any,
+) -> Optional[Span]:
+    """Synthesize an already-finished span from measured timestamps (the
+    serving worker reconstructs request spans from queue/apply timings this
+    way). ``parent`` re-parents it under a captured context."""
+    session = _session
+    if session is None:
+        return None
+    if parent is not None:
+        trace_id, parent_id = parent
+    else:
+        trace_id, parent_id = session.trace_id, None
+    thread = threading.current_thread()
+    record = Span(
+        name=name,
+        trace_id=trace_id,
+        span_id=_new_id(),
+        parent_id=parent_id,
+        start_s=start_s,
+        end_s=end_s,
+        attributes=dict(attributes),
+        thread_id=thread.ident or 0,
+        thread_name=thread.name,
+    )
+    session.add(record)
+    return record
+
+
+def current_span():
+    """The innermost active span on this thread (NOOP_SPAN when none)."""
+    if _session is None:
+        return NOOP_SPAN
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else NOOP_SPAN
+
+
+def current_context() -> Optional[TraceContext]:
+    """(trace_id, span_id) handoff token for cross-thread continuation, or
+    None when not tracing."""
+    if _session is None:
+        return None
+    stack = getattr(_state, "stack", None)
+    if stack:
+        return stack[-1].context()
+    return (_session.trace_id, "")
+
+
+def add_span_event(name: str, **attributes: Any) -> None:
+    """Attach an event to the current span; single global read when
+    tracing is off, so callers (retry loops, ladders) never gate on it."""
+    if _session is None:
+        return
+    stack = getattr(_state, "stack", None)
+    if stack:
+        stack[-1].add_event(name, **attributes)
+
+
+@contextmanager
+def attach(context: Optional[TraceContext]) -> Iterator[None]:
+    """Continue a trace captured on another thread: spans opened inside
+    parent under ``context`` instead of starting a new root."""
+    prev = getattr(_state, "attached", None)
+    _state.attached = context
+    try:
+        yield
+    finally:
+        _state.attached = prev

@@ -22,6 +22,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ...data.dataset import ArrayDataset, Dataset
+from ...obs import solver as solver_obs
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
 from ...reliability import DegradationLadder, halving_rungs, probe
@@ -142,12 +143,17 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             halving_rungs(block0, max(block0 // 4, 1)),
             label="BlockLeastSquaresEstimator.fit",
         )
-        if stream:
-            model = ladder.run(lambda block: self._fit_streaming(
-                features, targets, mesh, block))
-        else:
-            model = ladder.run(lambda block: self._fit_in_core(
-                features, targets, mesh, block))
+        fit_impl = self._fit_streaming if stream else self._fit_in_core
+        attempts = iter(range(len(ladder.rungs)))
+
+        def attempt(block):
+            with solver_obs.rung_span("block_ls", block, next(attempts)):
+                return fit_impl(features, targets, mesh, block)
+
+        with solver_obs.fit_span(
+            "block_ls", d=d, epochs=self.num_iter, streaming=stream
+        ):
+            model = ladder.run(attempt)
         if ladder.reduced:
             model.degradation = dict(ladder.record)
         return model

@@ -163,7 +163,18 @@ class KernelRidgeRegression(LabelEstimator):
             halving_rungs(bs0, max(bs0 // 4, 1)),
             label="KernelRidgeRegression.fit",
         )
-        model = ladder.run(lambda bs: self._fit_with_block(features, targets, bs))
+        from ...obs import solver as solver_obs
+
+        attempts = iter(range(len(ladder.rungs)))
+
+        def attempt(bs):
+            with solver_obs.rung_span("kernel_ridge", bs, next(attempts)):
+                return self._fit_with_block(features, targets, bs)
+
+        with solver_obs.fit_span(
+            "kernel_ridge", n=n, epochs=self.num_epochs
+        ):
+            model = ladder.run(attempt)
         if ladder.reduced:
             model.degradation = dict(ladder.record)
         return model

@@ -197,6 +197,11 @@ def _sparse_lbfgs_host(mat, y, reg, num_iterations, memory_size, tol):
         return value, grad.ravel()
 
     def stop_on_grad_norm(xk):
+        from ...obs import solver as solver_obs
+
+        solver_obs.count_iteration(
+            "sparse_lbfgs", grad_norm=round(last_grad_norm[0], 8)
+        )
         # The last gradient the line search evaluated is at (or adjacent
         # to) the accepted iterate xk — close enough for a stop test.
         if last_grad_norm[0] <= tol:

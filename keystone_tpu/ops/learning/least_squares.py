@@ -95,6 +95,7 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
 
     # default implementation when node-level optimization never ran
     def fit(self, data: Dataset, labels: Dataset) -> Transformer:
+        from ...obs import solver as solver_obs
         from ...reliability import DegradationLadder, probe
 
         # Solver-grade degradation (the Panther mindset, PAPERS.md): when
@@ -114,12 +115,16 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
             label="LeastSquaresEstimator.fit",
         )
 
-        def attempt(rung):
-            _, factory = rung
-            probe("LeastSquaresEstimator.solve")
-            return factory().fit(data, labels)
+        attempts = iter(range(len(ladder.rungs)))
 
-        model = ladder.run(attempt)
+        def attempt(rung):
+            name, factory = rung
+            probe("LeastSquaresEstimator.solve")
+            with solver_obs.rung_span("least_squares", name, next(attempts)):
+                return factory().fit(data, labels)
+
+        with solver_obs.fit_span("least_squares"):
+            model = ladder.run(attempt)
         if ladder.reduced:
             record = dict(
                 ladder.record, rung=ladder.record["rung"][0],

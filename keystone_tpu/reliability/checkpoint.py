@@ -30,9 +30,18 @@ import pickle
 import tempfile
 from typing import Any, Callable, Dict, Optional
 
+from ..obs import names as _names
 from .recovery import get_recovery_log
 
 _MISS = object()
+
+
+def _store_counters():
+    return (
+        _names.metric(_names.CHECKPOINT_HITS),
+        _names.metric(_names.CHECKPOINT_MISSES),
+        _names.metric(_names.CHECKPOINT_WRITES),
+    )
 
 
 # ------------------------------------------------------------ stable digests
@@ -136,17 +145,21 @@ class CheckpointStore:
         """Stored value for ``prefix``, or the module ``_MISS`` sentinel.
         Pass ``digest`` when already computed — digesting walks the prefix
         tree and content-hashes its datasets, which is not free."""
+        hits_c, misses_c, _ = _store_counters()
         entry = self._entry(digest or prefix_digest(prefix))
         if not os.path.exists(entry):
             self.misses += 1
+            misses_c.inc()
             return _MISS
         try:
             with open(entry, "rb") as f:
                 value = pickle.load(f)
         except Exception:
             self.misses += 1
+            misses_c.inc()
             return _MISS
         self.hits += 1
+        hits_c.inc()
         return value
 
     def save(self, prefix: Any, value: Any, digest: Optional[str] = None) -> bool:
@@ -170,6 +183,7 @@ class CheckpointStore:
                 pass
             return False
         self.writes += 1
+        _store_counters()[2].inc()
         return True
 
     def get_or_compute(
@@ -181,7 +195,10 @@ class CheckpointStore:
             get_recovery_log().record("checkpoint_hit", label, digest=digest[:12])
             return value
         value = thunk()
-        self.save(prefix, value, digest=digest)
+        if self.save(prefix, value, digest=digest):
+            # Saves are recovery-relevant state changes too: a resumed run
+            # reads them back, so surface them next to hits in traces.
+            get_recovery_log().record("checkpoint_save", label, digest=digest[:12])
         return value
 
     def stats(self) -> Dict[str, int]:

@@ -5,6 +5,14 @@ just that it did.
 The log is module-global (like ``PipelineEnv``) and reset alongside it —
 ``PipelineEnv.reset()`` clears both, so tests stay isolated without a
 second fixture.
+
+Since the observability PR the ledger is also a *publisher*: every
+``record()`` increments the ``keystone_reliability_events_total{kind=...}``
+counter and, when a span session is active, attaches a
+``reliability:<kind>`` event to the current span — so retries, ladder rung
+transitions, and checkpoint save/restores show up inline in Chrome traces
+and Prometheus snapshots, not only in ledger summaries
+(docs/OBSERVABILITY.md; cross-linked from docs/RELIABILITY.md).
 """
 
 from __future__ import annotations
@@ -12,6 +20,9 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List
+
+from ..obs import names as _names
+from ..obs import spans as _spans
 
 
 @dataclass
@@ -31,6 +42,13 @@ class RecoveryLog:
     def record(self, kind: str, label: str, **detail: Any) -> None:
         with self._lock:
             self._events.append(RecoveryEvent(kind, label, dict(detail)))
+        # Publish beyond the ledger: counter always (cheap), span event
+        # only under an active trace session (free otherwise).
+        _names.metric(_names.RELIABILITY_EVENTS).inc(kind=kind)
+        _spans.add_span_event(f"reliability:{kind}", label=label, **{
+            k: v for k, v in detail.items()
+            if isinstance(v, (bool, int, float, str))
+        })
 
     def events(self, kind: str = None) -> List[RecoveryEvent]:
         with self._lock:

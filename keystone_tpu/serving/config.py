@@ -125,6 +125,12 @@ class Request:
     deadline: Optional[Any] = None  # reliability.Deadline
     enqueued_at: float = field(default_factory=time.monotonic)
     request_id: int = field(default_factory=lambda: next(_request_ids))
+    # Trace handoff (obs.spans): set at submit time only when a span
+    # session is active, so the worker thread can parent this request's
+    # spans under the submitter's trace. (trace_id, span_id) + the
+    # perf_counter submit timestamp.
+    trace_ctx: Optional[Any] = None
+    trace_start_s: Optional[float] = None
 
     def expired(self) -> bool:
         return self.deadline is not None and self.deadline.expired()

@@ -31,6 +31,7 @@ import time
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Sequence
 
+from ..obs import spans as _spans
 from ..reliability.faultinject import probe
 from .admission import AdmissionController
 from .batcher import MicroBatcher
@@ -176,6 +177,12 @@ class PipelineServer:
         request = Request(
             payload=payload, model=model or self.default_model, deadline=deadline
         )
+        if _spans.active_session() is not None:
+            # Carry the submitter's trace to the worker thread: batch and
+            # request spans re-parent under this context (docs/OBSERVABILITY.md).
+            request.trace_ctx = _spans.current_context()
+            request.trace_start_s = time.perf_counter()
+            _spans.add_span_event("serving.submit", request_id=request.request_id)
         if not self.batcher.offer(request):  # raced to hard-full
             self.telemetry.record_shed()
             raise RequestShed(f"queue hard-full ({self.batcher.capacity})")
@@ -265,15 +272,34 @@ class PipelineServer:
 
     def _apply_group(self, model_name: str, group: List[Request]) -> None:
         t_apply = time.monotonic()
-        try:
-            entry = self.registry.resolve(model_name)
-            rows = self._apply_padded(entry, [r.payload for r in group])
-        except Exception as exc:
-            self.telemetry.record_failure(len(group))
-            for req in group:
-                _settle_exception(req.future, exc)
-            return
+        # Worker-side batch span, re-parented under the FIRST member's
+        # submit context (one batch serves many traces; Perfetto still
+        # shows every member via the request spans recorded below).
+        with _spans.attach(group[0].trace_ctx), _spans.span(
+            "serve:batch", model=model_name, size=len(group)
+        ):
+            try:
+                entry = self.registry.resolve(model_name)
+                rows = self._apply_padded(entry, [r.payload for r in group])
+            except Exception as exc:
+                self.telemetry.record_failure(len(group))
+                for req in group:
+                    _settle_exception(req.future, exc)
+                return
         done = time.monotonic()
+        done_perf = time.perf_counter()
+        for req in group:
+            if req.trace_ctx is not None and req.trace_start_s is not None:
+                _spans.record_span(
+                    "serve:request",
+                    req.trace_start_s,
+                    done_perf,
+                    parent=req.trace_ctx,
+                    request_id=req.request_id,
+                    model=model_name,
+                    batch_size=len(group),
+                    queue_wait_ms=round((t_apply - req.enqueued_at) * 1e3, 3),
+                )
         if len(rows) < len(group):
             # A model may legally return fewer logical rows than it was
             # given (e.g. a filtering ObjectDataset transformer) — the

@@ -3,9 +3,16 @@ hit rate, shed/timeout counters.
 
 Snapshot-oriented (``snapshot()`` returns a plain dict the CLI prints and
 the bench embeds in ``BENCH_*.json``) plus a rate-limited periodic log
-line for long-running servers. Stdlib-only: percentiles are computed from
-a bounded ring of samples with ``statistics``-free interpolation so the
-module imports before any backend initializes.
+line for long-running servers. Stdlib-only, importable pre-backend.
+
+The percentile math now lives in :mod:`keystone_tpu.obs.metrics` (this
+module re-exports it unchanged), and every recording call ALSO publishes
+into the process-wide metrics registry — ``keystone_serving_*`` counters
+and histograms — so a Prometheus export or bench metrics snapshot sees
+serving next to executor/reliability metrics. Per-instance windows are
+kept for ``snapshot()`` so two servers in one process don't blend their
+percentiles; the registry series aggregate across servers, as process-
+level metrics should.
 """
 
 from __future__ import annotations
@@ -14,21 +21,23 @@ import logging
 import threading
 import time
 from collections import deque
-from typing import Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, Optional
 
-
-def percentile(samples: Sequence[float], q: float) -> float:
-    """Linear-interpolated percentile (q in [0, 100]) of ``samples``."""
-    if not samples:
-        return 0.0
-    data = sorted(samples)
-    if len(data) == 1:
-        return float(data[0])
-    rank = (q / 100.0) * (len(data) - 1)
-    lo = int(rank)
-    hi = min(lo + 1, len(data) - 1)
-    frac = rank - lo
-    return float(data[lo] * (1.0 - frac) + data[hi] * frac)
+from ..obs import metrics as _metrics
+from ..obs.metrics import RATIO_BUCKETS, percentile  # noqa: F401  (re-export)
+from ..obs.names import (
+    SERVING_BATCH_OCCUPANCY,
+    SERVING_BATCHES,
+    SERVING_BUCKET_COMPILES,
+    SERVING_BUCKET_HITS,
+    SERVING_FAILURES,
+    SERVING_LATENCY_SECONDS,
+    SERVING_QUEUE_WAIT_SECONDS,
+    SERVING_REQUESTS,
+    SERVING_RETRIES,
+    SERVING_SHEDS,
+    SERVING_TIMEOUTS,
+)
 
 
 class ServingTelemetry:
@@ -57,6 +66,22 @@ class ServingTelemetry:
         self.bucket_hits = 0      # batch padded to an already-warm bucket
         self.bucket_compiles = 0  # first batch at a bucket (warm-up compile)
         self._warm_buckets: set = set()
+        # Registry handles resolved once (hot-path: no name lookups per
+        # request). These aggregate across all servers in the process.
+        registry = _metrics.get_registry()
+        self._m_requests = registry.counter(SERVING_REQUESTS, "Requests served to completion")
+        self._m_batches = registry.counter(SERVING_BATCHES, "Micro-batches dispatched")
+        self._m_sheds = registry.counter(SERVING_SHEDS, "Requests shed by admission control")
+        self._m_timeouts = registry.counter(SERVING_TIMEOUTS, "Requests expired before batch assembly")
+        self._m_retries = registry.counter(SERVING_RETRIES, "Apply-path retry attempts")
+        self._m_failures = registry.counter(SERVING_FAILURES, "Requests failed by apply errors")
+        self._m_bucket_hits = registry.counter(SERVING_BUCKET_HITS, "Batches padded onto an already-warm bucket")
+        self._m_bucket_compiles = registry.counter(SERVING_BUCKET_COMPILES, "First batches at a cold bucket")
+        self._m_latency = registry.histogram(SERVING_LATENCY_SECONDS, "End-to-end request latency")
+        self._m_queue_wait = registry.histogram(SERVING_QUEUE_WAIT_SECONDS, "Submit-to-apply queue wait")
+        self._m_occupancy = registry.histogram(
+            SERVING_BATCH_OCCUPANCY, "Batch size / max_batch", buckets=RATIO_BUCKETS
+        )
 
     # --------------------------------------------------------------- recording
     def record_request(self, latency_s: float, queue_wait_s: float) -> None:
@@ -64,6 +89,9 @@ class ServingTelemetry:
             self.served += 1
             self._latencies_s.append(latency_s)
             self._queue_waits_s.append(queue_wait_s)
+        self._m_requests.inc()
+        self._m_latency.observe(latency_s)
+        self._m_queue_wait.observe(queue_wait_s)
 
     def record_batch(self, size: int, bucket: int, max_batch: int) -> None:
         with self._lock:
@@ -71,9 +99,14 @@ class ServingTelemetry:
             self._occupancies.append(size / float(max_batch))
             if bucket in self._warm_buckets:
                 self.bucket_hits += 1
+                hit = True
             else:
                 self._warm_buckets.add(bucket)
                 self.bucket_compiles += 1
+                hit = False
+        self._m_batches.inc()
+        self._m_occupancy.observe(size / float(max_batch))
+        (self._m_bucket_hits if hit else self._m_bucket_compiles).inc()
 
     def mark_bucket_warm(self, bucket: int) -> None:
         """Pre-declare a bucket as compiled (AOT warmup path), so the
@@ -84,18 +117,22 @@ class ServingTelemetry:
     def record_shed(self) -> None:
         with self._lock:
             self.sheds += 1
+        self._m_sheds.inc()
 
     def record_timeout(self) -> None:
         with self._lock:
             self.timeouts += 1
+        self._m_timeouts.inc()
 
     def record_retry(self) -> None:
         with self._lock:
             self.retries += 1
+        self._m_retries.inc()
 
     def record_failure(self, n: int = 1) -> None:
         with self._lock:
             self.failures += n
+        self._m_failures.inc(n)
 
     # --------------------------------------------------------------- snapshots
     def snapshot(self, queue_depth: Optional[int] = None) -> Dict[str, object]:

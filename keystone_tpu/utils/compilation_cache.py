@@ -80,6 +80,12 @@ def install_compile_counter() -> Callable[[], int]:
             def _listener(event: str, duration: float, **kw) -> None:
                 if _COMPILE_EVENT_SUBSTRING in event:
                     _compile_events["count"] += 1
+                    # Mirror into the metrics registry so Prometheus
+                    # snapshots carry the compile count without callers
+                    # having to diff compile_count() themselves.
+                    from ..obs import names as _names
+
+                    _names.metric(_names.XLA_COMPILES).inc()
 
             jax.monitoring.register_event_duration_secs_listener(_listener)
             _counter_installed = True

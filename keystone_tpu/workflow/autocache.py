@@ -30,6 +30,8 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ..obs import names as _names
+from ..obs import spans as _spans
 from .graph import Graph, NodeId, SinkId, SourceId
 from .operators import (
     DatasetOperator,
@@ -239,23 +241,30 @@ class AutoCacheRule(Rule):
         if full_n == 0:
             return {}
         samples: Dict[NodeId, List[SampleProfile]] = {}
-        for scale in self.profile_scales:
-            for _ in range(self.num_trials):
-                interp = _ProfilingInterpreter(graph, scale, clock=self.clock)
-                try:
-                    for sink in graph.sinks:
-                        interp.execute(sink)
-                except Exception as e:
-                    # unbound sources etc.: no profile, no caching
-                    logging.getLogger(__name__).warning(
-                        "auto-cache profiling failed (%s): running without "
-                        "cache planning", e,
-                    )
-                    return {}
-                for n, t in interp.times.items():
-                    samples.setdefault(n, []).append(
-                        SampleProfile(scale, t, interp.sizes.get(n, 0))
-                    )
+        t_profile = time.perf_counter()
+        with _spans.span(
+            "autocache:profile", scales=str(self.profile_scales), full_n=full_n
+        ):
+            for scale in self.profile_scales:
+                for _ in range(self.num_trials):
+                    interp = _ProfilingInterpreter(graph, scale, clock=self.clock)
+                    try:
+                        for sink in graph.sinks:
+                            interp.execute(sink)
+                    except Exception as e:
+                        # unbound sources etc.: no profile, no caching
+                        logging.getLogger(__name__).warning(
+                            "auto-cache profiling failed (%s): running without "
+                            "cache planning", e,
+                        )
+                        return {}
+                    for n, t in interp.times.items():
+                        samples.setdefault(n, []).append(
+                            SampleProfile(scale, t, interp.sizes.get(n, 0))
+                        )
+        _names.metric(_names.AUTOCACHE_PROFILE_SECONDS).observe(
+            time.perf_counter() - t_profile
+        )
         return {n: _fit_linear(obs, full_n) for n, obs in samples.items() if obs}
 
     # ------------------------------------------------------------- cost model
@@ -341,6 +350,13 @@ class AutoCacheRule(Rule):
             )
             selected = self._greedy_select(graph, dependents, profiles, candidates, budget)
 
+        if selected:
+            _names.metric(_names.AUTOCACHE_CACHED_NODES).inc(len(selected))
+            _spans.add_span_event(
+                "autocache_selected",
+                nodes=len(selected),
+                strategy=self.strategy,
+            )
         for node in sorted(selected):
             graph = _insert_cacher_after(graph, node, CacherOperator(level="hbm"))
         return graph, prefixes

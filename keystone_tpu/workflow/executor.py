@@ -19,12 +19,32 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from ..obs import names as _names
+from ..obs import spans as _spans
 from ..reliability import faultinject
 from ..reliability.recovery import reset_recovery_log
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
 from .operators import EstimatorOperator, Expression
 from .prefix import Prefix, find_prefix
 from .tracing import timed_execute
+
+
+def _executor_counters():
+    """Resolve the executor's always-on counters (schema-driven). Cached
+    per GraphExecutor (executors are per-application, so a test-time
+    registry reset can't strand handles for long)."""
+    return (
+        _names.metric(_names.NODES_EXECUTED),
+        _names.metric(_names.MEMO_HITS),
+        _names.metric(_names.AUTOCACHE_HITS),
+        _names.metric(_names.AUTOCACHE_MISSES),
+    )
+
+
+def _is_cacher(op) -> bool:
+    from ..ops.util.misc import CacherOperator
+
+    return isinstance(op, CacherOperator)
 
 
 class PipelineEnv:
@@ -82,6 +102,7 @@ class GraphExecutor:
         self._optimized: Optional[Graph] = None
         self._prefixes: Dict[NodeId, Prefix] = {}
         self._memo: Dict[GraphId, Expression] = {}
+        self._counters = None  # resolved lazily, once per executor
 
     @property
     def graph(self) -> Graph:
@@ -89,7 +110,10 @@ class GraphExecutor:
         if self._optimized is None:
             if self._optimize:
                 env = PipelineEnv.get_or_create()
-                self._optimized, self._prefixes = env.optimizer.execute(self._raw_graph)
+                with _spans.span("optimize"):
+                    self._optimized, self._prefixes = env.optimizer.execute(
+                        self._raw_graph
+                    )
             else:
                 self._optimized = self._raw_graph
         return self._optimized
@@ -100,7 +124,17 @@ class GraphExecutor:
 
     def execute(self, graph_id: GraphId) -> Expression:
         graph = self.graph
+        if self._counters is None:
+            self._counters = _executor_counters()
+        nodes_c, memo_c, cache_hit_c, cache_miss_c = self._counters
         if graph_id in self._memo:
+            # Memo hits are the executor-level reuse signal; hits on Cacher
+            # nodes specifically are the auto-cache planner's payoff (each
+            # one is a recomputation of the cached subtree avoided).
+            if isinstance(graph_id, NodeId):
+                memo_c.inc()
+                if _is_cacher(graph.get_operator(graph_id)):
+                    cache_hit_c.inc()
             return self._memo[graph_id]
         if isinstance(graph_id, SourceId):
             raise ValueError(
@@ -113,6 +147,9 @@ class GraphExecutor:
 
         deps = [self.execute(d) for d in graph.get_dependencies(graph_id)]
         op = graph.get_operator(graph_id)
+        nodes_c.inc()
+        if _is_cacher(op):
+            cache_miss_c.inc()
         expression = timed_execute(op, deps)
 
         prefix = self._prefixes.get(graph_id)

@@ -14,9 +14,12 @@ enabling cross-pipeline reuse of fit estimator work.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..obs import names as _names
+from ..obs import spans as _spans
 from .analysis import get_ancestors
 from .graph import Graph, NodeId, SinkId, SourceId
 from .operators import DelegatingOperator, EstimatorOperator, ExpressionOperator
@@ -55,18 +58,33 @@ class RuleExecutor:
         self.batches = list(batches)
 
     def execute(self, graph: Graph, prefixes: Optional[PrefixMap] = None) -> Tuple[Graph, PrefixMap]:
+        runs_c = _names.metric(_names.RULE_RUNS)
+        rewrites_c = _names.metric(_names.RULE_REWRITES)
         prefixes = dict(prefixes or {})
-        for batch in self.batches:
-            iterations = batch.max_iterations if batch.fixed_point else 1
-            for _ in range(iterations):
-                before = graph
-                for rule in batch.rules:
-                    new_graph, prefixes = rule.apply(graph, prefixes)
-                    if logger.isEnabledFor(logging.DEBUG) and new_graph != graph:
-                        logger.debug("rule %s rewrote graph:\n%s", rule.name, new_graph.to_dot())
-                    graph = new_graph
-                if graph == before:
-                    break
+        t0 = time.perf_counter()
+        with _spans.span("optimize:rules", batches=len(self.batches)):
+            for batch in self.batches:
+                iterations = batch.max_iterations if batch.fixed_point else 1
+                with _spans.span(f"optimize:batch:{batch.name}"):
+                    for _ in range(iterations):
+                        before = graph
+                        for rule in batch.rules:
+                            new_graph, prefixes = rule.apply(graph, prefixes)
+                            runs_c.inc(rule=rule.name)
+                            if new_graph != graph:
+                                rewrites_c.inc(rule=rule.name)
+                                _spans.add_span_event(
+                                    "rule_rewrite", rule=rule.name
+                                )
+                                if logger.isEnabledFor(logging.DEBUG):
+                                    logger.debug(
+                                        "rule %s rewrote graph:\n%s",
+                                        rule.name, new_graph.to_dot(),
+                                    )
+                            graph = new_graph
+                        if graph == before:
+                            break
+        _names.metric(_names.OPTIMIZE_SECONDS).observe(time.perf_counter() - t0)
         return graph, prefixes
 
 

@@ -1,17 +1,24 @@
-"""Per-operator execution tracing.
+"""Per-operator execution tracing, now backed by the unified span layer.
 
 The reference's observability is (1) per-rule DOT logging
-(reference: workflow/RuleExecutor.scala:42-49) — covered by
-``Graph.to_dot``/rule logging here — and (2) the AutoCacheRule profiler
-that eagerly executes scaled samples under ``System.nanoTime``
-(reference: workflow/AutoCacheRule.scala:153-465) — covered by
-``workflow/autocache.py``. This module adds the per-op timeline the
-reference lacked: wrap any pipeline execution in ``trace()`` and every
-operator's forced execution is timed.
+(reference: workflow/RuleExecutor.scala:42-49) and (2) the AutoCacheRule
+profiler that eagerly executes scaled samples under ``System.nanoTime``
+(reference: workflow/AutoCacheRule.scala:153-465). This module adds the
+per-op timeline the reference lacked — and since the observability PR it
+is a thin compatibility shim over :mod:`keystone_tpu.obs.spans`:
+``trace()`` opens a real :class:`~keystone_tpu.obs.spans.TraceSession`
+with a ``pipeline`` root span, each forced operator becomes a
+``node:<label>`` child span (exportable as a Chrome trace via
+``obs.export``), and node wall times land in the
+``keystone_executor_node_seconds`` histogram. The legacy
+:class:`PipelineTrace` view (``timings`` / ``report()``) is preserved so
+existing callers and tests keep working unchanged.
 
 Timing forces each operator's lazy result (and on accelerators blocks on a
 scalar fetch) — tracing is a profiling mode, not a zero-cost observer;
-laziness across operators is preserved apart from the forcing.
+laziness across operators is preserved apart from the forcing. The same
+forcing applies under any active ``obs.spans`` session (e.g. the
+``keystone-tpu profile`` CLI) even when no ``trace()`` shim is active.
 """
 
 from __future__ import annotations
@@ -22,6 +29,10 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
 
+from ..obs import names as _names
+from ..obs import spans as _spans
+from ..obs.device import device_annotation
+
 
 @dataclass
 class OpTiming:
@@ -31,7 +42,11 @@ class OpTiming:
 
 @dataclass
 class PipelineTrace:
+    """Back-compat flat view of one traced run; ``session`` carries the
+    underlying span session for callers that want the hierarchy."""
+
     timings: List[OpTiming] = field(default_factory=list)
+    session: Optional[Any] = None  # obs.spans.TraceSession
 
     def record(self, label: str, seconds: float) -> None:
         self.timings.append(OpTiming(label, seconds))
@@ -65,12 +80,19 @@ def trace():
     >>> with trace() as t:
     ...     pipeline(data).get()
     >>> print(t.report())
+
+    Also opens (or joins) an ``obs.spans`` tracing session with a
+    ``pipeline`` root span, so ``t.session`` can be exported with
+    ``obs.export.write_chrome_trace`` after the block.
     """
     prev = current_trace()
     tr = PipelineTrace()
     _local.trace = tr
     try:
-        yield tr
+        with _spans.tracing_session("pipeline") as session:
+            tr.session = session
+            with _spans.span("pipeline"):
+                yield tr
     finally:
         _local.trace = prev
 
@@ -97,14 +119,26 @@ def _force(value: Any) -> None:
         pass
 
 
+def _node_seconds_hist():
+    return _names.metric(_names.NODE_SECONDS)
+
+
 def timed_execute(op, deps):
-    """Execute ``op`` under the active trace (or plainly if none)."""
+    """Execute ``op`` under the active trace/span session (or plainly if
+    neither is active)."""
     tr = current_trace()
+    session = _spans.active_session()
     expression = op.execute(deps)
-    if tr is None:
+    if tr is None and session is None:
         return expression
-    label = getattr(op, "label", type(op).__name__)
-    start = time.perf_counter()
-    _force(expression.get())
-    tr.record(str(label), time.perf_counter() - start)
+    label = str(getattr(op, "label", type(op).__name__))
+    with _spans.span(f"node:{label}", op=type(op).__name__) as sp:
+        with device_annotation(f"keystone/node/{label}"):
+            start = time.perf_counter()
+            _force(expression.get())
+            seconds = time.perf_counter() - start
+        sp.set_attribute("seconds", round(seconds, 6))
+    if tr is not None:
+        tr.record(label, seconds)
+    _node_seconds_hist().observe(seconds, op=label)
     return expression

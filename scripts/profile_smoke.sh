@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# Profile smoke test: run `keystone-tpu profile` on the synthetic pipeline
+# and assert both export artifacts are produced, non-empty, and loadable —
+# the Chrome trace with nested pipeline → node → solver spans, the
+# Prometheus snapshot with executor/autocache/reliability/serving metric
+# families. Exercises the exact path docs/OBSERVABILITY.md documents.
+#
+# Usage: scripts/profile_smoke.sh [out_dir]
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+OUT="${1:-$(mktemp -d)}"
+mkdir -p "$OUT"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+timeout -k 10 280 python -m keystone_tpu profile \
+    --rows 64 --num-ffts 1 --block-size 32 --serve-requests 8 \
+    --out "$OUT" > "$OUT/profile_stdout.txt"
+
+python - "$OUT" <<'EOF'
+import json, sys, os
+out = sys.argv[1]
+trace_path = os.path.join(out, "profile_trace.json")
+prom_path = os.path.join(out, "profile_metrics.prom")
+assert os.path.getsize(trace_path) > 0, "empty chrome trace"
+assert os.path.getsize(prom_path) > 0, "empty prometheus snapshot"
+
+trace = json.load(open(trace_path))
+events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+assert events, "no complete events in chrome trace"
+by_id = {e["args"]["span_id"]: e for e in events}
+def chain(e):
+    seen = [e["name"]]
+    while e["args"].get("parent_id") in by_id:
+        e = by_id[e["args"]["parent_id"]]
+        seen.append(e["name"])
+    return seen[::-1]
+chains = [chain(e) for e in events if e["name"] == "solver:iteration"]
+assert any("profile" in c and any(n.startswith("node:") for n in c) for c in chains), \
+    f"no pipeline->node->solver-iteration nesting: {chains}"
+assert any(e["name"] == "serve:request" for e in events), "no request spans"
+
+prom = open(prom_path).read()
+for family in ("keystone_executor_nodes_executed_total",
+               "keystone_autocache_cached_nodes_total",
+               "keystone_reliability_events_total",
+               "keystone_serving_requests_total",
+               "keystone_serving_latency_seconds"):
+    assert family in prom, f"missing {family} in prometheus export"
+
+stdout = open(os.path.join(out, "profile_stdout.txt")).read()
+summary = [l for l in stdout.splitlines() if l.startswith("PROFILE_JSON:")]
+assert len(summary) == 1, "missing PROFILE_JSON summary line"
+s = json.loads(summary[0][len("PROFILE_JSON:"):])
+assert s["spans"] > 10, s
+print(f"profile_smoke OK: {s['spans']} spans, fit={s['fit_s']}s, "
+      f"serve_rps={s.get('serve', {}).get('rps')}, artifacts in {out}")
+EOF

@@ -1,0 +1,97 @@
+"""Exporter correctness: Chrome trace JSON round-trips with valid
+``ph``/``ts``/``dur``; Prometheus output parses line-by-line; the human
+report renders the hierarchy."""
+
+import json
+import re
+
+from keystone_tpu.obs import spans
+from keystone_tpu.obs.export import chrome_trace, prometheus_text, report
+from keystone_tpu.obs.metrics import MetricsRegistry
+
+
+def _session_with_tree():
+    with spans.tracing_session("export-test") as session:
+        with spans.span("pipeline"):
+            with spans.span("node:featurize", op="Featurize") as sp:
+                sp.add_event("checkpoint", digest="abc")
+            with spans.span("node:solve"):
+                with spans.span("solver:iteration", rung_index=0):
+                    pass
+    return session
+
+
+def test_chrome_trace_round_trips_with_valid_fields():
+    session = _session_with_tree()
+    payload = json.loads(json.dumps(chrome_trace(session)))
+    events = payload["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    assert len(complete) == 4
+    for e in complete:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert e["pid"] > 0 and e["tid"] > 0
+        assert e["args"]["span_id"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(instants) == 1 and instants[0]["name"] == "checkpoint"
+    metas = [e for e in events if e["ph"] == "M"]
+    assert metas and metas[0]["name"] == "thread_name"
+    assert payload["otherData"]["trace_id"] == session.trace_id
+
+
+def test_chrome_trace_children_contained_in_parents():
+    session = _session_with_tree()
+    events = [e for e in chrome_trace(session)["traceEvents"] if e["ph"] == "X"]
+    by_id = {e["args"]["span_id"]: e for e in events}
+    for e in events:
+        parent = by_id.get(e["args"].get("parent_id"))
+        if parent is None:
+            continue
+        assert parent["ts"] <= e["ts"]
+        assert e["ts"] + e["dur"] <= parent["ts"] + parent["dur"] + 1e-3
+
+
+_PROM_LINE = re.compile(
+    r"^(?:# (?:HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(?:\{[^}]*\})? -?(?:[0-9.e+-]+|\+Inf|NaN))$"
+)
+
+
+def test_prometheus_output_parses_line_by_line():
+    reg = MetricsRegistry()
+    c = reg.counter("keystone_test_total", "a counter", ("kind",))
+    c.inc(3, kind='we"ird\nlabel')  # escaping must keep the line one line
+    g = reg.gauge("keystone_test_bytes", "a gauge")
+    g.set(12.5)
+    h = reg.histogram("keystone_test_seconds", "a histogram", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = prometheus_text(reg)
+    lines = text.strip().splitlines()
+    for line in lines:
+        assert _PROM_LINE.match(line), f"unparseable prometheus line: {line!r}"
+    # histogram structure: cumulative buckets, +Inf == count
+    buckets = [l for l in lines if l.startswith("keystone_test_seconds_bucket")]
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    inf_line = [l for l in buckets if 'le="+Inf"' in l]
+    count_line = [l for l in lines if l.startswith("keystone_test_seconds_count")]
+    assert inf_line[0].rsplit(" ", 1)[1] == count_line[0].rsplit(" ", 1)[1]
+
+
+def test_prometheus_zero_series_metrics_still_exported():
+    reg = MetricsRegistry()
+    reg.counter("keystone_idle_total", "never incremented")
+    reg.counter("keystone_labeled_total", "no series yet", ("k",))
+    text = prometheus_text(reg)
+    assert "keystone_idle_total 0" in text
+    assert "# TYPE keystone_labeled_total counter" in text
+
+
+def test_report_renders_hierarchy_and_durations():
+    session = _session_with_tree()
+    text = report(session)
+    assert "pipeline" in text
+    assert "  node:featurize" in text  # indented child
+    assert "    solver:iteration" in text  # grandchild
+    assert "ms" in text.splitlines()[0]

@@ -1,0 +1,169 @@
+"""Cross-layer observability: executor node spans, reliability events as
+span events + counters, serving trace propagation and registry parity."""
+
+import numpy as np
+
+from keystone_tpu.obs import metrics, names, spans
+
+
+def _counter_value(name, **labels):
+    metric = metrics.get_registry().get(name)
+    if metric is None:
+        return 0.0
+    return metric.value(**labels)
+
+
+def test_trace_shim_produces_nested_node_spans_and_metrics():
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.stats.core import LinearRectifier, NormalizeRows
+    from keystone_tpu.workflow.tracing import trace
+
+    executed_before = _counter_value(names.NODES_EXECUTED)
+    ds = ArrayDataset(
+        np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    )
+    pipeline = LinearRectifier(0.0).to_pipeline() >> NormalizeRows()
+    with trace() as t:
+        pipeline(ds).get()
+    # legacy flat view still works
+    assert any("NormalizeRows" in x.label for x in t.timings)
+    assert "TOTAL" in t.report()
+    # hierarchy: node spans parented under the pipeline root
+    roots = [s for s in t.session.spans() if s.parent_id is None]
+    assert [s.name for s in roots] == ["pipeline"]
+    node_spans = t.session.find("node:")
+    assert {s.parent_id for s in node_spans} == {roots[0].span_id}
+    # node wall-time histogram populated for the traced ops
+    hist = metrics.get_registry().get(names.NODE_SECONDS)
+    assert hist.count(op="NormalizeRows") >= 1
+    # executor counters moved
+    assert _counter_value(names.NODES_EXECUTED) > executed_before
+
+
+def test_reliability_events_publish_counters_and_span_events():
+    from keystone_tpu.reliability.retry import RetryPolicy
+
+    before = _counter_value(names.RELIABILITY_EVENTS, kind="retry")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("UNAVAILABLE: transient")
+        return "ok"
+
+    with spans.tracing_session() as session:
+        with spans.span("work"):
+            policy = RetryPolicy(max_attempts=3, base_delay_s=0.0, sleep=lambda s: None)
+            assert policy.call(flaky, label="probe") == "ok"
+    assert _counter_value(names.RELIABILITY_EVENTS, kind="retry") == before + 2
+    (work,) = session.find("work")
+    retry_events = [e for e in work.events if e.name == "reliability:retry"]
+    assert len(retry_events) == 2
+    assert retry_events[0].attributes["label"] == "probe"
+
+
+def test_degradation_ladder_rungs_surface_as_events():
+    from keystone_tpu.reliability.degrade import DegradationLadder
+
+    before = _counter_value(names.RELIABILITY_EVENTS, kind="degrade")
+
+    def attempt(rung):
+        if rung > 1:
+            raise MemoryError("RESOURCE_EXHAUSTED: oom")
+        return rung
+
+    with spans.tracing_session() as session:
+        with spans.span("solve"):
+            ladder = DegradationLadder([4, 2, 1], label="test-ladder")
+            assert ladder.run(attempt) == 1
+    assert _counter_value(names.RELIABILITY_EVENTS, kind="degrade") == before + 1
+    (solve,) = session.find("solve")
+    assert any(e.name == "reliability:degrade" for e in solve.events)
+
+
+def test_checkpoint_store_counters(tmp_path):
+    from keystone_tpu.reliability.checkpoint import CheckpointStore
+    from keystone_tpu.workflow.pipeline import Transformer
+
+    class Tagged(Transformer):
+        def __init__(self, tag):
+            self.tag = tag
+
+        def apply(self, x):
+            return x
+
+    from keystone_tpu.workflow.prefix import Prefix
+
+    prefix = Prefix((Tagged("a"), ()))
+    hits0 = _counter_value(names.CHECKPOINT_HITS)
+    misses0 = _counter_value(names.CHECKPOINT_MISSES)
+    writes0 = _counter_value(names.CHECKPOINT_WRITES)
+    store = CheckpointStore(str(tmp_path))
+    assert store.get_or_compute(prefix, lambda: "value") == "value"  # miss+write
+    assert store.get_or_compute(prefix, lambda: "other") == "value"  # hit
+    assert _counter_value(names.CHECKPOINT_HITS) == hits0 + 1
+    assert _counter_value(names.CHECKPOINT_MISSES) == misses0 + 1
+    assert _counter_value(names.CHECKPOINT_WRITES) == writes0 + 1
+
+
+def test_serving_traces_propagate_submit_to_apply():
+    from keystone_tpu.serving import PipelineServer, ServingConfig
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    served0 = _counter_value(names.SERVING_REQUESTS)
+    fp = synthetic_fitted_pipeline(d=8, depth=1)
+    with spans.tracing_session() as session:
+        with spans.span("client") as client:
+            server = PipelineServer(
+                fp, config=ServingConfig(max_batch=4, max_wait_ms=1.0)
+            ).start()
+            try:
+                futures = [
+                    server.submit(np.zeros((8,), np.float32)) for _ in range(3)
+                ]
+                for f in futures:
+                    f.result(timeout=30)
+            finally:
+                server.stop()
+    # every request span re-parents under the submitting client span
+    request_spans = session.find("serve:request")
+    assert len(request_spans) == 3
+    assert {s.parent_id for s in request_spans} == {client.span_id}
+    assert {s.trace_id for s in request_spans} == {client.trace_id}
+    batch_spans = session.find("serve:batch")
+    assert batch_spans and all(s.trace_id == client.trace_id for s in batch_spans)
+    # submit events landed on the client span
+    submit_events = [e for e in client.events if e.name == "serving.submit"]
+    assert len(submit_events) == 3
+    # registry parity: the serving counters moved with telemetry
+    assert _counter_value(names.SERVING_REQUESTS) == served0 + 3
+
+
+def test_serving_without_session_keeps_requests_unannotated():
+    from keystone_tpu.serving import PipelineServer, ServingConfig
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    fp = synthetic_fitted_pipeline(d=8, depth=1)
+    server = PipelineServer(
+        fp, config=ServingConfig(max_batch=4, max_wait_ms=1.0)
+    ).start()
+    try:
+        future = server.submit(np.zeros((8,), np.float32))
+        future.result(timeout=30)
+    finally:
+        server.stop()
+    # no session → no trace context captured, no span machinery engaged
+    assert spans.active_session() is None
+
+
+def test_rule_executor_metrics_and_optimize_span():
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.stats.core import LinearRectifier
+
+    runs0 = _counter_value(names.RULE_RUNS, rule="EquivalentNodeMergeRule")
+    ds = ArrayDataset(np.ones((4, 3), np.float32))
+    with spans.tracing_session() as session:
+        LinearRectifier(0.0).to_pipeline()(ds).get()
+    assert _counter_value(names.RULE_RUNS, rule="EquivalentNodeMergeRule") > runs0
+    assert session.find("optimize")  # optimizer ran under a span

@@ -1,0 +1,128 @@
+"""Metrics registry: counters/gauges/histograms, percentile parity with
+the previous ServingTelemetry math, and the stable name schema."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.obs import metrics, names
+from keystone_tpu.obs.metrics import (
+    Counter,
+    Histogram,
+    MetricsRegistry,
+    delta,
+    percentile,
+)
+
+
+def test_counter_labels_and_totals():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "help", ("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="a")
+    c.inc(kind="b")
+    assert c.value(kind="a") == 3
+    assert c.total() == 4
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="a")
+    with pytest.raises(ValueError):
+        c.inc(kind="a", extra="nope")
+
+
+def test_registry_get_or_create_idempotent_and_type_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total")
+    assert reg.counter("x_total") is a
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("k",))
+
+
+def test_gauge_set_inc_max():
+    reg = MetricsRegistry()
+    g = reg.gauge("mem_bytes", labels=("stage",))
+    g.set(100, stage="fit")
+    g.max(50, stage="fit")
+    assert g.value(stage="fit") == 100
+    g.max(200, stage="fit")
+    assert g.value(stage="fit") == 200
+    g.inc(5, stage="fit")
+    assert g.value(stage="fit") == 205
+
+
+def test_histogram_buckets_cumulative_and_window():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", buckets=(0.01, 0.1, 1.0), window=4)
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    assert h.count() == 4
+    assert h.sum() == pytest.approx(5.555)
+    # window bounded: oldest evicted
+    for v in (0.2, 0.3):
+        h.observe(v)
+    assert h.count() == 6  # cumulative count keeps everything
+    assert h.percentile(100) == pytest.approx(5.0)  # window kept [0.5,5,.2,.3]
+
+
+def test_histogram_percentiles_match_serving_telemetry_previous_values():
+    """The satellite contract: identical latency inputs → identical p50/
+    p95/p99 between the absorbed Histogram math and what ServingTelemetry
+    reports (which used this interpolation from PR 2 on)."""
+    from keystone_tpu.serving.telemetry import ServingTelemetry
+
+    rng = np.random.default_rng(3)
+    latencies = rng.gamma(2.0, 0.01, size=257).tolist()
+
+    telemetry = ServingTelemetry(window=2048)
+    for lat in latencies:
+        telemetry.record_request(latency_s=lat, queue_wait_s=lat / 3)
+    snap = telemetry.snapshot()
+
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", window=2048)
+    for lat in latencies:
+        h.observe(lat)
+    for q, key in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+        assert round(h.percentile(q) * 1e3, 3) == snap[key]
+    # and the serving module's percentile() is literally the obs one
+    from keystone_tpu.serving import telemetry as serving_telemetry
+
+    assert serving_telemetry.percentile is percentile
+
+
+def test_snapshot_and_delta():
+    reg = MetricsRegistry()
+    c = reg.counter("a_total")
+    h = reg.histogram("b_seconds")
+    c.inc(2)
+    before = reg.snapshot()
+    c.inc(3)
+    h.observe(0.5)
+    moved = delta(reg.snapshot(), before)
+    assert moved["a_total"] == 3
+    assert moved["b_seconds_count"] == 1
+    assert moved["b_seconds_sum"] == pytest.approx(0.5)
+    assert "untouched" not in moved
+
+
+def test_schema_registers_cleanly_and_is_documented():
+    reg = MetricsRegistry()
+    names.register_all(reg)
+    registered = set(reg.names())
+    assert registered == set(names.ALL_METRIC_NAMES)
+    # every name in the stable registry is documented
+    import os
+
+    docs = open(
+        os.path.join(os.path.dirname(__file__), "..", "..", "docs", "OBSERVABILITY.md")
+    ).read()
+    missing = [n for n in names.ALL_METRIC_NAMES if n not in docs]
+    assert not missing, f"metric names undocumented in docs/OBSERVABILITY.md: {missing}"
+
+
+def test_register_all_idempotent_on_global_registry():
+    names.register_all()
+    names.register_all()  # second call must not raise or duplicate
+    reg = metrics.get_registry()
+    for name in names.ALL_METRIC_NAMES:
+        assert reg.get(name) is not None

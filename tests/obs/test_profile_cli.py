@@ -1,0 +1,77 @@
+"""``keystone-tpu profile``: flag surface (fast) and the full instrumented
+run (slow — covered in CI by scripts/profile_smoke.sh as well)."""
+
+import argparse
+import json
+import os
+
+import pytest
+
+from keystone_tpu.obs.profile import add_profile_arguments
+
+
+def test_profile_flags_parse_jax_free():
+    parser = argparse.ArgumentParser()
+    add_profile_arguments(parser)
+    args = parser.parse_args(
+        ["--rows", "64", "--num-ffts", "1", "--out", "/tmp/x", "--no-serve"]
+    )
+    assert args.rows == 64 and args.num_ffts == 1 and args.no_serve
+
+
+def test_profile_subcommand_listed_in_cli():
+    from keystone_tpu.cli import main
+
+    import io
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["--list"]) == 0
+    assert "profile" in buf.getvalue()
+
+
+@pytest.mark.slow
+def test_profile_cli_end_to_end(tmp_path):
+    """Acceptance: a Perfetto-loadable Chrome trace with nested
+    pipeline → node → solver-iteration spans plus a Prometheus snapshot
+    spanning executor, autocache, reliability, and serving metrics."""
+    from keystone_tpu.cli import main
+
+    rc = main([
+        "profile", "--rows", "64", "--num-ffts", "1", "--block-size", "32",
+        "--serve-requests", "4", "--out", str(tmp_path),
+    ])
+    assert rc == 0
+
+    trace = json.loads((tmp_path / "profile_trace.json").read_text())
+    events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert events, "empty chrome trace"
+    by_id = {e["args"]["span_id"]: e for e in events}
+
+    def chain(event):
+        out = [event["name"]]
+        while event["args"].get("parent_id") in by_id:
+            event = by_id[event["args"]["parent_id"]]
+            out.append(event["name"])
+        return list(reversed(out))
+
+    iteration_chains = [
+        chain(e) for e in events if e["name"] == "solver:iteration"
+    ]
+    assert any(
+        "profile" in c and any(n.startswith("node:") for n in c)
+        for c in iteration_chains
+    ), f"no pipeline → node → solver-iteration chain: {iteration_chains}"
+    assert any(e["name"].startswith("serve:request") for e in events)
+
+    prom = (tmp_path / "profile_metrics.prom").read_text()
+    assert prom.strip()
+    for family in (
+        "keystone_executor_nodes_executed_total",
+        "keystone_autocache_cached_nodes_total",
+        "keystone_reliability_events_total",
+        "keystone_serving_requests_total",
+    ):
+        assert family in prom, f"missing {family} in prometheus export"
+    assert 'keystone_serving_requests_total' in prom

@@ -1,0 +1,104 @@
+"""Span semantics: nesting, ids, cross-thread handoff, no-op fast path."""
+
+import threading
+
+from keystone_tpu.obs import spans
+
+
+def test_nesting_parents_and_trace_ids():
+    with spans.tracing_session("t") as session:
+        with spans.span("outer", kind="test") as outer:
+            with spans.span("inner") as inner:
+                assert inner.parent_id == outer.span_id
+                assert inner.trace_id == outer.trace_id == session.trace_id
+            with spans.span("sibling") as sib:
+                assert sib.parent_id == outer.span_id
+    finished = session.spans()
+    assert [s.name for s in finished] == ["inner", "sibling", "outer"]
+    assert finished[-1].parent_id is None
+    assert all(s.end_s >= s.start_s for s in finished)
+
+
+def test_no_session_is_noop():
+    assert spans.active_session() is None
+    with spans.span("anything") as sp:
+        assert sp is spans.NOOP_SPAN
+        sp.set_attribute("k", "v")  # must not raise
+        sp.add_event("e")
+    assert spans.current_context() is None
+    spans.add_span_event("nothing")  # must not raise
+
+
+def test_attributes_and_events():
+    with spans.tracing_session() as session:
+        with spans.span("op", x=1) as sp:
+            sp.set_attribute("y", 2)
+            spans.add_span_event("milestone", stage="mid")
+    (finished,) = session.spans()
+    assert finished.attributes == {"x": 1, "y": 2}
+    assert finished.events[0].name == "milestone"
+    assert finished.events[0].attributes == {"stage": "mid"}
+
+
+def test_error_status_and_exception_event():
+    with spans.tracing_session() as session:
+        try:
+            with spans.span("boom"):
+                raise ValueError("bad")
+        except ValueError:
+            pass
+    (finished,) = session.spans()
+    assert finished.status == "error"
+    assert finished.events[0].attributes["type"] == "ValueError"
+
+
+def test_cross_thread_attach_parents_under_submitter():
+    captured = {}
+    with spans.tracing_session() as session:
+        with spans.span("request") as req:
+            ctx = spans.current_context()
+            assert ctx == (req.trace_id, req.span_id)
+
+        def worker():
+            with spans.attach(ctx):
+                with spans.span("batch") as sp:
+                    captured["parent"] = sp.parent_id
+                    captured["trace"] = sp.trace_id
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert captured["parent"] == req.span_id
+    assert captured["trace"] == req.trace_id
+
+
+def test_record_span_synthesizes_finished_span():
+    with spans.tracing_session() as session:
+        with spans.span("submit") as sub:
+            ctx = sub.context()
+        rec = spans.record_span("later", 1.0, 2.5, parent=ctx, k="v")
+        assert rec.parent_id == sub.span_id
+        assert abs(rec.duration_s - 1.5) < 1e-9
+    assert "later" in [s.name for s in session.spans()]
+    # without a session it degrades to None, never an error
+    assert spans.record_span("nope", 0.0, 1.0) is None
+
+
+def test_session_cap_drops_and_counts():
+    with spans.tracing_session(max_spans=2) as session:
+        for i in range(4):
+            with spans.span(f"s{i}"):
+                pass
+    assert len(session) == 2
+    assert session.dropped == 2
+
+
+def test_nested_sessions_reuse_outer():
+    with spans.tracing_session("outer") as a:
+        with spans.tracing_session("inner") as b:
+            assert a is b
+            with spans.span("x"):
+                pass
+        assert spans.active_session() is a  # inner exit keeps outer installed
+    assert spans.active_session() is None
+    assert [s.name for s in a.spans()] == ["x"]
