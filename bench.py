@@ -1077,6 +1077,51 @@ def _bench_serving(small: bool) -> dict:
     return out
 
 
+def _bench_fusion(small: bool) -> dict:
+    """Whole-pipeline fusion (docs/OPTIMIZER.md): an 8-node dense chain
+    applied through a FittedPipeline both fused (ONE XLA dispatch per
+    batch) and unfused (8 dispatches + 8 host syncs per batch). Reports
+    wall time and the measured dispatches-per-apply for each — the
+    dispatch counter is the invariant scripts/fusion_smoke.sh gates CI
+    on, the wall ratio is the dispatch-amortization payoff (largest on
+    relay-backed attachments where the round trip is ~100 ms)."""
+    import numpy as np
+
+    import jax
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.obs import names as obs_names
+    from keystone_tpu.serving.synthetic import synthetic_chain_pipeline
+
+    nodes = 8
+    d = 128 if small else 512
+    n = 256 if small else 1024
+    iters = 20 if small else 50
+    x = np.random.default_rng(5).normal(size=(n, d)).astype(np.float32)
+    out: dict = {"chain_nodes": nodes, "d": d, "n": n, "iters": iters}
+    counter = obs_names.metric(obs_names.FUSION_BATCH_DISPATCHES)
+
+    for fused in (True, False):
+        fp = synthetic_chain_pipeline(num_nodes=nodes, d=d, seed=5, fused=fused)
+        apply = fp.compiled_apply()
+        jax.block_until_ready(apply(ArrayDataset(x)).data)  # warm/compile
+        before = counter.value(fused="1") + counter.value(fused="0")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            result = apply(ArrayDataset(x))
+        jax.block_until_ready(result.data)
+        wall = time.perf_counter() - t0
+        dispatches = counter.value(fused="1") + counter.value(fused="0") - before
+        key = "fused" if fused else "unfused"
+        out[f"{key}_wall_s"] = round(wall, 4)
+        out[f"{key}_apply_ms"] = round(wall / iters * 1e3, 3)
+        out[f"{key}_dispatches_per_apply"] = round(dispatches / iters, 2)
+    out["fused_speedup"] = round(
+        out["unfused_wall_s"] / max(out["fused_wall_s"], 1e-9), 2
+    )
+    return out
+
+
 def _workload_registry() -> dict:
     # ORDER IS THE MEASURING PRIORITY: cheap, headline-bearing legs
     # first, so a budget-capped run (KEYSTONE_BENCH_MEASURE_BUDGET — the
@@ -1086,6 +1131,7 @@ def _workload_registry() -> dict:
         "timit_exact": _bench_timit_exact,
         "gram_mfu": _bench_gram_mfu,
         "timit_wide_block": _bench_timit_wide_block,
+        "fusion": _bench_fusion,
         "serving": _bench_serving,
         "ingest": _bench_ingest,
         "imagenet_fv": _bench_imagenet_fv,

@@ -27,6 +27,13 @@ OPTIMIZE_SECONDS = "keystone_optimizer_seconds"
 RULE_RUNS = "keystone_optimizer_rule_runs_total"
 RULE_REWRITES = "keystone_optimizer_rule_rewrites_total"
 
+# ---------------------------------------------------------------------- fusion
+FUSION_CHAINS = "keystone_fusion_chains_total"
+FUSION_FUSED_NODES = "keystone_fusion_fused_nodes_total"
+FUSION_DISPATCHES_SAVED = "keystone_fusion_dispatches_saved_total"
+FUSION_COMPILES = "keystone_fusion_compiles_total"
+FUSION_BATCH_DISPATCHES = "keystone_fusion_batch_dispatches_total"
+
 # ------------------------------------------------------------------- autocache
 AUTOCACHE_CACHED_NODES = "keystone_autocache_cached_nodes_total"
 AUTOCACHE_HITS = "keystone_autocache_hits_total"
@@ -80,6 +87,11 @@ SCHEMA: Dict[str, Tuple] = {
     OPTIMIZE_SECONDS: ("histogram", "Whole optimizer-stack runs", ()),
     RULE_RUNS: ("counter", "Optimizer rule applications", ("rule",)),
     RULE_REWRITES: ("counter", "Optimizer rule applications that changed the graph", ("rule",)),
+    FUSION_CHAINS: ("counter", "Fused operator chains created by NodeFusionRule", ()),
+    FUSION_FUSED_NODES: ("counter", "Member transformer nodes absorbed into fused operators", ()),
+    FUSION_DISPATCHES_SAVED: ("counter", "Per-execution dispatches avoided by fusion (members-1 per chain)", ()),
+    FUSION_COMPILES: ("counter", "Fused-chain executable traces (one per new shape/dtype)", ()),
+    FUSION_BATCH_DISPATCHES: ("counter", "Transformer batch-apply dispatches, split fused vs unfused", ("fused",)),
     AUTOCACHE_CACHED_NODES: ("counter", "Cacher nodes inserted by the auto-cache planner", ()),
     AUTOCACHE_HITS: ("counter", "Re-reads of a cached (Cacher) node's memoized result", ()),
     AUTOCACHE_MISSES: ("counter", "First executions of a Cacher node", ()),

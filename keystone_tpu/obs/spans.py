@@ -99,10 +99,25 @@ NOOP_SPAN = _NoopSpan()
 
 
 class TraceSession:
-    """Bounded collector of the spans of one instrumented run."""
+    """Bounded collector of the spans of one instrumented run.
 
-    def __init__(self, name: str = "trace", max_spans: int = 100_000):
+    ``sync_timings`` declares whether this session needs REAL per-node
+    device timings: when True (default — profiling sessions), the
+    executor's ``timed_execute`` blocks on device results per node so a
+    node span's duration is the node's work; when False, spans record
+    dispatch time only and async dispatch between nodes is preserved
+    (the right trade for sessions that exist to collect counters and
+    coarse phase spans, e.g. metrics-only serving runs).
+    """
+
+    def __init__(
+        self,
+        name: str = "trace",
+        max_spans: int = 100_000,
+        sync_timings: bool = True,
+    ):
         self.name = name
+        self.sync_timings = sync_timings
         self.trace_id = _new_id()
         self.started_unix = time.time()
         self.started_s = time.perf_counter()
@@ -151,18 +166,18 @@ def _stack() -> List[Span]:
 
 @contextmanager
 def tracing_session(
-    name: str = "trace", max_spans: int = 100_000
+    name: str = "trace", max_spans: int = 100_000, sync_timings: bool = True
 ) -> Iterator[TraceSession]:
     """Install a process-wide :class:`TraceSession`. Nested calls reuse the
     outer session (the yielded object is the ACTIVE session, which is what
-    exporters should read)."""
+    exporters should read — including its ``sync_timings`` choice)."""
     global _session
     with _session_lock:
         if _session is not None:
             outer = _session
             nested = True
         else:
-            outer = TraceSession(name, max_spans=max_spans)
+            outer = TraceSession(name, max_spans=max_spans, sync_timings=sync_timings)
             _session = outer
             nested = False
     try:

@@ -214,8 +214,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         else:
             xc = linalg.prepare_row_sharded(xc, mesh)
             yc = linalg.prepare_row_sharded(yc, mesh)
+            # xc/yc are private centered copies, dead after the solve —
+            # donate them so the epoch×block scan reuses their HBM for
+            # the carried predictions and per-block Gram workspace
+            # instead of keeping raw + centered copies both resident.
             w = linalg.block_coordinate_descent(
-                xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block, mesh=mesh
+                xc, yc, reg=reg, num_epochs=self.num_iter, block_size=block,
+                mesh=mesh, donate_xy=True,
             )
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a

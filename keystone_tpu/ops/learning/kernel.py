@@ -276,6 +276,11 @@ class KernelBlockLinearMapper(BatchTransformer):
     dataflow: the train/dual shards travel the ICI ring while test rows
     stay put — the same schedule as ring attention)."""
 
+    # Manages its own sharded placement + ring dispatch: composing this
+    # apply_arrays inside another operator's jit would re-trace the
+    # device_put/shard_map choreography — keep it a standalone dispatch.
+    fusable = False
+
     def __init__(self, train: jnp.ndarray, duals: jnp.ndarray, gamma: float,
                  num_train: int, block_size: int):
         self.train = train      # (n_pad, d) row-sharded

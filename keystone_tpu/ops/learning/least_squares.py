@@ -14,6 +14,12 @@ pass; machine count from the mesh. Cost formulas mirror the reference's
 (flops / bytes-scanned / network per solver), with the caveat the
 reference itself documents: the weights were fit on its 16-node cluster
 and should be re-fit per deployment.
+
+HBM discipline: the exact normal-equation rung (``LinearMapEstimator``)
+and the block rung both donate their private row-sharded data copies
+into the solve (``donate_xy`` in parallel/linalg.py), so the update's
+Gram/residual workspace reuses the data buffers instead of doubling
+residency — same pattern as conv_block.py's donated prediction carry.
 """
 
 from __future__ import annotations

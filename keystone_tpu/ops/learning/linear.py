@@ -87,9 +87,16 @@ class LinearMapEstimator(LabelEstimator):
             # the env var after import and must get the Gram speed they
             # asked for.
             gram_precision, refine_steps = linalg.precision_for_mode(mode), 0
+        # Donate the row-sharded copies into the fused normal-equation
+        # solve (frees the dominant (n, d) buffer for Gram/residual
+        # temporaries) — but ONLY when prepare_row_sharded actually
+        # copied: if the dataset's own device arrays came back unchanged,
+        # donating would invalidate data the pipeline may re-read.
+        donate = x is not features.data and y is not targets.data
         w, mu_a, mu_b = linalg.centered_solve_refined(
             x, y, n, self.reg or 0.0, mesh=mesh,
             gram_precision=gram_precision, refine_steps=refine_steps,
+            donate_xy=donate,
         )
         if not self.reg:  # singular-risk case only: fail loudly, not NaN
             linalg.check_finite(w, "LinearMapEstimator (reg=0)")

@@ -18,6 +18,7 @@ jitted; shapes are static.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional, Tuple
 
 import numpy as np
@@ -148,6 +149,27 @@ def _mode_cached(maxsize=None):
 mode_cached = _mode_cached  # public name for other modules' compiled-fn factories
 
 
+_DONATION_WARNING_RE = "Some donated buffers were not usable"
+
+
+def _quiet_unused_donation_warnings() -> None:
+    """Ensure a filter for jax's "Some donated buffers were not usable"
+    warning is present. This package DELIBERATELY marks whole data
+    matrices as donors for the solves' temporaries (jax.buffer_donor);
+    backends that can't exploit that (host CPU aliasing is input→output
+    only) warn per compile, which would read as a bug to an operator
+    when it is the documented best-effort behavior. Called from the
+    donating code paths — not at import — so a process that never uses
+    these solvers keeps jax's diagnostic for its own donations. The
+    presence check is against the live filter list (not a once-flag):
+    pytest/catch_warnings scopes restore the list behind our back, and
+    a stale flag would leave later compiles un-silenced."""
+    for f in warnings.filters:
+        if f[0] == "ignore" and f[1] is not None and f[1].pattern == _DONATION_WARNING_RE:
+            return
+    warnings.filterwarnings("ignore", message=_DONATION_WARNING_RE)
+
+
 def _row_sharded(mesh: Mesh, a: jnp.ndarray) -> jnp.ndarray:
     spec = P(row_axes(mesh), *([None] * (a.ndim - 1)))
     target = NamedSharding(mesh, spec)
@@ -244,6 +266,7 @@ def _centered_solve_fused_fn(
     refine_steps: int,
     resid_precision: lax.Precision,
     gram_perturb: float = 0.0,
+    donate_xy: bool = False,
 ):
     """ONE jitted computation: sharded Gram + algebraic centering +
     replicated Cholesky solve + optional mixed-precision iterative
@@ -372,7 +395,14 @@ def _centered_solve_fused_fn(
         w_final = lax.cond(failed, highest_fallback, lambda _: w, None)
         return w_final, mu_a, mu_b
 
-    return jax.jit(run)
+    # donate_xy: the (n, d)/(n, k) inputs dominate HBM during the solve;
+    # when the caller owns them (fresh row-sharded copies, as in
+    # LinearMapEstimator.fit) donation frees their buffers into the
+    # computation for Gram/residual temporaries. The normal-equation
+    # update passes (IR residual recomputation) still read x/y — XLA
+    # keeps the storage live exactly as long as needed; only the caller's
+    # handle dies.
+    return jax.jit(run, donate_argnums=(0, 1) if donate_xy else ())
 
 
 def centered_solve_refined(
@@ -384,19 +414,24 @@ def centered_solve_refined(
     gram_precision: lax.Precision = None,
     refine_steps: int = 0,
     resid_precision: lax.Precision = lax.Precision.HIGHEST,
+    donate_xy: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Centered ridge solve (w, μ_a, μ_b) in one dispatch, with optional
     mixed-precision iterative refinement (see _centered_solve_fused_fn).
 
     ``x``/``y`` must be row-sharded (zero-padded rows allowed); ``n`` is
-    the true (unpadded) row count.
+    the true (unpadded) row count. ``donate_xy=True`` donates the data
+    buffers into the solve — only when the caller owns them (their
+    handles are invalidated).
     """
     mesh = mesh or get_mesh()
     if gram_precision is None:
         gram_precision = _solver_precision()
+    if donate_xy:
+        _quiet_unused_donation_warnings()
     fn = _centered_solve_fused_fn(
         mesh, gram_precision, int(refine_steps), resid_precision,
-        float(_TEST_GRAM_PERTURB),
+        float(_TEST_GRAM_PERTURB), bool(donate_xy),
     )
     return fn(x, y, jnp.float32(n), jnp.float32(reg))
 
@@ -459,7 +494,10 @@ def normal_equations_solve(
     """One-shot distributed least squares: x = (AᵀA + λI)⁻¹ Aᵀb.
 
     Gram + replicated Cholesky fused into ONE dispatch (one relay
-    round trip, docs/PERFORMANCE.md on why that matters here).
+    round trip, docs/PERFORMANCE.md on why that matters here). Callers
+    that own private copies of the data and want them donated into the
+    solve should use :func:`centered_solve_refined` with ``donate_xy``
+    (the exact-solver path LinearMapEstimator takes).
     """
     mesh = mesh or get_mesh()
     return _normal_equations_fn(mesh)(a, b, jnp.float32(reg))
@@ -518,6 +556,7 @@ def block_coordinate_descent(
     num_epochs: int,
     block_size: int,
     mesh: Optional[Mesh] = None,
+    donate_xy: bool = False,
 ) -> jnp.ndarray:
     """Least-squares block coordinate descent over feature blocks.
 
@@ -534,17 +573,25 @@ def block_coordinate_descent(
     ``a`` is (n, d) row-sharded (rows may be zero-padded), ``y`` is (n, k).
     ``d`` must be a multiple of ``block_size`` (pad features if needed).
     Returns the (d, k) weight matrix, replicated.
+
+    ``donate_xy=True`` donates the ``a``/``y`` buffers into the solve
+    (caller's handles are invalidated) — pass it when they are private
+    centered copies (block.py's in-core fit does), so the epoch×block
+    scan can reuse their HBM for the carried predictions and Gram
+    workspace instead of holding the copies alive beside them.
     """
     mesh = mesh or get_mesh()
     n, d = a.shape
     if d % block_size != 0:
         raise ValueError(f"d={d} not divisible by block_size={block_size}")
-    fn = _bcd_fn(mesh, num_epochs, block_size)
+    if donate_xy:
+        _quiet_unused_donation_warnings()
+    fn = _bcd_fn(mesh, num_epochs, block_size, bool(donate_xy))
     return fn(a, y, jnp.asarray(reg, dtype=a.dtype))
 
 
 @_mode_cached()
-def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
+def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int, donate_xy: bool = False):
     axes = row_axes(mesh)
 
     def per_device(a_local, y_local, reg):
@@ -579,7 +626,8 @@ def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int):
             mesh=mesh,
             in_specs=(P(axes, None), P(axes, None), P()),
             out_specs=P(),
-        )
+        ),
+        donate_argnums=(0, 1) if donate_xy else (),
     )
 
 
@@ -671,6 +719,14 @@ def block_coordinate_descent_rematerialized(
 def _bcd_stream_step_fn(mesh: Mesh):
     axes = row_axes(mesh)
 
+    # Donation (same idea as conv_block.py's donate_argnums=(3,)): the
+    # streaming caller ping-pongs the (n, k) predictions and the (bs, k)
+    # block weights through this step — the old buffers are dead the
+    # moment the step returns — and the (n, bs) feature panel is a fresh
+    # per-block transfer consumed exactly once. Donating all three lets
+    # XLA alias p/w outputs onto their inputs and reuse the panel's HBM
+    # for temporaries, so per-step residency stays one panel + one
+    # predictions buffer instead of two of each.
     def per_device(a_b_local, mask_local, mu_block, y_local, p_local, w_b, reg):
         bs = a_b_local.shape[1]
         k = y_local.shape[1]
@@ -694,7 +750,8 @@ def _bcd_stream_step_fn(mesh: Mesh):
                 P(axes, None), P(), P(),
             ),
             out_specs=(P(), P(axes, None)),
-        )
+        ),
+        donate_argnums=(0, 4, 5),
     )
 
 
@@ -753,9 +810,13 @@ def block_coordinate_descent_streaming(
     mask_dev = prepare_row_sharded(jnp.asarray(mask), mesh)
     p_dev = prepare_row_sharded(jnp.zeros((n_pad, k), jnp.float32), mesh)
 
+    _quiet_unused_donation_warnings()  # the step donates its spent panel
     step = _bcd_stream_step_fn(mesh)
     reg_dev = jnp.float32(reg)
     w_blocks = [jnp.zeros((bs, k), jnp.float32) for _ in range(num_blocks)]
+    # The step donates its ping-pong carries (predictions + block
+    # weights, aliased in place) and the spent feature panel — the old
+    # handles die with each call, which is exactly the intent here.
     for _ in range(num_epochs):
         for b in range(num_blocks):
             start = b * bs

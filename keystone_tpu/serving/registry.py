@@ -92,10 +92,17 @@ class ModelRegistry:
             return entry
 
     def load_fitted(self, name: str, path: str) -> ModelEntry:
-        """Publish a ``FittedPipeline.save`` artifact."""
+        """Publish a ``FittedPipeline.save`` artifact.
+
+        The loaded graph is re-fused (workflow/fusion.py): artifacts
+        saved before fusion existed — or with fusion disabled — still
+        serve through single-dispatch fused chains, and warmup then
+        warms the fused executables."""
         from ..workflow.pipeline import FittedPipeline
 
-        return self.publish(name, FittedPipeline.load(path), source=f"fitted:{path}")
+        return self.publish(
+            name, FittedPipeline.load(path).fused(), source=f"fitted:{path}"
+        )
 
     def load_checkpoint(self, name: str, store_path: str, digest: str) -> ModelEntry:
         """Publish a fitted value out of a reliability checkpoint store.
@@ -116,6 +123,12 @@ class ModelRegistry:
             )
         with open(os.path.join(store_path, matches[0]), "rb") as f:
             model = pickle.load(f)
+        fused = getattr(model, "fused", None)
+        if callable(fused):
+            # Same re-fusion as load_fitted: a checkpointed FittedPipeline
+            # serves through single-dispatch fused chains regardless of
+            # when (or with what switches) it was saved.
+            model = fused()
         return self.publish(
             name, model, source=f"checkpoint:{store_path}/{matches[0]}"
         )

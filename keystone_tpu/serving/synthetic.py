@@ -39,7 +39,7 @@ class SyntheticDense(BatchTransformer):
             import jax
             import jax.numpy as jnp
 
-            ws = [jnp.asarray(w) for w in self.weights]
+            weights = self.weights
             trace_log = self.trace_log
 
             def compute(x):
@@ -47,6 +47,12 @@ class SyntheticDense(BatchTransformer):
                     # Trace-time side effect: appends once per new shape,
                     # never on cached executions.
                     trace_log.append(tuple(x.shape))
+                # Convert INSIDE compute: this op may itself be traced as
+                # a member of a fused chain (workflow/fusion.py), and a
+                # jnp.asarray hoisted outside `compute` there would leak
+                # outer-trace tracers into the cached closure. np arrays
+                # in the closure are trace-agnostic constants.
+                ws = [jnp.asarray(w) for w in weights]
                 for w in ws[:-1]:
                     x = jnp.tanh(x @ w)
                 return x @ ws[-1]
@@ -73,6 +79,35 @@ def synthetic_fitted_pipeline(
     ]
     pipeline = SyntheticDense(weights, trace_log=trace_log).to_pipeline()
     return FittedPipeline(pipeline.graph, pipeline.source, pipeline.sink)
+
+
+def synthetic_chain_pipeline(
+    num_nodes: int = 4,
+    d: int = 64,
+    seed: int = 0,
+    fused: bool = True,
+) -> FittedPipeline:
+    """A transformer-only FittedPipeline that is a CHAIN of ``num_nodes``
+    single-layer dense ops (each its own graph node) — the fusion bench/
+    smoke workload. With ``fused=True`` (default) the chain collapses
+    into one :class:`~keystone_tpu.workflow.fusion.FusedTransformerOperator`
+    = one XLA dispatch; ``fused=False`` keeps node-per-dispatch execution
+    for the unfused baseline. Both variants compute identical outputs for
+    the same ``seed``."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    scale = 1.0 / np.sqrt(d)
+    pipeline = None
+    for i in range(max(1, num_nodes)):
+        w = (rng.standard_normal((d, d)) * scale).astype(np.float32)
+        node = SyntheticDense([w])
+        pipeline = node.to_pipeline() if pipeline is None else pipeline.then(node)
+    fitted = FittedPipeline(pipeline.graph, pipeline.source, pipeline.sink)
+    # fused=False returns the graph as built — node per dispatch — without
+    # touching the process-global fusion switch (a fusion_disabled() window
+    # here would race concurrent fits in serving/bench threads).
+    return fitted.fused() if fused else fitted
 
 
 def synthetic_requests(n: int, d: int = 64, seed: int = 1) -> List[Any]:

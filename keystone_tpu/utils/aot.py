@@ -38,7 +38,12 @@ def warm_buckets(
     full-occupancy batch skips them, so warming at full occupancy would
     leave the partial-batch path cold). Returns per-bucket seconds; with
     the persistent cache enabled the warmed executables outlive this
-    process, so a restarted server's warmup is a disk load."""
+    process, so a restarted server's warmup is a disk load.
+
+    Fused pipelines (workflow/fusion.py) warm through here unchanged:
+    ``batch_apply`` executes the FUSED chain executable, so each bucket
+    warms one whole-chain program — serving keeps its zero-recompile-
+    after-warmup guarantee with fusion on, at one dispatch per batch."""
     import jax
 
     from ..data.dataset import ArrayDataset

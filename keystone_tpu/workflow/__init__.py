@@ -36,6 +36,14 @@ from .rules import (
     default_optimizer,
 )
 from .optimize import DataStats, NodeOptimizationRule, Optimizable
+from .fusion import (
+    FusedTransformerOperator,
+    NodeFusionRule,
+    fuse_graph,
+    fusion_disabled,
+    fusion_enabled,
+    set_fusion_enabled,
+)
 from .tracing import PipelineTrace, current_trace, trace
 
 __all__ = [
@@ -50,5 +58,7 @@ __all__ = [
     "Rule", "Batch", "RuleExecutor", "EquivalentNodeMergeRule",
     "UnusedBranchRemovalRule", "default_optimizer", "auto_caching_optimizer",
     "DataStats", "NodeOptimizationRule", "Optimizable",
+    "FusedTransformerOperator", "NodeFusionRule", "fuse_graph",
+    "fusion_enabled", "fusion_disabled", "set_fusion_enabled",
     "PipelineTrace", "current_trace", "trace",
 ]

@@ -190,17 +190,9 @@ class AutoCacheRule(Rule):
 
     # ------------------------------------------------------------- structure
     def _dependents(self, graph: Graph) -> Dict[NodeId, List]:
-        """node → list of (dependent node-or-sink)."""
-        out: Dict[NodeId, List] = {n: [] for n in graph.nodes}
-        for node in graph.nodes:
-            for dep in graph.get_dependencies(node):
-                if isinstance(dep, NodeId):
-                    out[dep].append(node)
-        for sink in graph.sinks:
-            dep = graph.get_sink_dependency(sink)
-            if isinstance(dep, NodeId):
-                out[dep].append(sink)
-        return out
+        """node → list of (dependent node-or-sink) — the shared
+        :meth:`Graph.dependents` view, also used by the fusion pass."""
+        return graph.dependents()
 
     def _candidates(self, graph: Graph, dependents: Dict[NodeId, List]) -> List[NodeId]:
         """Nodes worth caching: dataset-producing, used more than once when
@@ -331,6 +323,24 @@ class AutoCacheRule(Rule):
     def apply(self, graph: Graph, prefixes: PrefixMap) -> Tuple[Graph, PrefixMap]:
         from ..ops.util.misc import CacherOperator
         from ..parallel.mesh import device_memory_budget_bytes
+        from .fusion import FusedTransformerOperator
+
+        # Ordering contract (docs/OPTIMIZER.md): cache planning must see
+        # REAL node boundaries — the standard stacks run fusion strictly
+        # after this rule, keeping cache decisions byte-identical to
+        # pre-fusion plans. A custom stack that fused first would have
+        # this planner profiling synthetic merged nodes (it still works,
+        # but candidate boundaries inside fused chains are gone) — warn
+        # so the mis-ordering is visible.
+        if any(
+            isinstance(op, FusedTransformerOperator)
+            for op in graph.operators.values()
+        ):
+            logging.getLogger(__name__).warning(
+                "AutoCacheRule running on an already-fused graph: cache "
+                "planning cannot see boundaries inside fused chains; run "
+                "fusion after auto-cache (the default optimizer ordering)"
+            )
 
         dependents = self._dependents(graph)
         candidates = self._candidates(graph, dependents)

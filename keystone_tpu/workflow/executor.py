@@ -185,9 +185,11 @@ def _wrap_reliability(
     Each attempt executes the op FRESH (``op.execute`` is cheap — it only
     builds lazy thunks; deps stay memoized) rather than re-entering the
     shared Expression: after a deadline abandonment the watchdog thread
-    may still be inside the old expression's unsynchronized ``get``, and a
-    retry re-entering it would race on its memo. The wrapper expression
-    below memoizes the one successful result for all downstream readers.
+    may still be inside the old expression's ``get`` holding its memo
+    lock, and a retry re-entering it would block behind the hung attempt
+    (``Expression.get`` is lock-guarded, so the race is gone — but the
+    hang would remain). The wrapper expression below memoizes the one
+    successful result for all downstream readers.
     """
     env = PipelineEnv.get_or_create()
     injector = faultinject.current()

@@ -111,6 +111,24 @@ class Graph:
     def _next_ids(self) -> Iterable[int]:
         return itertools.count(self._max_id + 1)
 
+    def dependents(self) -> Dict[NodeId, List[GraphId]]:
+        """node → list of consumers (nodes AND sinks — a sink read counts).
+
+        The shared reverse-edge view used by the auto-cache planner
+        (reuse counting) and the fusion pass (chain cutting): both must
+        agree on what 'consumer' means or their rewrites would disagree
+        about node boundaries.
+        """
+        out: Dict[NodeId, List[GraphId]] = {n: [] for n in self.operators}
+        for node, deps in self.dependencies.items():
+            for dep in deps:
+                if isinstance(dep, NodeId):
+                    out[dep].append(node)
+        for sink, dep in self.sink_dependencies.items():
+            if isinstance(dep, NodeId):
+                out[dep].append(sink)
+        return out
+
     # --------------------------------------------------------------- surgery
     def add_node(self, op: "Operator", deps: Sequence[NodeOrSourceId]) -> Tuple["Graph", NodeId]:
         node = NodeId(self._max_id + 1)

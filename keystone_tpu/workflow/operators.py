@@ -11,6 +11,7 @@ the analog of the reference's "no Spark job until someone forces .get".
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, List, Optional, Sequence
 
 from ..data.dataset import Dataset
@@ -20,18 +21,47 @@ _UNSET = object()
 
 
 class Expression:
-    """Call-by-name memoized result."""
+    """Call-by-name memoized result.
+
+    ``get`` is thread-safe: the memo is guarded by a per-expression lock,
+    so two threads forcing the same expression run the thunk exactly once
+    and both observe the one memoized value. This matters to the
+    reliability layer — a deadline-abandoned watchdog thread can still be
+    inside ``get`` when a retry (or a concurrent serving reader) arrives;
+    without the lock the racers could both run the thunk or read a
+    half-written memo. (Retries still execute the op FRESH rather than
+    re-entering an abandoned expression — see executor._wrap_reliability —
+    because a watchdog stuck in a hung thunk holds the lock until it
+    dies; the lock protects concurrent readers, not hung work.)
+    """
 
     def __init__(self, thunk: Callable[[], Any]):
         self._thunk: Optional[Callable[[], Any]] = thunk
         self._value: Any = _UNSET
+        self._lock = threading.Lock()
 
     def get(self) -> Any:
+        # Double-checked: the unlocked fast path is safe because _value
+        # is written exactly once, under the lock, after the thunk ran.
         if self._value is _UNSET:
-            assert self._thunk is not None
-            self._value = self._thunk()
-            self._thunk = None
+            with self._lock:
+                if self._value is _UNSET:
+                    assert self._thunk is not None
+                    self._value = self._thunk()
+                    self._thunk = None
         return self._value
+
+    def __getstate__(self):
+        # Locks don't pickle; a forced expression (thunk already dropped)
+        # must stay serializable — SavedStateLoadRule splices expressions
+        # into graphs that FittedPipeline.save pickles.
+        state = self.__dict__.copy()
+        state["_lock"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
 
     @classmethod
     def of(cls, value: Any) -> "Expression":

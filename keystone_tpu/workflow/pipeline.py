@@ -189,7 +189,18 @@ class BatchTransformer(Transformer):
     ``num_examples`` (mesh padding) stay exactly zero, so downstream
     Gram/gradient accumulations over the data axis are unaffected by
     padding no matter what elementwise work happens in between.
+
+    ``apply_arrays`` must also be row-independent (output row i depends
+    only on input row i) and jit-traceable — the contract the fusion pass
+    (workflow/fusion.py) relies on to compose consecutive transformers
+    into one compiled dispatch. Ops that manage their own sharding or
+    dispatch set ``fusable = False`` to opt out.
     """
+
+    #: Chain-fusion opt-out (see workflow/fusion.py).
+    fusable: bool = True
+    #: True only on FusedTransformerOperator (dispatch accounting label).
+    _is_fused: bool = False
 
     def apply_arrays(self, data: Any) -> Any:
         raise NotImplementedError
@@ -214,6 +225,24 @@ class BatchTransformer(Transformer):
             # Native-resolution path: one static-shape application per
             # size bucket (each bucket compiles once, like any batch).
             return dataset.map_datasets(self.apply_batch)
+        # Dispatch accounting: each batch application of a transformer is
+        # one host→device round trip. The fused-vs-unfused split is the
+        # direct evidence for the fusion pass (a k-node chain fused into
+        # one operator counts 1 here instead of k) — see workflow/fusion.py
+        # and the bench `fusion` leg. Bucketed batches count per bucket
+        # (each bucket genuinely dispatches), via the recursion above.
+        # A fused operator that latched its eager fallback no longer
+        # dispatches once — count its members as unfused so the CI-gated
+        # 1-dispatch invariant actually detects fusion degrading. (The
+        # single batch that triggers the latch is counted fused — the
+        # latch flips mid-apply — every batch after it is counted true.)
+        from ..obs import names as _names
+
+        counter = _names.metric(_names.FUSION_BATCH_DISPATCHES)
+        if self._is_fused and getattr(self, "_eager_fallback", False):
+            counter.inc(len(self.members), fused="0")
+        else:
+            counter.inc(fused="1" if self._is_fused else "0")
         if isinstance(dataset, ObjectDataset):
             dataset = dataset.to_arrays()
         assert isinstance(dataset, ArrayDataset)
@@ -375,7 +404,12 @@ class Pipeline(Chainable):
             executor._memo.pop(node, None)
 
         graph, _ = UnusedBranchRemovalRule().apply(graph, {})
-        return FittedPipeline(graph, self.source, self.sink)
+        # The spliced graph is transformer-only: newly-adjacent chains
+        # (fit transformer next to its featurization) fuse into single
+        # compiled dispatches for the apply/serving path. The optimizer's
+        # own fusion batch can't see these chains — they exist only after
+        # delegating nodes collapse.
+        return FittedPipeline(graph, self.source, self.sink).fused()
 
     # ------------------------------------------------------------------ gather
     @staticmethod
@@ -478,6 +512,22 @@ class FittedPipeline(Transformer):
         graph = graph.remove_source(self.source)
         executor = GraphExecutor(graph, optimize=False)
         return executor.execute(self.sink).get()
+
+    def fused(self) -> "FittedPipeline":
+        """This pipeline with transformer chains collapsed into single
+        compiled dispatches (workflow/fusion.py). Returns ``self`` when
+        fusion is disabled or nothing fuses; otherwise a NEW pipeline
+        (graph surgery never mutates in place). ``Pipeline.fit`` calls
+        this, and the serving registry re-fuses loaded artifacts that
+        were saved before fusion existed."""
+        from .fusion import fuse_graph, fusion_enabled
+
+        if not fusion_enabled():
+            return self
+        graph = fuse_graph(self.graph)
+        if graph == self.graph:
+            return self
+        return FittedPipeline(graph, self.source, self.sink)
 
     def compiled_apply(self) -> "CompiledApply":
         """The serving-loop batch handle: graph bound once, only the

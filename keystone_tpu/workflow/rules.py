@@ -194,7 +194,10 @@ class SavedStateLoadRule(Rule):
 
 def default_optimizer() -> RuleExecutor:
     """The standard stack: saved-state reuse → CSE → node-level optimization
-    (reference: DefaultOptimizer.scala:8-26)."""
+    → chain fusion (reference: DefaultOptimizer.scala:8-26; fusion is
+    TPU-native, docs/OPTIMIZER.md). Fusion is last so every structural
+    decision upstream sees real node boundaries."""
+    from .fusion import NodeFusionRule
     from .optimize import NodeOptimizationRule
 
     return RuleExecutor(
@@ -205,14 +208,20 @@ def default_optimizer() -> RuleExecutor:
             ),
             Batch("cse", [EquivalentNodeMergeRule()], fixed_point=True),
             Batch("node-level-optimization", [NodeOptimizationRule()]),
+            Batch("fusion", [NodeFusionRule()]),
         ]
     )
 
 
 def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "greedy") -> RuleExecutor:
     """Default stack plus profile-driven cache insertion
-    (reference: DefaultOptimizer.scala AutoCachingOptimizer)."""
+    (reference: DefaultOptimizer.scala AutoCachingOptimizer). Fusion runs
+    AFTER cache insertion: the cache planner profiles and splices against
+    real node boundaries, so its decisions are byte-identical to
+    pre-fusion plans, and inserted Cacher nodes then act as hard fusion
+    boundaries."""
     from .autocache import AutoCacheRule
+    from .fusion import NodeFusionRule
     from .optimize import NodeOptimizationRule
 
     return RuleExecutor(
@@ -224,5 +233,6 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
             Batch("cse", [EquivalentNodeMergeRule()], fixed_point=True),
             Batch("node-level-optimization", [NodeOptimizationRule()]),
             Batch("auto-cache", [AutoCacheRule(budget_bytes=budget_bytes, strategy=strategy)]),
+            Batch("fusion", [NodeFusionRule()]),
         ]
     )

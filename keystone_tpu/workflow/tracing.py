@@ -17,8 +17,12 @@ existing callers and tests keep working unchanged.
 Timing forces each operator's lazy result (and on accelerators blocks on a
 scalar fetch) — tracing is a profiling mode, not a zero-cost observer;
 laziness across operators is preserved apart from the forcing. The same
-forcing applies under any active ``obs.spans`` session (e.g. the
-``keystone-tpu profile`` CLI) even when no ``trace()`` shim is active.
+forcing applies under an ``obs.spans`` session that declares
+``sync_timings=True`` (the default, e.g. the ``keystone-tpu profile``
+CLI) even when no ``trace()`` shim is active; a ``sync_timings=False``
+session — and a metrics-registry-only run with no session at all —
+skips the per-node sync entirely, preserving async dispatch between
+nodes (spans then carry ``synced=False``).
 """
 
 from __future__ import annotations
@@ -125,19 +129,39 @@ def _node_seconds_hist():
 
 def timed_execute(op, deps):
     """Execute ``op`` under the active trace/span session (or plainly if
-    neither is active)."""
+    neither is active).
+
+    The blocking device sync (:func:`_force`) runs only when someone
+    actually needs real per-node timings — an active ``trace()`` shim or
+    a span session with ``sync_timings`` (the default). A metrics-only
+    run (no session) or a ``sync_timings=False`` session keeps async
+    dispatch between nodes: spans/histograms then record dispatch time,
+    flagged ``synced=False`` so a reader never mistakes it for work time.
+
+    A fused chain (workflow/fusion.py) appears as ONE ``node:Fused[...]``
+    span carrying the member labels as an attribute — the per-member
+    spans collapse along with the dispatches.
+    """
     tr = current_trace()
     session = _spans.active_session()
     expression = op.execute(deps)
     if tr is None and session is None:
         return expression
+    sync = tr is not None or getattr(session, "sync_timings", True)
     label = str(getattr(op, "label", type(op).__name__))
+    members = getattr(op, "member_labels", None)
     with _spans.span(f"node:{label}", op=type(op).__name__) as sp:
+        if members is not None:
+            sp.set_attribute("fused_members", ",".join(members))
         with device_annotation(f"keystone/node/{label}"):
             start = time.perf_counter()
-            _force(expression.get())
+            value = expression.get()
+            if sync:
+                _force(value)
             seconds = time.perf_counter() - start
         sp.set_attribute("seconds", round(seconds, 6))
+        if not sync:
+            sp.set_attribute("synced", False)
     if tr is not None:
         tr.record(label, seconds)
     _node_seconds_hist().observe(seconds, op=label)

@@ -25,17 +25,21 @@ def test_trace_shim_produces_nested_node_spans_and_metrics():
     pipeline = LinearRectifier(0.0).to_pipeline() >> NormalizeRows()
     with trace() as t:
         pipeline(ds).get()
-    # legacy flat view still works
-    assert any("NormalizeRows" in x.label for x in t.timings)
+    # legacy flat view still works; the two-transformer chain executes as
+    # ONE fused node whose label carries the member names
+    (timing,) = [x for x in t.timings if "NormalizeRows" in x.label]
+    assert timing.label.startswith("Fused[")
     assert "TOTAL" in t.report()
     # hierarchy: node spans parented under the pipeline root
     roots = [s for s in t.session.spans() if s.parent_id is None]
     assert [s.name for s in roots] == ["pipeline"]
     node_spans = t.session.find("node:")
     assert {s.parent_id for s in node_spans} == {roots[0].span_id}
-    # node wall-time histogram populated for the traced ops
+    fused_spans = t.session.find("node:Fused[")
+    assert fused_spans and "NormalizeRows" in fused_spans[0].attributes["fused_members"]
+    # node wall-time histogram populated for the traced (fused) op
     hist = metrics.get_registry().get(names.NODE_SECONDS)
-    assert hist.count(op="NormalizeRows") >= 1
+    assert hist.count(op=timing.label) >= 1
     # executor counters moved
     assert _counter_value(names.NODES_EXECUTED) > executed_before
 

@@ -1,0 +1,95 @@
+"""Expression.get thread-safety: the memo is lock-guarded, so concurrent
+forcings (a deadline-abandoned watchdog racing a retry, or serving
+threads sharing a memoized result) run the thunk exactly once."""
+
+import pickle
+import threading
+import time
+
+import pytest
+
+from keystone_tpu.workflow.operators import Expression
+
+
+def test_concurrent_get_runs_thunk_once():
+    calls = []
+    barrier = threading.Barrier(2)
+
+    def thunk():
+        calls.append(threading.get_ident())
+        time.sleep(0.05)  # widen the race window
+        return {"value": len(calls)}
+
+    expr = Expression(thunk)
+    results = [None, None]
+
+    def hammer(i):
+        barrier.wait()
+        results[i] = expr.get()
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1, f"thunk ran {len(calls)} times under contention"
+    assert results[0] is results[1]  # both readers see the one memo
+    assert results[0] == {"value": 1}
+
+
+def test_many_threads_hammering_one_expression():
+    calls = []
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+
+    def thunk():
+        calls.append(1)
+        time.sleep(0.02)
+        return object()
+
+    expr = Expression(thunk)
+    seen = []
+    lock = threading.Lock()
+
+    def hammer():
+        barrier.wait()
+        for _ in range(50):
+            value = expr.get()
+            with lock:
+                seen.append(value)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert len(calls) == 1
+    assert len(set(id(v) for v in seen)) == 1
+
+
+def test_failing_thunk_can_be_reforced():
+    """A failing thunk leaves the memo unset (the retry contract) and the
+    lock released, so a later forcing re-executes."""
+    attempts = []
+
+    def thunk():
+        attempts.append(1)
+        if len(attempts) == 1:
+            raise RuntimeError("transient")
+        return 42
+
+    expr = Expression(thunk)
+    with pytest.raises(RuntimeError):
+        expr.get()
+    assert expr.get() == 42
+    assert len(attempts) == 2
+
+
+def test_forced_expression_pickles_without_lock():
+    expr = Expression.of([1, 2, 3])
+    restored = pickle.loads(pickle.dumps(expr))
+    assert restored.get() == [1, 2, 3]
+    # the restored expression has a working lock again
+    assert restored._lock is not None
+    with restored._lock:
+        pass

@@ -1,0 +1,61 @@
+"""timed_execute's device-sync policy: real per-node timings only when a
+trace/span session asks for them; metrics-only runs keep async dispatch."""
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs import spans as _spans
+from keystone_tpu.workflow import BatchTransformer, trace
+from keystone_tpu.workflow.executor import PipelineEnv
+
+
+class Double(BatchTransformer):
+    def apply_arrays(self, x):
+        return x * 2.0
+
+
+def _run_pipeline():
+    PipelineEnv.reset()
+    pipe = Double().to_pipeline()
+    return pipe(ArrayDataset(np.ones((3, 4), np.float32))).get()
+
+
+def _forced_calls(monkeypatch):
+    from keystone_tpu.workflow import tracing
+
+    calls = []
+    real = tracing._force
+    monkeypatch.setattr(tracing, "_force", lambda v: calls.append(1) or real(v))
+    return calls
+
+
+def test_no_session_no_forced_sync(monkeypatch):
+    calls = _forced_calls(monkeypatch)
+    _run_pipeline()
+    assert calls == [], "metrics-only execution must not block per node"
+
+
+def test_trace_shim_forces(monkeypatch):
+    calls = _forced_calls(monkeypatch)
+    with trace() as t:
+        _run_pipeline()
+    assert len(calls) >= 1
+    assert any(op.label == "Double" for op in t.timings)
+
+
+def test_sync_session_forces(monkeypatch):
+    calls = _forced_calls(monkeypatch)
+    with _spans.tracing_session("t") as session:
+        assert session.sync_timings is True
+        _run_pipeline()
+    assert len(calls) >= 1
+
+
+def test_nosync_session_skips_force_but_keeps_spans(monkeypatch):
+    calls = _forced_calls(monkeypatch)
+    with _spans.tracing_session("t", sync_timings=False) as session:
+        _run_pipeline()
+    assert calls == [], "sync_timings=False session must keep async dispatch"
+    node_spans = session.find("node:")
+    assert node_spans, "node spans still recorded (dispatch-timed)"
+    assert all(s.attributes.get("synced") is False for s in node_spans)
