@@ -26,13 +26,49 @@ harmless to Gram/gradient accumulation and are masked out of statistics via
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List, Optional, Sequence
+import os
+from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def default_ingest_workers() -> int:
+    """Host-side worker count shared by every ingest-adjacent pool:
+    ``ObjectDataset.map``, the archive decode pool, and the streaming
+    engine's prefetch pipeline. ``KEYSTONE_INGEST_WORKERS`` overrides;
+    the default derives from the host's core count (capped — tar decode
+    pools past ~32 threads just fight the GIL/page cache)."""
+    env = os.environ.get("KEYSTONE_INGEST_WORKERS", "").strip()
+    if env:
+        return max(1, int(env))
+    return max(2, min(32, os.cpu_count() or 4))
+
+
+def transfer_dtype(dtype) -> np.dtype:
+    """The dtype a host array should CROSS the host→device link as.
+
+    Narrow dtypes (uint8 images, int16 audio, bool masks) stay narrow —
+    transfer scales with bytes, and uint8 is 4× less traffic than the
+    float32 the math eventually wants (measured fact backing
+    pipelines/imagenet_streaming.py); the consumer casts ON DEVICE.
+    64-bit host types squeeze to 32-bit: jax (x64 disabled) would
+    canonicalize them to 32-bit anyway, so shipping 8 bytes/element is
+    pure waste.
+    """
+    dtype = np.dtype(dtype)
+    if dtype == np.float64:
+        return np.dtype(np.float32)
+    if dtype == np.int64:
+        return np.dtype(np.int32)
+    if dtype == np.uint64:
+        return np.dtype(np.uint32)
+    if dtype == np.complex128:
+        return np.dtype(np.complex64)
+    return dtype
 
 
 class Dataset:
@@ -57,6 +93,26 @@ class Dataset:
         forces any lazy source. Returns self for chaining.
         """
         return self
+
+    def fetch_rows(self, start: int, stop: int) -> Any:
+        """Host numpy pytree of the ``[start, stop)`` example window,
+        stored dtype preserved. The one chunk-windowing primitive: both
+        :meth:`iter_chunks` and the streaming engine's parallel prefetch
+        workers (workflow/streaming.py) go through it, so window
+        semantics can't diverge. Subclasses without a chunkable physical
+        layout don't implement it — the streaming planner falls back to
+        the materialized path for them."""
+        raise NotImplementedError(f"{type(self).__name__} is not chunkable")
+
+    def iter_chunks(self, chunk_rows: int) -> Iterator[Tuple[Any, int]]:
+        """Yield ``(host_pytree, num_valid_rows)`` windows of at most
+        ``chunk_rows`` examples, in order, as host numpy arrays with
+        their stored dtype preserved (the streaming engine narrows via
+        :func:`transfer_dtype` at upload time)."""
+        n = len(self)
+        for start in range(0, n, chunk_rows):
+            stop = min(start + chunk_rows, n)
+            yield self.fetch_rows(start, stop), stop - start
 
     @property
     def num_shards(self) -> int:
@@ -85,13 +141,16 @@ class ObjectDataset(Dataset):
 
         ``fn`` must be safe to call concurrently (the RDD-map contract);
         pass ``parallel=False`` for functions with shared mutable state,
-        ``parallel=True`` to force the pool for small datasets."""
+        ``parallel=True`` to force the pool for small datasets. Pool
+        width comes from :func:`default_ingest_workers`
+        (``KEYSTONE_INGEST_WORKERS``), shared with the archive decode
+        pool and the streaming prefetch pipeline."""
         if parallel is None:
             parallel = len(self._items) >= 64
         if parallel:
             from concurrent.futures import ThreadPoolExecutor
 
-            with ThreadPoolExecutor(max_workers=8) as pool:
+            with ThreadPoolExecutor(max_workers=default_ingest_workers()) as pool:
                 return ObjectDataset(list(pool.map(fn, self._items)), self._num_shards)
         return ObjectDataset([fn(x) for x in self._items], self._num_shards)
 
@@ -111,6 +170,15 @@ class ObjectDataset(Dataset):
             raise ValueError("cannot stack an empty dataset")
         stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *self._items)
         return ArrayDataset(stacked)
+
+    def fetch_rows(self, start: int, stop: int) -> Any:
+        """Stack one window of items on demand — only the window is ever
+        stacked, so host residency stays O(chunk) no matter the dataset
+        size; the streaming prefetch workers call this concurrently."""
+        window = self._items[start:stop]
+        return jax.tree_util.tree_map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *window
+        )
 
     def __repr__(self) -> str:
         return f"ObjectDataset(n={len(self._items)}, shards={self._num_shards})"
@@ -169,9 +237,23 @@ class ArrayDataset(Dataset):
         host = jax.tree_util.tree_map(lambda a: np.asarray(a[:n]), self.data)
         return [jax.tree_util.tree_map(lambda a: a[i], host) for i in range(n)]
 
+    def fetch_rows(self, start: int, stop: int) -> Any:
+        """Host-side row window of the logical (unpadded) examples.
+        Device-resident leaves are pulled per window, never whole —
+        a chunked read of an HBM-resident dataset stays O(chunk)."""
+        return jax.tree_util.tree_map(
+            lambda a: np.asarray(a[start:stop]), self.data
+        )
+
     # ------------------------------------------------------------- sharding
     def padded_to(self, multiple: int) -> "ArrayDataset":
-        """Zero-pad the leading axis up to the next multiple of ``multiple``."""
+        """Zero-pad the leading axis up to the next multiple of ``multiple``.
+
+        Dtype-preserving by contract: a uint8 image batch pads to uint8 —
+        narrowing to the storage dtype and casting on DEVICE is what
+        keeps host→device traffic at 1 byte/px (see
+        :func:`transfer_dtype`); an upcast here would silently 4× it.
+        """
         physical = self.physical_rows
         target = ((physical + multiple - 1) // multiple) * multiple
         if target == physical:
@@ -189,11 +271,18 @@ class ArrayDataset(Dataset):
 
         Zero-pads so the leading axis divides the mesh axis size — the
         TPU-native analog of the reference's row-partitioned RDDs.
+        Host leaves cross the link at :func:`transfer_dtype` width
+        (uint8 stays uint8, float64 squeezes to float32) so the
+        placement never silently widens the transfer.
         """
         n_dev = mesh.shape[axis]
         ds = self.padded_to(n_dev)
 
         def place(a):
+            if isinstance(a, np.ndarray):
+                narrow = transfer_dtype(a.dtype)
+                if narrow != a.dtype:
+                    a = a.astype(narrow)
             spec = P(axis, *([None] * (a.ndim - 1)))
             return jax.device_put(a, NamedSharding(mesh, spec))
 

@@ -122,7 +122,7 @@ def load_image_archives(
     label_fn: Callable[[str], Any],
     name_prefix: Optional[str] = None,
     resize: Optional[Tuple[int, int]] = None,
-    num_workers: int = 8,
+    num_workers: Optional[int] = None,
     label_key: str = "label",
     use_native: Optional[bool] = None,
 ) -> ObjectDataset:
@@ -141,7 +141,16 @@ def load_image_archives(
     With ``resize`` set and the native library built, decode+resize runs
     through the OpenMP libjpeg kernel (``use_native=None`` auto-detects;
     True requires it; False forces the PIL path).
+
+    ``num_workers=None`` resolves through
+    :func:`~keystone_tpu.data.dataset.default_ingest_workers`
+    (``KEYSTONE_INGEST_WORKERS``) — one knob shared with
+    ``ObjectDataset.map`` and the streaming prefetch pipeline.
     """
+    from ..dataset import default_ingest_workers
+
+    if num_workers is None:
+        num_workers = default_ingest_workers()
     quarantine = QuarantineCounts()
 
     def decode(item: Tuple[str, bytes]) -> Optional[Dict[str, Any]]:

@@ -37,7 +37,7 @@ def load_imagenet(
     data_path: str,
     labels_path: str,
     resize: Optional[Tuple[int, int]] = None,
-    num_workers: int = 8,
+    num_workers: Optional[int] = None,  # None → KEYSTONE_INGEST_WORKERS default
 ) -> ObjectDataset:
     """Load every image under ``data_path`` (a tar file or a directory of
     tar files), labeling by the entry's leading directory name

@@ -39,7 +39,7 @@ def load_voc(
     labels_path: str,
     name_prefix: str = DEFAULT_NAME_PREFIX,
     resize: Optional[Tuple[int, int]] = None,
-    num_workers: int = 8,
+    num_workers: Optional[int] = None,  # None → KEYSTONE_INGEST_WORKERS default
 ) -> ObjectDataset:
     """Load the VOC tar(s); entries are matched to labels by basename so
     the label CSV's bare filenames line up with tar paths under

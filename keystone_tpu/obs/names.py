@@ -34,6 +34,14 @@ FUSION_DISPATCHES_SAVED = "keystone_fusion_dispatches_saved_total"
 FUSION_COMPILES = "keystone_fusion_compiles_total"
 FUSION_BATCH_DISPATCHES = "keystone_fusion_batch_dispatches_total"
 
+# ------------------------------------------------------------------- streaming
+STREAM_PLANS = "keystone_stream_plans_total"
+STREAM_CHUNKS = "keystone_stream_chunks_total"
+STREAM_BYTES = "keystone_stream_bytes_transferred_total"
+STREAM_STALL_SECONDS = "keystone_stream_stall_seconds_total"
+STREAM_PREFETCH_DEPTH = "keystone_stream_prefetch_depth"
+STREAM_HOST_BUFFER_PEAK = "keystone_stream_host_buffer_peak_bytes"
+
 # ------------------------------------------------------------------- autocache
 AUTOCACHE_CACHED_NODES = "keystone_autocache_cached_nodes_total"
 AUTOCACHE_HITS = "keystone_autocache_hits_total"
@@ -92,6 +100,12 @@ SCHEMA: Dict[str, Tuple] = {
     FUSION_DISPATCHES_SAVED: ("counter", "Per-execution dispatches avoided by fusion (members-1 per chain)", ()),
     FUSION_COMPILES: ("counter", "Fused-chain executable traces (one per new shape/dtype)", ()),
     FUSION_BATCH_DISPATCHES: ("counter", "Transformer batch-apply dispatches, split fused vs unfused", ("fused",)),
+    STREAM_PLANS: ("counter", "Estimator fits rewritten onto the streaming engine by StreamingPlanRule", ()),
+    STREAM_CHUNKS: ("counter", "Chunks dispatched by the streaming execution engine", ()),
+    STREAM_BYTES: ("counter", "Host-to-device bytes uploaded by the streaming engine (post narrow-dtype)", ()),
+    STREAM_STALL_SECONDS: ("counter", "Seconds the streaming dispatch loop spent waiting on the host prefetch pipeline", ()),
+    STREAM_PREFETCH_DEPTH: ("gauge", "Chunks currently buffered in the host prefetch queue", ()),
+    STREAM_HOST_BUFFER_PEAK: ("gauge", "Peak bytes of host chunk buffers concurrently live in the last streaming fit", ()),
     AUTOCACHE_CACHED_NODES: ("counter", "Cacher nodes inserted by the auto-cache planner", ()),
     AUTOCACHE_HITS: ("counter", "Re-reads of a cached (Cacher) node's memoized result", ()),
     AUTOCACHE_MISSES: ("counter", "First executions of a Cacher node", ()),

@@ -97,6 +97,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     reference weights it: 3·num_iter + 1 passes over the data.
     """
 
+    #: Chunked-fit protocol (workflow/streaming.py): this estimator can
+    #: consume featurized row chunks incrementally via Gram accumulation.
+    supports_fit_stream = True
+
     def __init__(
         self,
         block_size: int,
@@ -115,6 +119,41 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     @property
     def weight(self) -> int:
         return 3 * self.num_iter + 1
+
+    def fit_stream(self, stream) -> BlockLinearMapper:
+        """Row-chunked fit: accumulate (AᵀA, AᵀY, Σx, Σy) one fused
+        dispatch per chunk, then run the SAME Gauss-Seidel block updates
+        as the in-core solver directly from the centered statistics
+        (``linalg.bcd_from_gram``) — identical math, identical block
+        order, O(d²) residency instead of O(n·d), and the feature matrix
+        never exists (docs/STREAMING.md)."""
+        probe("BlockLeastSquaresEstimator.solve")
+
+        def init(feat_aval, y_aval):
+            d, k = _stream_shapes(feat_aval, y_aval)
+            return linalg.gram_stream_init(d, k)
+
+        with solver_obs.fit_span("block_ls_stream", epochs=self.num_iter):
+            carry, info = stream.fold(init, linalg.gram_stream_step)
+            n = info["num_examples"]
+            gc, cc, mu_a, mu_b = linalg.gram_stream_finish(carry, n)
+            d = gc.shape[0]
+            block = min(self.block_size, d)
+            # Same reg floor as the in-core fit: 1e-6 of the mean Gram
+            # diagonal — trace(Gc)/(n·d) IS E[x²] of the centered data.
+            reg = self.reg if self.reg > 0 else max(
+                1e-6 * float(jnp.trace(gc)) / d, 1e-6
+            )
+            d_pad = _round_up(d, block)
+            if d_pad != d:  # zero pad rows/cols are inert (λ keeps PD)
+                gc = jnp.pad(gc, ((0, d_pad - d), (0, d_pad - d)))
+                cc = jnp.pad(cc, ((0, d_pad - d), (0, 0)))
+            w = linalg.bcd_from_gram(
+                gc, cc, reg=reg, num_epochs=self.num_iter, block_size=block
+            )
+        return BlockLinearMapper(
+            w, block_size=block, intercept=mu_b, feature_mean=mu_a
+        )
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         features = _as_array_dataset(data)
@@ -225,6 +264,22 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a
         )
+
+
+def _stream_shapes(feat_aval, y_aval):
+    """(d, k) from the streaming engine's featurized/label chunk avals;
+    rejects non-matrix chains (the engine falls back to materialized)."""
+    from ...workflow.streaming import StreamingFallback
+
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(feat_aval)
+    if len(leaves) != 1 or len(leaves[0].shape) != 2:
+        raise StreamingFallback(
+            f"gram streaming needs a single (rows, d) feature chunk, got "
+            f"{[tuple(l.shape) for l in leaves]}"
+        )
+    return leaves[0].shape[1], y_aval.shape[1]
 
 
 def _scale_aware_reg_floor(x_sample, n: int) -> float:

@@ -81,6 +81,25 @@ class _ExactCost(CostModel):
 class LeastSquaresEstimator(LabelEstimator, Optimizable):
     """Meta-solver choosing the concrete least-squares implementation."""
 
+    #: Chunked-fit protocol (workflow/streaming.py). The streaming path
+    #: always has the full Gram in hand after accumulation, so the
+    #: meta-choice collapses: exact solve for narrow problems, Gram-BCD
+    #: for wide ones (L-BFGS needs materialized data passes and is never
+    #: the streaming pick).
+    supports_fit_stream = True
+
+    def fit_stream(self, stream):
+        if _stream_width(stream, self.block_size) > self.block_size:
+            return BlockLeastSquaresEstimator(
+                self.block_size, num_iter=self.block_iters, reg=self.reg
+            ).fit_stream(stream)
+        from .linear import LinearMapEstimator
+
+        # Same contract as the exact rung: reg>0 is ridge, reg=0 is
+        # plain least squares that fails LOUDLY on a singular Gram
+        # (check_finite) rather than degrading to NaN predictions.
+        return LinearMapEstimator(reg=self.reg or None).fit_stream(stream)
+
     def __init__(
         self,
         reg: float = 0.0,
@@ -181,6 +200,21 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
             ),
         ]
         return min(candidates, key=lambda c: c[0])[1]
+
+
+def _stream_width(stream, default: int) -> int:
+    """Featurized width of a ChunkStream (shape-only, no data touched);
+    ``default`` when the chain output is not a plain matrix — the
+    downstream fold will fall back to the materialized path anyway."""
+    import jax
+
+    try:
+        leaves = jax.tree_util.tree_leaves(stream.feature_aval())
+    except Exception:
+        return default
+    if len(leaves) == 1 and len(leaves[0].shape) == 2:
+        return int(leaves[0].shape[1])
+    return default
 
 
 def _sample_shape_stats(sample_x: Dataset, sample_y: Optional[Dataset]):

@@ -56,8 +56,32 @@ class LinearMapEstimator(LabelEstimator):
     (reference: LinearMapper.scala:75-103).
     """
 
+    #: Chunked-fit protocol (workflow/streaming.py): exact normal
+    #: equations accumulate naturally over row chunks.
+    supports_fit_stream = True
+
     def __init__(self, reg: Optional[float] = None):
         self.reg = reg
+
+    def fit_stream(self, stream) -> LinearMapper:
+        """Row-chunked exact fit: the same algebraic centering identity
+        the fused in-core solve uses (Σ(a−μ)(a−μ)ᵀ = AᵀA − n·μμᵀ), fed
+        by per-chunk Gram accumulation instead of one whole-matrix
+        dispatch — O(d²) residency, feature matrix never materializes."""
+        from ..learning.block import _stream_shapes
+
+        def init(feat_aval, y_aval):
+            d, k = _stream_shapes(feat_aval, y_aval)
+            return linalg.gram_stream_init(d, k)
+
+        carry, info = stream.fold(init, linalg.gram_stream_step)
+        gc, cc, mu_a, mu_b = linalg.gram_stream_finish(
+            carry, info["num_examples"]
+        )
+        w = linalg.solve_from_gram(gc, cc, reg=self.reg or 0.0)
+        if not self.reg:  # singular-risk case only: fail loudly, not NaN
+            linalg.check_finite(w, "LinearMapEstimator (reg=0, streaming)")
+        return LinearMapper(w, intercept=mu_b, feature_mean=mu_a)
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         features = _as_array_dataset(data)

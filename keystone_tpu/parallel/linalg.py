@@ -837,6 +837,125 @@ def block_coordinate_descent_streaming(
     return w, jnp.asarray(mu_a), mu_b
 
 
+# --------------------------------------------- streaming gram (chunked fit)
+#
+# The row-chunked counterpart of the feature-block streaming above: the
+# workflow streaming engine (workflow/streaming.py) feeds featurized row
+# chunks through ``gram_stream_step`` — fused into the SAME dispatch as
+# the featurize chain, carries donated ping-pong style like
+# ``_bcd_stream_step_fn`` — so only O(d²) sufficient statistics ever
+# exist; the (n, d) feature matrix never materializes on host or device.
+# ``solve_from_gram`` / ``bcd_from_gram`` then finish the fit from the
+# statistics alone: the Gauss-Seidel block update only needs A_bᵀA_b,
+# (AᵀA·W)_b and (AᵀY)_b, all slices of the accumulated Gram.
+
+
+def gram_stream_init(d: int, k: int, dtype=jnp.float32):
+    """Zero sufficient statistics (G=AᵀA, C=AᵀY, Σx, Σy) for a streaming
+    least-squares fit. The carry the engine donates through every chunk."""
+    return (
+        jnp.zeros((d, d), dtype),
+        jnp.zeros((d, k), dtype),
+        jnp.zeros((d,), dtype),
+        jnp.zeros((k,), dtype),
+    )
+
+
+def gram_stream_step(carry, x, y):
+    """One chunk's contribution to the sufficient statistics (traceable;
+    the engine composes it after the featurize chain inside ONE jit).
+    Pad rows must be exactly zero — the engine's re-zero mask and the
+    framework-wide BatchTransformer invariant guarantee it — so no mask
+    multiply is needed here."""
+    g, c, sa, sb = carry
+    x = x.astype(g.dtype)
+    y = y.astype(g.dtype)
+    return (
+        g + mm(x.T, x),
+        c + mm(x.T, y),
+        sa + jnp.sum(x, axis=0),
+        sb + jnp.sum(y, axis=0),
+    )
+
+
+@_mode_cached()
+def _gram_finish_fn():
+    def run(g, c, sa, sb, n):
+        # Algebraic centering (Σ(x−μ)(x−μ)ᵀ = G − n·μμᵀ), same identity
+        # as the exact solver's fused path — no centered copy exists.
+        mu_a = sa / n
+        mu_b = sb / n
+        gc = g - n * jnp.outer(mu_a, mu_a)
+        cc = c - n * jnp.outer(mu_a, mu_b)
+        return gc, cc, mu_a, mu_b
+
+    return jax.jit(run)
+
+
+def gram_stream_finish(carry, n: int):
+    """Centered Gram/cross products + column means from the accumulated
+    statistics: ``(Gc, Cc, mu_a, mu_b)``."""
+    g, c, sa, sb = carry
+    return _gram_finish_fn()(g, c, sa, sb, jnp.asarray(n, g.dtype))
+
+
+def solve_from_gram(gc, cc, reg) -> jnp.ndarray:
+    """Exact ridge solve from centered sufficient statistics — the
+    streaming analog of the normal-equation rung."""
+    return solve_spd(gc, cc, reg=reg)
+
+
+@_mode_cached()
+def _bcd_gram_fn(num_epochs: int, block_size: int):
+    def run(gc, cc, reg):
+        d = gc.shape[0]
+        k = cc.shape[1]
+        num_blocks = d // block_size
+        eye = jnp.eye(block_size, dtype=gc.dtype)
+        w0 = jnp.zeros((d, k), dtype=gc.dtype)
+
+        def block_step(w, block_idx):
+            start = block_idx * block_size
+            g_rows = lax.dynamic_slice(gc, (start, 0), (block_size, d))
+            g_bb = lax.dynamic_slice(g_rows, (0, start), (block_size, block_size))
+            w_b = lax.dynamic_slice(w, (start, 0), (block_size, k))
+            # A_bᵀ(Y − P + A_b W_b) expressed in statistics:
+            #   (AᵀY)_b − (AᵀA·W)_b + A_bᵀA_b·W_b
+            c_b = lax.dynamic_slice(cc, (start, 0), (block_size, k))
+            rhs = c_b - mm(g_rows, w) + mm(g_bb, w_b)
+            factor = jax.scipy.linalg.cho_factor(g_bb + reg * eye, lower=True)
+            w_b_new = jax.scipy.linalg.cho_solve(factor, rhs)
+            return lax.dynamic_update_slice(w, w_b_new, (start, 0)), None
+
+        blocks = jnp.tile(jnp.arange(num_blocks), num_epochs)
+        w, _ = lax.scan(block_step, w0, blocks)
+        return w
+
+    return jax.jit(run)
+
+
+def bcd_from_gram(
+    gc: jnp.ndarray,
+    cc: jnp.ndarray,
+    reg: float,
+    num_epochs: int,
+    block_size: int,
+) -> jnp.ndarray:
+    """Feature-block Gauss-Seidel least squares driven entirely by the
+    centered Gram statistics — the identical per-block update (and block
+    order) as :func:`block_coordinate_descent`, so a streaming fit
+    matches the materialized fit to accumulation rounding. ``gc`` must
+    be (d_pad, d_pad) with d_pad a multiple of ``block_size`` (zero
+    pad rows/cols are inert: λ keeps the factor PD, exactly as the
+    in-core solver's zero column padding). Returns (d_pad, k) weights.
+    """
+    d = gc.shape[0]
+    if d % block_size != 0:
+        raise ValueError(f"d={d} not divisible by block_size={block_size}")
+    fn = _bcd_gram_fn(int(num_epochs), int(block_size))
+    return fn(gc, cc, jnp.asarray(reg, dtype=gc.dtype))
+
+
 # ------------------------------------------------------------------- 2-D BCD
 
 

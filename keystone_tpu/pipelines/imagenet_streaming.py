@@ -281,12 +281,16 @@ class StreamingFlagship:
         full-bucket dims; pad outputs are dropped at the gather) and the
         fused encode runs as one GSPMD computation — the data-parallel
         featurize path for multi-chip.
+
+        The pipelined loop itself is the workflow layer's shared
+        streaming engine (``workflow.streaming.stream_pipelined``) — the
+        same stage/compute/drain structure that backs general chunked
+        fits now, rather than a bespoke copy here.
         """
+        from ..workflow.streaming import stream_pipelined
+
         assert self.codebooks is not None, "fit_codebooks first"
-        staged: List[Tuple[jnp.ndarray, jnp.ndarray, Dict]] = []
         out_rows: List[np.ndarray] = []
-        pending: List[Tuple[jnp.ndarray, Dict]] = []
-        it = iter(buckets)
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -319,37 +323,23 @@ class StreamingFlagship:
                     jax.device_put(np.asarray(b["dims"])),
                 )
 
-        def stage_next() -> bool:
-            try:
-                b = next(it)
-            except StopIteration:
-                return False
-            img_s, dims_s = shard(b)
-            staged.append((img_s, dims_s, b))
-            return True
+        def compute(staged, b):
+            img_s, dims_s = staged
+            return self._encode_jit(
+                img_s, dims_s, self.codebooks.sift_pca, self.codebooks.lcs_pca
+            )
 
-        def drain_one():
-            dev, b = pending.pop(0)
+        def consume(dev, b):
             rows = np.asarray(dev)[: len(b["dims"])]
             if on_rows is not None:
                 on_rows(rows, b)
             else:
                 out_rows.append(rows)
 
-        for _ in range(max(1, prefetch)):
-            stage_next()
-        while staged:
-            img, dims, b = staged.pop(0)
-            pending.append((
-                self._encode_jit(img, dims, self.codebooks.sift_pca,
-                                 self.codebooks.lcs_pca),
-                b,
-            ))
-            stage_next()
-            if len(pending) > 1:
-                drain_one()
-        while pending:
-            drain_one()
+        stream_pipelined(
+            buckets, stage=shard, compute=compute, consume=consume,
+            prefetch=prefetch,
+        )
         return None if on_rows is not None else (
             np.concatenate(out_rows, axis=0) if out_rows else None
         )
@@ -551,42 +541,57 @@ def run_flagship_ondevice(
     fs.fit_codebooks(synth_host_batches(4), per_image=64)
     t["codebook_fit_s"] = round(time.perf_counter() - t0, 1)
 
-    # Phase B: device-generated encode, one dispatch per batch.
+    # Phase B: device-generated encode, one dispatch per batch, pipelined
+    # through the shared streaming engine (upload/stage of batch i+1
+    # overlaps compute of batch i; results drain one behind).
+    from ..workflow.streaming import stream_pipelined
+
     enc = synth_batch_fn(fs, image_size)
     labels_all = rng.integers(0, num_classes, num_train + num_test)
     feats = np.empty((num_train + num_test, fs.codebooks.fv_dim), np.float32)
     t0 = time.perf_counter()
     done = 0
-    pending: List[Tuple[int, int, jnp.ndarray]] = []
     last_report = t0
     truncated = None
-    for bi, start in enumerate(range(0, num_train + num_test, batch)):
-        if deadline_left_fn is not None and bi % 16 == 0:
-            left = deadline_left_fn()
-            # Enough margin to drain the pipeline and report; the solve
-            # and eval phases are separately gated below.
-            if left is not None and left <= 180.0:
-                truncated = (
-                    f"deadline mid-encode at {start}/{num_train + num_test}"
-                )
-                break
-        stop = min(start + batch, num_train + num_test)
+
+    def batch_ranges():
+        nonlocal truncated
+        for bi, start in enumerate(range(0, num_train + num_test, batch)):
+            if deadline_left_fn is not None and bi % 16 == 0:
+                left = deadline_left_fn()
+                # Enough margin to drain the pipeline and report; the
+                # solve and eval phases are separately gated below.
+                if left is not None and left <= 180.0:
+                    truncated = (
+                        f"deadline mid-encode at {start}/{num_train + num_test}"
+                    )
+                    return
+            yield start, min(start + batch, num_train + num_test)
+
+    def stage(rng_range):
+        start, stop = rng_range
         lab = jnp.asarray(labels_all[start:stop])
         if len(lab) < batch:  # pad tail to the compiled batch shape
             lab = jnp.pad(lab, (0, batch - len(lab)))
-        pending.append((start, stop, enc(jax.random.PRNGKey(start), lab)))
-        if len(pending) > 1:
-            s, e, dev = pending.pop(0)
-            feats[s:e] = np.asarray(dev)[: e - s]
-            done = e
+        return lab
+
+    def compute(lab, rng_range):
+        return enc(jax.random.PRNGKey(rng_range[0]), lab)
+
+    def consume(dev, rng_range):
+        nonlocal done, last_report
+        s, e = rng_range
+        feats[s:e] = np.asarray(dev)[: e - s]
+        done = e
         if progress_s and time.perf_counter() - last_report > progress_s:
             last_report = time.perf_counter()
             print(f"encoded {done}/{num_train + num_test} "
                   f"({done / (last_report - t0):.1f} img/s)", flush=True)
-    while pending:
-        s, e, dev = pending.pop(0)
-        feats[s:e] = np.asarray(dev)[: e - s]
-        done = e
+
+    stream_pipelined(
+        batch_ranges(), stage=stage, compute=compute, consume=consume,
+        prefetch=1,
+    )
     encode_s = time.perf_counter() - t0
     t["encode_s"] = round(encode_s, 1)
     t["encoded_images"] = int(done)

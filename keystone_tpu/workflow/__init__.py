@@ -44,6 +44,16 @@ from .fusion import (
     fusion_enabled,
     set_fusion_enabled,
 )
+from .streaming import (
+    ChunkStream,
+    StreamingFitOperator,
+    StreamingPlanRule,
+    last_stream_report,
+    set_streaming_enabled,
+    stream_pipelined,
+    streaming_disabled,
+    streaming_enabled,
+)
 from .tracing import PipelineTrace, current_trace, trace
 
 __all__ = [
@@ -60,5 +70,8 @@ __all__ = [
     "DataStats", "NodeOptimizationRule", "Optimizable",
     "FusedTransformerOperator", "NodeFusionRule", "fuse_graph",
     "fusion_enabled", "fusion_disabled", "set_fusion_enabled",
+    "ChunkStream", "StreamingFitOperator", "StreamingPlanRule",
+    "stream_pipelined", "last_stream_report",
+    "streaming_enabled", "streaming_disabled", "set_streaming_enabled",
     "PipelineTrace", "current_trace", "trace",
 ]

@@ -189,10 +189,28 @@ class TransformerOperator(Operator):
 
 
 class EstimatorOperator(Operator):
-    """Fits datasets into a TransformerOperator (reference: Operator.scala:112-124)."""
+    """Fits datasets into a TransformerOperator (reference: Operator.scala:112-124).
+
+    Estimators that can consume their training data INCREMENTALLY — via
+    sufficient statistics (Gram accumulation) rather than a materialized
+    feature matrix — advertise ``supports_fit_stream = True`` and
+    implement :meth:`fit_stream`; the streaming planner
+    (workflow/streaming.py) then rewrites eligible
+    ``ingest → featurize → fit`` graphs into chunked plans where the
+    full feature matrix never exists.
+    """
+
+    #: True when :meth:`fit_stream` is implemented (streaming planner gate).
+    supports_fit_stream: bool = False
 
     def fit_datasets(self, datasets: List[Dataset]) -> TransformerOperator:
         raise NotImplementedError
+
+    def fit_stream(self, stream) -> TransformerOperator:
+        """Fit from a :class:`~keystone_tpu.workflow.streaming.ChunkStream`
+        (see its ``fold`` contract). Only called when
+        ``supports_fit_stream`` is True."""
+        raise NotImplementedError(f"{self.label} does not support fit_stream")
 
     def execute(self, deps: Sequence[Expression]) -> TransformerExpression:
         def thunk() -> TransformerOperator:

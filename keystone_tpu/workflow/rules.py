@@ -194,11 +194,14 @@ class SavedStateLoadRule(Rule):
 
 def default_optimizer() -> RuleExecutor:
     """The standard stack: saved-state reuse → CSE → node-level optimization
-    → chain fusion (reference: DefaultOptimizer.scala:8-26; fusion is
-    TPU-native, docs/OPTIMIZER.md). Fusion is last so every structural
-    decision upstream sees real node boundaries."""
+    → chain fusion → streaming (reference: DefaultOptimizer.scala:8-26;
+    fusion and streaming are TPU-native, docs/OPTIMIZER.md +
+    docs/STREAMING.md). Fusion runs late so every structural decision
+    upstream sees real node boundaries; streaming runs LAST so it can
+    absorb already-fused chains into chunked fit plans."""
     from .fusion import NodeFusionRule
     from .optimize import NodeOptimizationRule
+    from .streaming import StreamingPlanRule
 
     return RuleExecutor(
         [
@@ -209,6 +212,7 @@ def default_optimizer() -> RuleExecutor:
             Batch("cse", [EquivalentNodeMergeRule()], fixed_point=True),
             Batch("node-level-optimization", [NodeOptimizationRule()]),
             Batch("fusion", [NodeFusionRule()]),
+            Batch("streaming", [StreamingPlanRule()]),
         ]
     )
 
@@ -219,10 +223,13 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
     AFTER cache insertion: the cache planner profiles and splices against
     real node boundaries, so its decisions are byte-identical to
     pre-fusion plans, and inserted Cacher nodes then act as hard fusion
-    boundaries."""
+    boundaries — and as streaming-chain boundaries for the streaming
+    batch that follows (a stream starts from a Cacher's materialized
+    output, never crosses it)."""
     from .autocache import AutoCacheRule
     from .fusion import NodeFusionRule
     from .optimize import NodeOptimizationRule
+    from .streaming import StreamingPlanRule
 
     return RuleExecutor(
         [
@@ -234,5 +241,6 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
             Batch("node-level-optimization", [NodeOptimizationRule()]),
             Batch("auto-cache", [AutoCacheRule(budget_bytes=budget_bytes, strategy=strategy)]),
             Batch("fusion", [NodeFusionRule()]),
+            Batch("streaming", [StreamingPlanRule()]),
         ]
     )

@@ -1,0 +1,774 @@
+"""Streaming chunked execution: overlap ingest, transfer, and fused compute.
+
+The Pipeline API materializes every stage's output dataset — correct and
+optimizer-visible, but the reason featurization-heavy fits die at scale:
+the full feature matrix must exist before the solver sees a single row.
+The reference never pays that cost — featurization stays lazy per
+partition and feeds the solver incrementally (reference:
+ImageNetSiftLcsFV.scala:96-136) — and our hand-rolled flagship module
+(pipelines/imagenet_streaming.py) proved the TPU shape of the same idea:
+uint8 uploads double-buffered against fused per-chunk dispatches.
+
+This module generalizes that shape into the workflow layer:
+
+- :class:`StreamingPlanRule` (the LAST optimizer batch, after auto-cache
+  and fusion) rewrites eligible ``ingest/featurize-chain → estimator``
+  graphs: the featurize chain between the data source and a
+  ``fit_stream``-capable estimator is absorbed into a
+  :class:`StreamingFitOperator` that consumes the RAW dataset directly.
+- At fit time the operator drives a chunked plan: a bounded-prefetch
+  host pipeline (:class:`~keystone_tpu.data.ingest.PrefetchQueue` —
+  multi-worker decode/stack feeding a depth-limited queue), host→device
+  uploads that cross at the NARROWEST dtype
+  (:func:`~keystone_tpu.data.dataset.transfer_dtype`; uint8 images stay
+  uint8, 4× less traffic) and cast on device, and ONE fused XLA dispatch
+  per chunk composing cast → featurize chain → the estimator's
+  Gram-accumulation step, with the carry donated ping-pong style
+  (parallel/linalg.py streaming idiom).
+- Upload of chunk i+1 is issued before compute of chunk i completes
+  (double-buffering, asserted by scripts/streaming_smoke.sh), and the
+  full feature matrix never exists on host or device — only O(chunk)
+  host buffers and O(d²) device statistics.
+
+Estimator protocol: operators advertising ``supports_fit_stream = True``
+implement ``fit_stream(stream)`` where ``stream`` is a
+:class:`ChunkStream`; ``stream.fold(init_fn, step_fn)`` runs the engine
+loop with ``step_fn`` traced INTO the per-chunk dispatch. See
+``LeastSquaresEstimator`` / ``BlockLeastSquaresEstimator`` /
+``LinearMapEstimator`` and docs/STREAMING.md.
+
+Boundaries (mirror fusion's, docs/OPTIMIZER.md): Cacher nodes, saveable
+prefixes, multi-consumer intermediates, and bespoke-``apply_batch``
+transformers all cut the streamed chain — a cut chain streams from the
+boundary's materialized output instead (the Cacher-boundary parity case
+in tests/workflow/test_streaming.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+from ..data.dataset import (
+    ArrayDataset,
+    Dataset,
+    ObjectDataset,
+    default_ingest_workers,
+    transfer_dtype,
+)
+from ..obs import names as _names
+from ..obs import spans as _spans
+from ..reliability.faultinject import probe
+from .graph import Graph, NodeId, SourceId
+from .operators import DatasetOperator, EstimatorOperator, TransformerOperator
+from .rules import PrefixMap, Rule
+
+logger = logging.getLogger(__name__)
+
+
+# ------------------------------------------------------------------ enablement
+
+# Tri-state like fusion's: None → env default (on unless
+# KEYSTONE_STREAMING=off/0/disabled).
+_enabled: Optional[bool] = None
+_enabled_lock = threading.Lock()
+
+
+def streaming_enabled() -> bool:
+    if _enabled is not None:
+        return _enabled
+    return os.environ.get("KEYSTONE_STREAMING", "").lower() not in (
+        "off", "0", "disabled",
+    )
+
+
+def set_streaming_enabled(value: Optional[bool]) -> None:
+    """Force streaming on/off process-wide; ``None`` restores the env
+    default."""
+    global _enabled
+    with _enabled_lock:
+        _enabled = value
+
+
+@contextmanager
+def streaming_disabled():
+    """Scoped off-switch (parity tests build the materialized reference
+    here, exactly like fusion_disabled())."""
+    global _enabled
+    with _enabled_lock:
+        prev = _enabled
+        _enabled = False
+    try:
+        yield
+    finally:
+        with _enabled_lock:
+            _enabled = prev
+
+
+def stream_chunk_rows() -> int:
+    """Rows per streamed chunk (``KEYSTONE_STREAM_CHUNK_ROWS``, default
+    4096 — large enough to amortize dispatch, small enough that two host
+    chunk buffers stay far below any realistic feature matrix)."""
+    return max(1, int(os.environ.get("KEYSTONE_STREAM_CHUNK_ROWS", 4096)))
+
+
+def stream_min_rows() -> int:
+    """Plan-time eligibility floor for known-size datasets: below
+    max(2·chunk, this) the materialized path wins (one dispatch, no
+    pipeline overhead). ``KEYSTONE_STREAM_MIN_ROWS`` raises it."""
+    return int(os.environ.get("KEYSTONE_STREAM_MIN_ROWS", 0))
+
+
+def stream_prefetch_depth() -> int:
+    """Host prefetch-queue depth (``KEYSTONE_STREAM_PREFETCH``, default
+    1). The engine holds at most depth+1 host chunk buffers live — depth
+    queued plus one in hand being uploaded — so the default keeps peak
+    host residency at 2× chunk while still hiding decode behind compute."""
+    return max(1, int(os.environ.get("KEYSTONE_STREAM_PREFETCH", 1)))
+
+
+class StreamingFallback(Exception):
+    """Raised (internally, before any chunk is consumed) when a planned
+    streaming fit turns out ineligible at run time — the operator falls
+    back to the materialized path. Never used for mid-stream failures:
+    those propagate to the reliability layer."""
+
+
+# ------------------------------------------------------------- pipelined loop
+
+
+def stream_pipelined(
+    items: Iterable[Any],
+    stage: Callable[[Any], Any],
+    compute: Callable[[Any, Any], Any],
+    consume: Callable[[Any, Any], None],
+    prefetch: int = 2,
+) -> int:
+    """The shared double-buffered dispatch loop.
+
+    ``stage(item)`` issues the (async) host→device upload; ``compute``
+    dispatches device work on the staged value; ``consume`` forces and
+    drains a result ONE item behind the dispatch frontier — so staging
+    of item i+1 is always issued before the loop blocks on item i, and
+    transfer, device compute, and host copies overlap. This is the
+    engine under both the streaming fit path below and the ImageNet
+    flagship's per-bucket encode loop
+    (pipelines/imagenet_streaming.py), which used to hand-roll it.
+    Returns the number of items processed.
+    """
+    staged: List[Tuple[Any, Any]] = []
+    pending: List[Tuple[Any, Any]] = []
+    it = iter(items)
+    done = 0
+
+    def stage_next() -> bool:
+        try:
+            item = next(it)
+        except StopIteration:
+            return False
+        staged.append((stage(item), item))
+        return True
+
+    for _ in range(max(1, prefetch)):
+        stage_next()
+    while staged:
+        s, item = staged.pop(0)
+        pending.append((compute(s, item), item))
+        stage_next()
+        if len(pending) > 1:
+            r, r_item = pending.pop(0)
+            consume(r, r_item)
+            done += 1
+    while pending:
+        r, r_item = pending.pop(0)
+        consume(r, r_item)
+        done += 1
+    return done
+
+
+# ------------------------------------------------------------------- reporting
+
+
+@dataclass
+class StreamReport:
+    """What the last streaming fit actually did — the evidence the
+    smoke script and tests assert on (overlap, compiles, memory)."""
+
+    chunks: int = 0
+    chunk_rows: int = 0
+    num_examples: int = 0
+    bytes_transferred: int = 0
+    prefetch_depth: int = 0
+    host_buffer_peak_bytes: int = 0
+    stall_s: float = 0.0
+    compiles_first_chunk: int = 0
+    compiles_steady_state: int = 0
+    upload_issued_t: List[float] = field(default_factory=list)
+    dispatch_t: List[float] = field(default_factory=list)
+    compute_done_t: List[float] = field(default_factory=list)
+
+    def overlap_ok(self) -> bool:
+        """True when the upload of chunk i+1 was issued before compute
+        of chunk i was observed complete — the double-buffer invariant."""
+        if self.chunks < 2:
+            return True
+        return all(
+            self.upload_issued_t[i + 1] <= self.compute_done_t[i]
+            for i in range(self.chunks - 1)
+        )
+
+
+_last_report: Optional[StreamReport] = None
+_report_lock = threading.Lock()
+
+
+def last_stream_report() -> Optional[StreamReport]:
+    """The :class:`StreamReport` of the most recent streaming fit in
+    this process (None if none ran)."""
+    return _last_report
+
+
+def _publish_report(report: StreamReport) -> None:
+    global _last_report
+    with _report_lock:
+        _last_report = report
+    _names.metric(_names.STREAM_HOST_BUFFER_PEAK).set(
+        report.host_buffer_peak_bytes
+    )
+
+
+# ----------------------------------------------------------- fused chunk step
+
+# One jitted (cast → chain → re-zero → estimator step) callable per
+# (member instances, step_fn) pair, shared across folds — same rationale
+# as fusion's _shared_chain_jit: every fit of an unfitted pipeline builds
+# a fresh StreamingFitOperator, and a per-fold jit would retrace the
+# identical program every time (breaking the zero-steady-state-recompile
+# guarantee across repeated fits). Entries keep strong refs to members.
+_STEP_JIT_CACHE = None  # type: ignore
+_STEP_JIT_MAX = 32
+_step_cache_lock = threading.Lock()
+
+
+def _cast_tree(x):
+    import jax
+    import jax.numpy as jnp
+
+    def cast(a):
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            return a
+        return a.astype(jnp.float32)  # uint8/int/bool → f32 ON DEVICE
+
+    return jax.tree_util.tree_map(cast, x)
+
+
+def _apply_chain(members, x, mask):
+    import jax
+    import jax.numpy as jnp
+
+    x = _cast_tree(x)
+    for m in members:
+        x = m.apply_arrays(x)
+
+    # Re-zero pad rows once at the end of the chain (valid because
+    # apply_arrays is row-independent by the BatchTransformer contract)
+    # so the estimator's accumulation sees exact zeros — same discipline
+    # as BatchTransformer.apply_batch.
+    def zero_pad(a):
+        m = mask.reshape((-1,) + (1,) * (a.ndim - 1))
+        return jnp.where(m > 0, a, jnp.zeros((), dtype=a.dtype))
+
+    return jax.tree_util.tree_map(zero_pad, x)
+
+
+def _shared_step_jit(members: tuple, step_fn):
+    """jit of (carry, x_raw, y, mask) → (carry', probe), cached on
+    (member ids, step_fn id). Returns (callable, trace_counter_list) —
+    the counter appends at trace time only, making 'exactly one compile
+    per chunk shape' directly observable."""
+    global _STEP_JIT_CACHE
+    import jax
+
+    key = tuple(id(m) for m in members) + (id(step_fn),)
+    with _step_cache_lock:
+        if _STEP_JIT_CACHE is None:
+            from collections import OrderedDict
+
+            _STEP_JIT_CACHE = OrderedDict()
+        hit = _STEP_JIT_CACHE.get(key)
+        if hit is not None:
+            _STEP_JIT_CACHE.move_to_end(key)
+            return hit[1], hit[2]
+
+    traces: List[tuple] = []
+
+    def fused(carry, x_raw, y, mask):
+        traces.append(())  # trace-time side effect: once per new shape
+        x = _apply_chain(members, x_raw, mask)
+        new_carry = step_fn(carry, x, y)
+        leaf = jax.tree_util.tree_leaves(new_carry)[0]
+        probe = leaf.ravel()[:1]  # tiny, NOT donated: safe to block on
+        return new_carry, probe
+
+    jitted = jax.jit(fused, donate_argnums=(0,))
+    with _step_cache_lock:
+        _STEP_JIT_CACHE[key] = ((members, step_fn), jitted, traces)
+        _STEP_JIT_CACHE.move_to_end(key)
+        while len(_STEP_JIT_CACHE) > _STEP_JIT_MAX:
+            _STEP_JIT_CACHE.popitem(last=False)
+    return jitted, traces
+
+
+# ------------------------------------------------------------------ the stream
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    return sum(
+        getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _labels_host(labels: Dataset):
+    """Labels as one host (n, k) float-ready matrix. Labels are O(n·k) —
+    'the full feature matrix never materializes' is about features; a
+    label matrix is the estimator's RHS and is small by construction."""
+    import numpy as np
+
+    if isinstance(labels, ObjectDataset):
+        labels = labels.to_arrays()
+    if not isinstance(labels, ArrayDataset):
+        raise StreamingFallback(f"labels of type {type(labels).__name__}")
+    y = np.asarray(labels.data)[: labels.num_examples]
+    if y.ndim == 1:
+        y = y[:, None]
+    if y.ndim != 2:
+        raise StreamingFallback(f"labels must be rank ≤ 2, got {y.shape}")
+    return np.ascontiguousarray(y.astype(transfer_dtype(y.dtype), copy=False))
+
+
+class ChunkStream:
+    """The engine-side handle handed to ``Estimator.fit_stream``.
+
+    ``fold(init_fn, step_fn)`` drives the chunked plan:
+
+    - ``init_fn(feat_aval, y_aval)`` receives jax ShapeDtypeStructs of
+      the FEATURIZED chunk (post-chain, computed via ``jax.eval_shape``
+      without touching data) and the label chunk, and returns the
+      initial carry pytree. Raise :class:`StreamingFallback` here to
+      reject the shape (nothing has been prefetched yet).
+    - ``step_fn(carry, x_feat, y) -> carry`` is traced INTO the single
+      per-chunk dispatch, after the featurize chain, with the carry
+      donated — the Gram-accumulation protocol.
+
+    Returns ``(carry, info)`` where info has ``num_examples``, ``d``
+    (featurized width) and the :class:`StreamReport`.
+    """
+
+    def __init__(
+        self,
+        data: Dataset,
+        labels: Optional[Dataset],
+        members: Sequence[TransformerOperator],
+        chunk_rows: Optional[int] = None,
+        prefetch: Optional[int] = None,
+        workers: Optional[int] = None,
+    ):
+        self.data = data
+        self.labels = labels
+        self.members = tuple(members)
+        self.chunk_rows = chunk_rows or stream_chunk_rows()
+        self.prefetch = prefetch or stream_prefetch_depth()
+        self.workers = workers or min(default_ingest_workers(), 4)
+        self.num_examples = len(data)
+        self._feat_aval = None
+
+    def feature_aval(self):
+        """Shape/dtype of one FEATURIZED chunk (shape-only trace of the
+        chain, no data touched). Raises :class:`StreamingFallback` when
+        the chain can't shape-trace or the dataset isn't chunkable."""
+        if self._feat_aval is None:
+            import jax
+            import numpy as np
+
+            x_spec = _chunk_spec(self.data, self.chunk_rows)
+            mask_spec = jax.ShapeDtypeStruct((self.chunk_rows, 1), np.float32)
+            try:
+                self._feat_aval = jax.eval_shape(
+                    lambda x, m: _apply_chain(self.members, x, m),
+                    x_spec,
+                    mask_spec,
+                )
+            except StreamingFallback:
+                raise
+            except Exception as e:
+                raise StreamingFallback(
+                    f"chain not shape-traceable: {e}"
+                ) from e
+        return self._feat_aval
+
+    # ---------------------------------------------------------------- fold
+    def fold(self, init_fn, step_fn):
+        import jax
+        import numpy as np
+
+        from ..parallel.linalg import _quiet_unused_donation_warnings
+
+        data, chunk_rows = self.data, self.chunk_rows
+        n = self.num_examples
+        if self.labels is None:
+            raise StreamingFallback("no labels bound for a supervised fit")
+        y_host = _labels_host(self.labels)
+        if y_host.shape[0] < n:
+            raise StreamingFallback(
+                f"labels rows {y_host.shape[0]} < data rows {n}"
+            )
+
+        # Shape-only pass: featurized aval without touching data.
+        feat_aval = self.feature_aval()
+        y_spec = jax.ShapeDtypeStruct((chunk_rows, y_host.shape[1]), y_host.dtype)
+        carry = init_fn(feat_aval, y_spec)
+
+        _quiet_unused_donation_warnings()  # carries are donated each step
+        step, traces = _shared_step_jit(self.members, step_fn)
+
+        if not hasattr(type(data), "fetch_rows") or (
+            type(data).fetch_rows is Dataset.fetch_rows
+        ):
+            raise StreamingFallback(f"{type(data).__name__} is not chunkable")
+        windows = [
+            (s, min(s + chunk_rows, n)) for s in range(0, n, chunk_rows)
+        ]
+
+        def prepare(window):
+            start, stop = window
+            # fetch_rows runs inside the prefetch workers — this is the
+            # decode/stack work being overlapped with device compute.
+            x = data.fetch_rows(start, stop)
+            x = jax.tree_util.tree_map(
+                lambda a: _pad_narrow(a, chunk_rows), x
+            )
+            rows = stop - start
+            y = y_host[start:stop]
+            if rows < chunk_rows:  # tail chunk: pad to the compiled shape
+                y = np.concatenate(
+                    [y, np.zeros((chunk_rows - rows,) + y.shape[1:], y.dtype)]
+                )
+            mask = np.zeros((chunk_rows, 1), np.float32)
+            mask[:rows] = 1.0
+            return x, y, mask, rows
+
+        report = StreamReport(
+            chunk_rows=chunk_rows,
+            num_examples=n,
+            prefetch_depth=self.prefetch,
+        )
+        chunks_c = _names.metric(_names.STREAM_CHUNKS)
+        bytes_c = _names.metric(_names.STREAM_BYTES)
+        from ..data.ingest import PrefetchQueue
+
+        queue = PrefetchQueue(
+            iter(windows),
+            prepare,
+            depth=self.prefetch,
+            workers=min(self.workers, self.prefetch),
+            size_of=lambda c: _tree_nbytes(c[0]) + c[1].nbytes,
+        )
+        in_hand_peak = 0
+        t0 = time.perf_counter()
+
+        # The loop below IS stream_pipelined — the same engine that runs
+        # the flagship's per-bucket encode — with the carry threaded and
+        # the report timestamps recorded through the three callbacks.
+        # consume() drains one item behind the dispatch frontier, so the
+        # upload of chunk i+1 (stage) is always issued before the loop
+        # blocks on chunk i — the double-buffer invariant the smoke
+        # script asserts via the event log.
+        def stage(chunk):
+            nonlocal in_hand_peak
+            x, y, mask, rows = chunk
+            nbytes = _tree_nbytes(x) + y.nbytes + mask.nbytes
+            in_hand_peak = max(in_hand_peak, nbytes)
+            report.upload_issued_t.append(time.perf_counter() - t0)
+            # Async uploads at transfer (narrow) width; cast happens on
+            # device inside the fused step.
+            dev = (
+                jax.tree_util.tree_map(jax.device_put, x),
+                jax.device_put(y),
+                jax.device_put(mask),
+                rows,
+            )
+            report.bytes_transferred += nbytes
+            bytes_c.inc(nbytes)
+            return dev
+
+        def compute(staged_chunk, _chunk):
+            nonlocal carry
+            x_dev, y_dev, mask_dev, _rows = staged_chunk
+            probe("streaming.chunk")
+            report.dispatch_t.append(time.perf_counter() - t0)
+            carry, probe_out = step(carry, x_dev, y_dev, mask_dev)
+            chunks_c.inc()
+            report.chunks += 1
+            if report.chunks == 1:
+                report.compiles_first_chunk = len(traces)
+            return probe_out
+
+        def consume(probe_out, _chunk):
+            probe_out.block_until_ready()
+            report.compute_done_t.append(time.perf_counter() - t0)
+
+        try:
+            with _spans.span(
+                "stream:fold", chunks=len(windows), chunk_rows=chunk_rows
+            ):
+                stream_pipelined(
+                    queue, stage=stage, compute=compute, consume=consume,
+                    prefetch=1,
+                )
+        finally:
+            queue.close()
+            report.stall_s = queue.stall_s
+            report.host_buffer_peak_bytes = (
+                queue.peak_live_bytes + in_hand_peak
+            )
+            report.compiles_steady_state = (
+                len(traces) - report.compiles_first_chunk
+            )
+            _publish_report(report)
+
+        info = {
+            "num_examples": n,
+            "chunks": report.chunks,
+            "report": report,
+        }
+        return carry, info
+
+
+def _chunk_spec(data: Dataset, chunk_rows: int):
+    """ShapeDtypeStructs of one padded chunk at TRANSFER dtype."""
+    import jax
+    import numpy as np
+
+    if isinstance(data, ArrayDataset):
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(
+                (chunk_rows,) + tuple(a.shape[1:]),
+                transfer_dtype(getattr(a, "dtype", np.float32)),
+            ),
+            data.data,
+        )
+    if isinstance(data, ObjectDataset):
+        if not len(data):
+            raise StreamingFallback("empty dataset")
+        first = data.take(1)[0]
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.ShapeDtypeStruct(
+                (chunk_rows,) + np.asarray(leaf).shape,
+                transfer_dtype(np.asarray(leaf).dtype),
+            ),
+            first,
+        )
+    raise StreamingFallback(f"{type(data).__name__} is not chunkable")
+
+
+def _pad_narrow(a, chunk_rows: int):
+    """Narrow a host leaf to its transfer dtype and zero-pad the tail
+    chunk to the compiled chunk shape (one shape → one compile)."""
+    import numpy as np
+
+    a = np.asarray(a)
+    narrow = transfer_dtype(a.dtype)
+    if narrow != a.dtype:
+        a = a.astype(narrow)
+    rows = a.shape[0]
+    if rows < chunk_rows:
+        a = np.concatenate(
+            [a, np.zeros((chunk_rows - rows,) + a.shape[1:], a.dtype)]
+        )
+    return np.ascontiguousarray(a)
+
+
+# ------------------------------------------------------------------- operator
+
+
+class StreamingFitOperator(EstimatorOperator):
+    """An estimator node rewritten onto the streaming engine.
+
+    Wraps the original estimator plus the featurize-chain members that
+    were between it and the data source; depends directly on the RAW
+    data (plus labels). At force time it streams chunks through ONE
+    fused dispatch per chunk into ``estimator.fit_stream``; if run-time
+    eligibility fails (small data, unchunkable dataset, untraceable
+    chain) it reproduces the materialized path exactly — member-by-member
+    batch application then ``fit_datasets`` — so a planned-but-infeasible
+    stream can never change results.
+    """
+
+    def __init__(
+        self,
+        estimator: EstimatorOperator,
+        members: Sequence[TransformerOperator],
+        chunk_rows: Optional[int] = None,
+        prefetch: Optional[int] = None,
+    ):
+        self.estimator = estimator
+        self.members = tuple(members)
+        self.chunk_rows = chunk_rows
+        self.prefetch = prefetch
+
+    @property
+    def label(self) -> str:
+        est = getattr(self.estimator, "label", type(self.estimator).__name__)
+        return f"StreamFit[{est}+{len(self.members)}ops]"
+
+    def fit_datasets(self, datasets: List[Dataset]) -> TransformerOperator:
+        data = datasets[0]
+        labels = datasets[1] if len(datasets) > 1 else None
+        chunk_rows = self.chunk_rows or stream_chunk_rows()
+        with _spans.span(
+            "stream:fit",
+            estimator=str(getattr(self.estimator, "label", "")),
+            members=len(self.members),
+            chunk_rows=chunk_rows,
+        ) as span:
+            # A planned-but-unknowable head (Cacher etc.) may yield a
+            # Dataset subclass without even a length — that is a
+            # fallback, not a crash (the materialized path handles it).
+            try:
+                n_rows = len(data)
+            except Exception:
+                n_rows = -1
+            if streaming_enabled() and n_rows >= max(
+                2 * chunk_rows, stream_min_rows()
+            ):
+                try:
+                    stream = ChunkStream(
+                        data,
+                        labels,
+                        self.members,
+                        chunk_rows=chunk_rows,
+                        prefetch=self.prefetch,
+                    )
+                    return self.estimator.fit_stream(stream)
+                except StreamingFallback as e:
+                    logger.info(
+                        "streaming fit of %s fell back to the materialized "
+                        "path: %s", self.label, e,
+                    )
+                    span.set_attribute("fallback", str(e))
+            else:
+                span.set_attribute("fallback", "below row floor or disabled")
+            featurized = data
+            for m in self.members:
+                featurized = m.batch_transform([featurized])
+            rest = [labels] if labels is not None else []
+            return self.estimator.fit_datasets([featurized] + rest)
+
+
+# ----------------------------------------------------------------- the rule
+
+
+def _streamable_member(op) -> bool:
+    from .fusion import FusedTransformerOperator, is_fusable
+
+    return isinstance(op, FusedTransformerOperator) or is_fusable(op)
+
+
+class StreamingPlanRule(Rule):
+    """Rewrite eligible ``data → featurize-chain → estimator`` shapes
+    onto the streaming engine.
+
+    Runs LAST (after auto-cache and fusion, docs/OPTIMIZER.md): the
+    chain it absorbs is usually already one FusedTransformerOperator,
+    whose members it flattens into the per-chunk dispatch. A chain
+    member is absorbable under exactly the fusion rules (array-in/
+    array-out, single consumer, unary, outside the prefix map); the
+    walk stops at Cacher nodes, saveable prefixes, and fan-out — the
+    stream then starts from that boundary's materialized output.
+
+    Plan-time gates: the estimator advertises ``supports_fit_stream``;
+    a known-size head (a bound ``DatasetOperator``) must hold at least
+    max(2·chunk, ``KEYSTONE_STREAM_MIN_ROWS``) rows; an unknown-size
+    head (e.g. a Cacher) is rewritten only when there is a featurize
+    chain to fuse into the chunk dispatches, and the operator's own
+    run-time gate makes the final call.
+    """
+
+    def __init__(self, chunk_rows: Optional[int] = None):
+        self.chunk_rows = chunk_rows
+
+    def apply(self, graph: Graph, prefixes: PrefixMap) -> Tuple[Graph, PrefixMap]:
+        if not streaming_enabled():
+            return graph, prefixes
+        chunk_rows = self.chunk_rows or stream_chunk_rows()
+        rewrites = 0
+        for node in sorted(graph.nodes):
+            if node not in graph.operators:
+                continue  # absorbed into an earlier rewrite
+            op = graph.get_operator(node)
+            if isinstance(op, StreamingFitOperator):
+                continue
+            if not isinstance(op, EstimatorOperator):
+                continue
+            if not getattr(op, "supports_fit_stream", False):
+                continue
+            deps = graph.get_dependencies(node)
+            if not deps:
+                continue
+            dependents = graph.dependents()
+            chain: List[NodeId] = []
+            cur = deps[0]
+            while isinstance(cur, NodeId):
+                consumers = dependents.get(cur, [])
+                if (
+                    len(consumers) == 1
+                    and cur not in prefixes
+                    and len(graph.get_dependencies(cur)) == 1
+                    and _streamable_member(graph.get_operator(cur))
+                ):
+                    chain.append(cur)
+                    cur = graph.get_dependencies(cur)[0]
+                else:
+                    break
+            head = cur
+            if isinstance(head, SourceId):
+                continue  # unbound input: nothing to chunk at plan time
+            head_op = graph.get_operator(head)
+            if isinstance(head_op, DatasetOperator):
+                ds = head_op.dataset
+                if not isinstance(ds, (ArrayDataset, ObjectDataset)):
+                    continue
+                if len(ds) < max(2 * chunk_rows, stream_min_rows()):
+                    continue
+            elif not chain:
+                # Unknown size AND nothing to fuse per chunk: the
+                # rewrite could only reproduce the materialized fit.
+                continue
+
+            from .fusion import FusedTransformerOperator
+
+            members: List[TransformerOperator] = []
+            for cn in reversed(chain):  # head-first application order
+                m = graph.get_operator(cn)
+                if isinstance(m, FusedTransformerOperator):
+                    members.extend(m.members)
+                else:
+                    members.append(m)
+            streaming_op = StreamingFitOperator(
+                op, members, chunk_rows=self.chunk_rows
+            )
+            graph = graph.set_operator(node, streaming_op)
+            graph = graph.set_dependencies(node, (head,) + tuple(deps[1:]))
+            for cn in chain:  # estimator-adjacent first: now unreferenced
+                graph = graph.remove_node(cn)
+            rewrites += 1
+        if rewrites:
+            _names.metric(_names.STREAM_PLANS).inc(rewrites)
+        return graph, prefixes
