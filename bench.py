@@ -1361,6 +1361,34 @@ def _leg_obs_snapshot(before: dict) -> dict:
     }
 
 
+def _record_leg_profile(name: str, leg: dict, small: bool) -> None:
+    """Persist the leg's headline numbers into the profile store
+    (docs/OBSERVABILITY.md): the run-over-run history `bench-diff`
+    formalizes, kept next to the XLA cache so future sessions can read
+    what this machine measured. Errored legs record nothing; a broken
+    store never breaks the bench."""
+    try:
+        from keystone_tpu.obs.store import get_store
+
+        store = get_store()
+        if store is None or "error" in leg or "skipped" in leg:
+            return
+        measurements = {
+            k: v for k, v in leg.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        obs = leg.get("obs", {})
+        if isinstance(obs, dict):
+            for k in ("xla_compiles", "lifetime_peak_memory_bytes"):
+                if isinstance(obs.get(k), (int, float)):
+                    measurements[k] = obs[k]
+        store.record(
+            f"bench:{name}", "small" if small else "full", **measurements
+        )
+    except Exception:
+        pass
+
+
 def child_main(small: bool, workload: str | None = None) -> int:
     import jax
 
@@ -1413,6 +1441,7 @@ def child_main(small: bool, workload: str | None = None) -> int:
             report[name] = {"error": f"{type(e).__name__}: {e}"[:500]}
         report[name]["wall_s"] = round(time.time() - t0, 1)
         report[name]["obs"] = _leg_obs_snapshot(obs_before)
+        _record_leg_profile(name, report[name], small)
         if partial_path:
             _dump_partial(
                 {"partial": True, "phase": "cpu_insurance", **report},

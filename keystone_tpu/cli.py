@@ -236,6 +236,17 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_profile_arguments(profile_parser)
 
+    # Perf-regression gate (docs/OBSERVABILITY.md): compare two BENCH
+    # json artifacts leg by leg with noise-aware tolerances. Entirely
+    # stdlib — CI runs it without a backend.
+    from .obs.benchdiff import add_bench_diff_arguments
+
+    bench_diff_parser = sub.add_parser(
+        "bench-diff",
+        help="compare two BENCH_*.json artifacts; exit 1 on perf regression",
+    )
+    add_bench_diff_arguments(bench_diff_parser)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -247,6 +258,7 @@ def main(argv: Optional[list] = None) -> int:
             print(f"{name:28s} {entry[-1]}")
         print(f"{'serve':28s} online serving front-end (micro-batched, stdin/JSON)")
         print(f"{'profile':28s} instrumented run → Chrome trace + Prometheus snapshot")
+        print(f"{'bench-diff':28s} compare two BENCH json artifacts, fail on regression")
         return 0
 
     # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;
@@ -263,6 +275,11 @@ def main(argv: Optional[list] = None) -> int:
         from .serving.server import serve_from_args
 
         return serve_from_args(args)
+
+    if args.workload == "bench-diff":
+        from .obs.benchdiff import bench_diff_from_args
+
+        return bench_diff_from_args(args)
 
     if args.workload == "profile":
         from .obs.profile import profile_from_args

@@ -13,6 +13,12 @@ ledger's counts):
   attribution, optional ``jax.profiler.TraceAnnotation`` wrapping.
 - :mod:`.export` — Chrome trace-event JSON (Perfetto), Prometheus text,
   and a human span-tree report.
+- :mod:`.store` — the persistent profile store: measurements keyed by
+  structural digest + shape class + backend, persisted next to the XLA
+  cache, consumed by the optimizer (autocache warm-start, measured
+  knobs) and the bench-diff gate.
+- :mod:`.benchdiff` — ``keystone-tpu bench-diff``: run-over-run BENCH
+  comparison with a regression verdict.
 - :mod:`.profile` — the ``keystone-tpu profile`` harness.
 
 The package is stdlib-only at import time (jax is imported lazily inside
@@ -42,6 +48,14 @@ from .spans import (
     span,
     tracing_session,
 )
+from .store import (
+    ProfileStore,
+    dataset_shape_class,
+    get_store,
+    set_store,
+    shape_class,
+    store_enabled,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "get_registry",
@@ -49,4 +63,6 @@ __all__ = [
     "NOOP_SPAN", "Span", "TraceSession", "active_session", "add_span_event",
     "attach", "current_context", "current_span", "record_span", "span",
     "tracing_session",
+    "ProfileStore", "get_store", "set_store", "store_enabled",
+    "shape_class", "dataset_shape_class",
 ]

@@ -108,18 +108,105 @@ def memory_snapshot() -> Dict[str, Any]:
     }
 
 
+def per_device_snapshots() -> list:
+    """One memory snapshot per local accelerator device, labeled with the
+    device's stable id (``tpu:0`` …). Devices that expose no
+    ``memory_stats`` (CPU meshes) collapse to a single host-RSS entry
+    labeled ``host`` — per-virtual-device RSS attribution would be
+    fiction. Empty list when jax is unavailable."""
+    out = []
+    try:
+        import jax
+
+        for dev in jax.local_devices():
+            try:
+                stats = dev.memory_stats()
+            except AttributeError:
+                stats = None  # backend has no memory_stats: not an error
+            except Exception as e:
+                # The chip most likely to be OOMing/wedged is exactly the
+                # one whose stats call fails — surface it as an error
+                # entry instead of silently shrinking the device list.
+                out.append(
+                    {
+                        "device": f"{dev.platform}:{dev.id}",
+                        "source": "error",
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                )
+                continue
+            if stats and "bytes_in_use" in stats:
+                out.append(
+                    {
+                        "device": f"{dev.platform}:{dev.id}",
+                        "source": "device",
+                        "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                        "peak_bytes_in_use": int(
+                            stats.get(
+                                "peak_bytes_in_use",
+                                stats.get("bytes_in_use", 0),
+                            )
+                        ),
+                    }
+                )
+    except Exception:
+        return out
+    if not out:
+        host = memory_snapshot()
+        host["device"] = "host"
+        out.append(host)
+    return out
+
+
 def publish_memory(stage: Optional[str] = None) -> Dict[str, Any]:
-    """Sample memory and publish it to the registry: the in-use gauge
-    always, plus per-stage peak attribution when ``stage`` is given."""
+    """Sample memory and publish it to the registry: the aggregate in-use
+    gauge always (``device="all"``), plus per-stage peak attribution when
+    ``stage`` is given. :func:`publish_per_device_memory` adds the
+    per-device series."""
     snap = memory_snapshot()
     names.metric(names.MEMORY_IN_USE_BYTES).set(
-        snap["bytes_in_use"], source=snap["source"]
+        snap["bytes_in_use"], source=snap["source"], device="all"
     )
     if stage is not None:
         names.metric(names.PEAK_MEMORY_BYTES).max(
-            snap["peak_bytes_in_use"], stage=stage
+            snap["peak_bytes_in_use"], stage=stage, device="all"
         )
     return snap
+
+
+def publish_per_device_memory(stage: Optional[str] = None) -> list:
+    """Publish one gauge series per local device (multichip runs — one
+    chip OOMing while seven idle is invisible in the aggregate) and
+    return the snapshots."""
+    snaps = per_device_snapshots()
+    in_use = names.metric(names.MEMORY_IN_USE_BYTES)
+    peak = names.metric(names.PEAK_MEMORY_BYTES)
+    for snap in snaps:
+        if "error" in snap:
+            continue  # error entries carry no bytes to publish
+        in_use.set(
+            snap["bytes_in_use"], source=snap["source"], device=snap["device"]
+        )
+        if stage is not None:
+            peak.max(
+                snap["peak_bytes_in_use"], stage=stage, device=snap["device"]
+            )
+    return snaps
+
+
+def device_obs_payload(snapshots: Optional[list] = None) -> Dict[str, Any]:
+    """The per-device observability payload multichip dryruns embed in
+    their artifact (MULTICHIP_r0*.json recorded parity but no telemetry):
+    per-device memory plus the process compile count. Pass ``snapshots``
+    (e.g. :func:`publish_per_device_memory`'s return) to reuse an
+    already-taken sample — the published gauges and the embedded payload
+    then agree instead of re-walking the devices twice."""
+    from ..utils.compilation_cache import compile_count
+
+    return {
+        "devices": per_device_snapshots() if snapshots is None else snapshots,
+        "xla_compiles": compile_count(),
+    }
 
 
 @contextmanager

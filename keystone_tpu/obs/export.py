@@ -33,8 +33,74 @@ def _json_safe(value: Any) -> Any:
     return str(value)
 
 
-def chrome_trace(session: TraceSession) -> Dict[str, Any]:
-    """The session's spans as a Chrome trace-event JSON object."""
+# Synthetic track ids for the streaming engine's per-chunk slices: the
+# upload and compute frontiers are pipeline stages, not threads, so they
+# get their own named Perfetto tracks next to the real span threads.
+_STREAM_UPLOAD_TID = 900001
+_STREAM_COMPUTE_TID = 900002
+
+
+def stream_report_events(
+    report: Any, base_s: float, pid: int
+) -> List[Dict[str, Any]]:
+    """The last streaming fit's per-chunk event log as Chrome ``ph:X``
+    slices: one ``chunk i upload`` slice (upload issued → dispatch) on a
+    ``stream-upload`` track and one ``chunk i compute`` slice (dispatch →
+    compute observed done) on ``stream-compute`` — so the double-buffer
+    overlap (``StreamReport.overlap_ok``) is visually inspectable in
+    Perfetto alongside node spans. ``base_s`` is the session's
+    perf_counter origin; the report's timestamps are offsets from its own
+    ``t0_s`` anchor."""
+    events: List[Dict[str, Any]] = []
+    if report is None or not getattr(report, "dispatch_t", None):
+        return events
+    origin = (getattr(report, "t0_s", 0.0) or 0.0) - base_s
+
+    def slice_event(name: str, tid: int, start: float, end: float, **args):
+        events.append(
+            {
+                "name": name,
+                "cat": "stream",
+                "ph": "X",
+                "ts": round((origin + start) * 1e6, 3),
+                "dur": round(max(end - start, 0.0) * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": args,
+            }
+        )
+
+    uploads = report.upload_issued_t
+    dispatches = report.dispatch_t
+    done = report.compute_done_t
+    for i, t_disp in enumerate(dispatches):
+        if i < len(uploads):
+            slice_event(
+                f"chunk {i} upload", _STREAM_UPLOAD_TID, uploads[i], t_disp,
+                chunk=i, chunk_rows=report.chunk_rows,
+            )
+        if i < len(done):
+            slice_event(
+                f"chunk {i} compute", _STREAM_COMPUTE_TID, t_disp, done[i],
+                chunk=i,
+            )
+    for tid, name in (
+        (_STREAM_UPLOAD_TID, "stream-upload"),
+        (_STREAM_COMPUTE_TID, "stream-compute"),
+    ):
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": name}}
+        )
+    return events
+
+
+def chrome_trace(
+    session: TraceSession, stream_report: Any = None
+) -> Dict[str, Any]:
+    """The session's spans as a Chrome trace-event JSON object; pass the
+    last :class:`~keystone_tpu.workflow.streaming.StreamReport` to also
+    emit its per-chunk upload/compute slices (:func:`stream_report_events`)."""
     import os
 
     pid = os.getpid()
@@ -88,6 +154,7 @@ def chrome_trace(session: TraceSession) -> Dict[str, Any]:
                 "args": {"name": thread_name or f"thread-{tid}"},
             }
         )
+    events.extend(stream_report_events(stream_report, session.started_s, pid))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -100,9 +167,11 @@ def chrome_trace(session: TraceSession) -> Dict[str, Any]:
     }
 
 
-def write_chrome_trace(session: TraceSession, path: str) -> str:
+def write_chrome_trace(
+    session: TraceSession, path: str, stream_report: Any = None
+) -> str:
     with open(path, "w") as f:
-        json.dump(chrome_trace(session), f)
+        json.dump(chrome_trace(session, stream_report=stream_report), f)
     return path
 
 

@@ -48,6 +48,15 @@ AUTOCACHE_HITS = "keystone_autocache_hits_total"
 AUTOCACHE_MISSES = "keystone_autocache_misses_total"
 AUTOCACHE_PROFILE_SECONDS = "keystone_autocache_profile_seconds"
 
+# --------------------------------------------------------------- profile store
+PROFILE_STORE_HITS = "keystone_profile_store_hits_total"
+PROFILE_STORE_MISSES = "keystone_profile_store_misses_total"
+PROFILE_STORE_WRITES = "keystone_profile_store_writes_total"
+PROFILE_STORE_EVICTIONS = "keystone_profile_store_evictions_total"
+PROFILE_STORE_INVALIDATIONS = "keystone_profile_store_invalidations_total"
+PROFILE_STORE_ENTRIES = "keystone_profile_store_entries"
+PROFILE_STORE_KNOB_OVERRIDES = "keystone_profile_store_knob_overrides_total"
+
 # --------------------------------------------------------------------- solvers
 SOLVER_FIT_SECONDS = "keystone_solver_fit_seconds"
 SOLVER_RUNG_ATTEMPTS = "keystone_solver_rung_attempts_total"
@@ -110,6 +119,13 @@ SCHEMA: Dict[str, Tuple] = {
     AUTOCACHE_HITS: ("counter", "Re-reads of a cached (Cacher) node's memoized result", ()),
     AUTOCACHE_MISSES: ("counter", "First executions of a Cacher node", ()),
     AUTOCACHE_PROFILE_SECONDS: ("histogram", "Auto-cache sample-profiling passes", ()),
+    PROFILE_STORE_HITS: ("counter", "Profile-store lookups served from a valid persisted entry", ()),
+    PROFILE_STORE_MISSES: ("counter", "Profile-store lookups with no usable entry", ()),
+    PROFILE_STORE_WRITES: ("counter", "Observations appended to the profile store", ()),
+    PROFILE_STORE_EVICTIONS: ("counter", "Entries evicted (LRU-by-write) at profile-store compaction", ()),
+    PROFILE_STORE_INVALIDATIONS: ("counter", "Entries rejected for a stale environment fingerprint", ()),
+    PROFILE_STORE_ENTRIES: ("gauge", "Live entries in the profile store", ()),
+    PROFILE_STORE_KNOB_OVERRIDES: ("counter", "Plan knobs overridden from measured observations by MeasuredKnobRule", ("knob",)),
     SOLVER_FIT_SECONDS: ("histogram", "Solver fit wall time", ("solver",)),
     SOLVER_RUNG_ATTEMPTS: ("counter", "Degradation-ladder rung attempts inside solvers", ("solver",)),
     SOLVER_ITERATIONS: ("counter", "Host-level solver iterations (e.g. L-BFGS steps)", ("solver",)),
@@ -133,8 +149,8 @@ SCHEMA: Dict[str, Tuple] = {
     SERVING_LATENCY_SECONDS: ("histogram", "End-to-end request latency", ()),
     SERVING_QUEUE_WAIT_SECONDS: ("histogram", "Submit-to-apply queue wait", ()),
     SERVING_BATCH_OCCUPANCY: ("histogram", "Batch size / max_batch", (), "ratio"),
-    MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source",)),
-    PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage",)),
+    MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source", "device")),
+    PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage", "device")),
 }
 
 ALL_METRIC_NAMES: Tuple[str, ...] = tuple(sorted(SCHEMA))

@@ -51,8 +51,13 @@ def add_profile_arguments(parser) -> None:
         help="online requests to fire through PipelineServer (default: 32)",
     )
     parser.add_argument(
-        "--out", default=".",
-        help="directory for profile_trace.json / profile_metrics.prom",
+        "--out", default=None,
+        help="deprecated alias of --out-dir",
+    )
+    parser.add_argument(
+        "--out-dir", default=None, dest="out_dir",
+        help="directory for profile_trace.json / profile_metrics.prom "
+             "(default: current directory)",
     )
     parser.add_argument(
         "--no-autocache", action="store_true",
@@ -75,10 +80,16 @@ def profile_from_args(args) -> int:
         num_ffts=args.num_ffts,
         block_size=args.block_size,
         serve_requests=0 if args.no_serve else args.serve_requests,
-        out_dir=args.out,
+        out_dir=args.out_dir or args.out or ".",
         autocache=not args.no_autocache,
         annotations=args.device_annotations,
     )
+    # Store round-trip evidence (asserted by scripts/profile_smoke.sh):
+    # hits prove a previous run's measurements were read back, writes
+    # prove this run's were persisted.
+    print("PROFILE_STORE:" + json.dumps(result["summary"].get(
+        "profile_store", {"enabled": False}
+    )))
     print("PROFILE_JSON:" + json.dumps(result["summary"]))
     return 0
 
@@ -101,6 +112,7 @@ def run_profile(
     )
     from ..workflow.executor import PipelineEnv
     from ..workflow.rules import auto_caching_optimizer
+    from . import store as obs_store
 
     names.register_all()
     annotations_before = device.annotations_enabled()
@@ -118,6 +130,17 @@ def run_profile(
         "num_ffts": config.num_ffts,
         "block_size": config.block_size,
     }
+
+    # Profile-store round trip: remember this harness run's phase walls
+    # per workload shape, and surface the PREVIOUS run's next to them —
+    # the CLI's own run-over-run comparison (docs/OBSERVABILITY.md).
+    store = obs_store.get_store()
+    store_key = f"profile:mnist_fft:ffts{config.num_ffts}"
+    store_shape = obs_store.shape_class(rows, (config.block_size,))
+    if store is not None:
+        previous = store.lookup(store_key, store_shape)
+        if previous is not None:
+            summary["previous"] = previous
 
     env = PipelineEnv.get_or_create()
     optimizer_before = env._optimizer  # restore below: run_profile is a
@@ -148,8 +171,20 @@ def run_profile(
         env._optimizer = optimizer_before
         device.set_device_annotations(annotations_before)
 
+    if store is not None:
+        store.record(
+            store_key, store_shape,
+            fit_s=summary.get("fit_s"), apply_s=summary.get("apply_s"),
+        )
+        summary["profile_store"] = {"enabled": True, **store.stats()}
+    else:
+        summary["profile_store"] = {"enabled": False}
+
+    from ..workflow.streaming import last_stream_report
+
     trace_path = export.write_chrome_trace(
-        session, os.path.join(out_dir, "profile_trace.json")
+        session, os.path.join(out_dir, "profile_trace.json"),
+        stream_report=last_stream_report(),
     )
     prom_path = export.write_prometheus(
         os.path.join(out_dir, "profile_metrics.prom"), registry

@@ -1,0 +1,491 @@
+"""Persistent profile store: measurements that survive the process.
+
+KeystoneML's signature capability is an optimizer driven by *measured*
+profiles — but measuring and forgetting makes every fit pay the
+measurement again. This module is the system's long-term memory: a
+JSON-lines store of per-node and per-subsystem observations, persisted
+next to the XLA compilation cache (the other thing that makes second
+runs cheap), keyed so an observation is only ever reused where it is
+valid:
+
+    (key, shape_class, backend)
+
+- ``key`` — what was measured: a structural digest for pipeline nodes
+  (``reliability.checkpoint.prefix_digest`` of the node's operator
+  ancestry — content-hashed, so different data or config is a different
+  key), or a namespaced string for subsystem observations
+  (``stream:<chain>:cr<rows>``, ``solver:block_ls:bs<b>:prec<mode>``,
+  ``bench:<leg>``).
+- ``shape_class`` — the input scale bucket (:func:`shape_class`): row
+  count bucketed to the next power of two plus exact trailing dims and
+  dtype, so a measurement taken at n=100k is not applied to n=10.
+- ``backend`` — jax platform (cpu/tpu): device economics differ.
+
+Every entry additionally carries an **environment fingerprint** (jax
+version, backend, device kind). A fingerprint mismatch at lookup time
+invalidates the entry — a store written under jax 0.4.37 on a v5e says
+nothing about the next jax on a v6 — counted in
+``keystone_profile_store_invalidations_total``.
+
+Durability/concurrency contract (same spirit as ``CheckpointStore``):
+
+- Appends are single JSON lines under an exclusive ``flock`` on a
+  sidecar lock file, so two processes profiling the same digest
+  interleave whole lines, never torn ones; readers additionally skip
+  unparseable lines, so even a torn write (crash mid-append) degrades to
+  a missed observation, not a corrupt store.
+- **Merge-on-write compaction**: when the file outgrows its bound, the
+  whole file is re-read under the lock (picking up other processes'
+  appends), merged newest-wins per key, evicted LRU-by-write down to
+  ``max_entries``, and atomically replaced (tmp + rename).
+
+Consumers (the measurement→decision loop, docs/OBSERVABILITY.md):
+
+1. ``AutoCacheRule`` warm-starts its cost model from stored node
+   profiles and skips scaled-sample re-execution entirely when the
+   store covers every node of the plan.
+2. ``MeasuredKnobRule`` (workflow/knobs.py) overrides chunk-rows /
+   solver-precision / block-size *defaults* per shape class from the
+   best recorded observation.
+3. ``keystone-tpu bench-diff`` compares BENCH artifacts run-over-run
+   (obs/benchdiff.py) — the store also keeps per-leg bench history.
+
+Env knobs:
+  KEYSTONE_PROFILE_STORE        off|0|disabled → disabled entirely;
+                                a path → store file location; unset →
+                                <compilation-cache root>/profile-store.jsonl
+  KEYSTONE_PROFILE_STORE_MAX    max entries kept at compaction (4096)
+
+Stdlib-only at import; jax is only touched (lazily, fallible) for the
+environment fingerprint.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import threading
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from . import names as _names
+
+logger = logging.getLogger(__name__)
+
+_DEFAULT_MAX_ENTRIES = 4096
+# Compact (merge + evict + rewrite) once this many lines have been
+# appended beyond the loaded snapshot — bounds file growth at roughly
+# loaded + slack without paying a rewrite per observation.
+_COMPACT_SLACK = 256
+
+
+# ------------------------------------------------------------- shape classes
+
+
+def shape_class(rows: int, dims: Tuple[int, ...] = (), dtype: Any = None) -> str:
+    """Canonical shape-class string: row count bucketed to the next power
+    of two (measurements transfer within a ~2× scale band), trailing dims
+    exact, dtype name. ``shape_class(100_000, (768,), 'float32')`` →
+    ``'n2^17|768|float32'``."""
+    rows = max(1, int(rows))
+    bucket = 1 << max(0, math.ceil(math.log2(rows)))
+    parts = [f"n2^{bucket.bit_length() - 1}"]
+    if dims:
+        parts.append("x".join(str(int(d)) for d in dims))
+    if dtype is not None:
+        parts.append(str(getattr(dtype, "name", dtype)))
+    return "|".join(parts)
+
+
+def rows_bucket(shape: str) -> str:
+    """The row-bucket component of a :func:`shape_class` string — the
+    coarse match key when trailing dims are unknowable at plan time."""
+    return shape.split("|", 1)[0]
+
+
+def dataset_shape_class(dataset: Any) -> str:
+    """Shape class of a Dataset's raw records: row count plus the first
+    record's dims/dtype at TRANSFER width (what streaming uploads)."""
+    import numpy as np
+
+    try:
+        rows = len(dataset)
+    except Exception:
+        return "n?"
+    dims: Tuple[int, ...] = ()
+    dtype = None
+    try:
+        from ..data.dataset import ArrayDataset, transfer_dtype
+
+        if isinstance(dataset, ArrayDataset):
+            leaf = np.asarray(dataset.data)
+            dims, dtype = tuple(leaf.shape[1:]), transfer_dtype(leaf.dtype)
+        else:
+            first = np.asarray(dataset.take(1)[0])
+            dims, dtype = tuple(first.shape), transfer_dtype(first.dtype)
+    except Exception:
+        pass
+    return shape_class(rows, dims, dtype)
+
+
+# -------------------------------------------------------------- fingerprint
+
+_fp_cache: Optional[Dict[str, str]] = None
+_fp_lock = threading.Lock()
+
+
+def environment_fingerprint() -> Dict[str, str]:
+    """What must match for a stored measurement to still be believable:
+    jax version, backend platform, device kind. Cached after first
+    computation (device enumeration is not free); degrades to
+    ``unknown`` fields when no backend is importable/initializable so
+    jax-free tools (bench-diff, tests) can still read the store."""
+    global _fp_cache
+    if _fp_cache is not None:
+        return _fp_cache
+    with _fp_lock:
+        if _fp_cache is not None:
+            return _fp_cache
+        fp = {"jax": "unknown", "backend": "unknown", "device_kind": "unknown"}
+        try:
+            import jax
+
+            fp["jax"] = str(jax.__version__)
+            dev = jax.devices()[0]
+            fp["backend"] = str(dev.platform)
+            fp["device_kind"] = str(getattr(dev, "device_kind", "unknown"))
+        except Exception:
+            pass
+        _fp_cache = fp
+        return fp
+
+
+def _reset_fingerprint_cache() -> None:  # testing hook
+    global _fp_cache
+    with _fp_lock:
+        _fp_cache = None
+
+
+# --------------------------------------------------------------------- store
+
+
+def _counter(name: str):
+    return _names.metric(name)
+
+
+class ProfileStore:
+    """One JSON-lines profile store file with merge-on-write semantics.
+
+    In-memory state is a dict keyed ``(key, shape, backend)`` holding the
+    newest observation per key; the file may transiently hold multiple
+    lines per key between compactions (newest ``seq`` wins on load).
+    """
+
+    def __init__(
+        self,
+        path: str,
+        max_entries: Optional[int] = None,
+        fingerprint: Optional[Dict[str, str]] = None,
+    ):
+        self.path = path
+        self.max_entries = max_entries or int(
+            os.environ.get("KEYSTONE_PROFILE_STORE_MAX", _DEFAULT_MAX_ENTRIES)
+        )
+        self._fingerprint = fingerprint
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        self._seq = 0
+        self._appended_since_load = 0
+        self.hits = 0
+        self.misses = 0
+        self.writes = 0
+        self.invalidations = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._load()
+
+    # ------------------------------------------------------------- plumbing
+    def fingerprint(self) -> Dict[str, str]:
+        return self._fingerprint or environment_fingerprint()
+
+    @property
+    def _lock_path(self) -> str:
+        return self.path + ".lock"
+
+    def _flock(self):
+        """Exclusive advisory lock context over the sidecar lock file —
+        the cross-process serialization point for appends/compactions."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def locked():
+            try:
+                import fcntl
+
+                fd = os.open(self._lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                    yield
+                finally:
+                    fcntl.flock(fd, fcntl.LOCK_UN)
+                    os.close(fd)
+            except ImportError:  # non-POSIX: single-process best effort
+                yield
+
+        return locked()
+
+    @staticmethod
+    def _parse_line(line: str) -> Optional[Dict[str, Any]]:
+        line = line.strip()
+        if not line:
+            return None
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            return None  # torn write: a missed observation, not an error
+        if not isinstance(rec, dict) or "k" not in rec or "s" not in rec:
+            return None
+        return rec
+
+    def _load(self) -> None:
+        """(Re)build the in-memory map from the file, newest-seq wins."""
+        entries: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+        max_seq = 0
+        try:
+            with open(self.path, "r") as f:
+                for line in f:
+                    rec = self._parse_line(line)
+                    if rec is None:
+                        continue
+                    seq = int(rec.get("seq", 0))
+                    max_seq = max(max_seq, seq)
+                    ident = (rec["k"], rec["s"], str(rec.get("b", "")))
+                    prev = entries.get(ident)
+                    if prev is None or int(prev.get("seq", 0)) <= seq:
+                        if prev is not None:
+                            rec = dict(rec)
+                            rec["obs"] = int(prev.get("obs", 1)) + 1
+                        entries[ident] = rec
+        except OSError:
+            pass
+        with self._lock:
+            self._entries = entries
+            self._seq = max_seq
+            self._appended_since_load = 0
+        _names.metric(_names.PROFILE_STORE_ENTRIES).set(len(entries))
+
+    # --------------------------------------------------------------- writes
+    def record(
+        self,
+        key: str,
+        shape: str,
+        backend: Optional[str] = None,
+        **measurements: Any,
+    ) -> None:
+        """Append one observation (merge-on-write: the newest observation
+        per (key, shape, backend) wins at read time; the per-key ``obs``
+        count survives merges). Never raises — a broken store must not
+        break a fit."""
+        backend = backend or self.fingerprint()["backend"]
+        try:
+            with self._lock:
+                self._seq += 1
+                rec = {
+                    "k": key,
+                    "s": shape,
+                    "b": backend,
+                    "m": {
+                        k: v for k, v in measurements.items() if v is not None
+                    },
+                    "fp": self.fingerprint(),
+                    "seq": self._seq,
+                    "obs": 1,
+                }
+                prev = self._entries.get((key, shape, backend))
+                if prev is not None:
+                    rec["obs"] = int(prev.get("obs", 1)) + 1
+                self._entries[(key, shape, backend)] = rec
+                line = json.dumps(rec, sort_keys=True)
+                self._appended_since_load += 1
+                need_compact = (
+                    len(self._entries) > self.max_entries
+                    or self._appended_since_load >= _COMPACT_SLACK
+                )
+            with self._flock():
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+            self.writes += 1
+            _counter(_names.PROFILE_STORE_WRITES).inc()
+            _names.metric(_names.PROFILE_STORE_ENTRIES).set(len(self._entries))
+            if need_compact:
+                self.compact()
+        except Exception as e:
+            logger.warning("profile store write failed (%s)", e)
+
+    def compact(self) -> None:
+        """Merge the on-disk file (including other processes' appends)
+        with this process's view, evict LRU-by-write past ``max_entries``,
+        and atomically rewrite. Safe to call anytime."""
+        try:
+            with self._flock():
+                # Re-read under the lock so concurrent appenders' lines
+                # are merged, not clobbered. The snapshot of our own view
+                # takes the thread lock: record() mutates _entries under
+                # it, and an unlocked dict() copy can die mid-iteration.
+                # No deadlock risk — record() never holds _lock while
+                # taking the file lock.
+                with self._lock:
+                    ours = dict(self._entries)
+                self._load()
+                with self._lock:
+                    for ident, rec in ours.items():
+                        cur = self._entries.get(ident)
+                        if cur is None or int(cur.get("seq", 0)) < int(
+                            rec.get("seq", 0)
+                        ):
+                            self._entries[ident] = rec
+                    ranked = sorted(
+                        self._entries.items(),
+                        key=lambda kv: int(kv[1].get("seq", 0)),
+                    )
+                    evicted = len(ranked) - self.max_entries
+                    if evicted > 0:
+                        for ident, _ in ranked[:evicted]:
+                            del self._entries[ident]
+                        _counter(_names.PROFILE_STORE_EVICTIONS).inc(evicted)
+                    snapshot = [
+                        self._entries[ident]
+                        for ident, _ in ranked[max(evicted, 0):]
+                    ]
+                    self._seq = max(
+                        [int(r.get("seq", 0)) for r in snapshot], default=0
+                    )
+                    self._appended_since_load = 0
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    for rec in snapshot:
+                        f.write(json.dumps(rec, sort_keys=True) + "\n")
+                os.replace(tmp, self.path)
+            _names.metric(_names.PROFILE_STORE_ENTRIES).set(len(self._entries))
+        except Exception as e:
+            logger.warning("profile store compaction failed (%s)", e)
+
+    # ---------------------------------------------------------------- reads
+    def lookup(
+        self, key: str, shape: str, backend: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The newest valid measurements dict for (key, shape, backend),
+        or None. Entries whose environment fingerprint no longer matches
+        are invalidated (counted), never returned."""
+        backend = backend or self.fingerprint()["backend"]
+        with self._lock:
+            rec = self._entries.get((key, shape, backend))
+        if rec is None:
+            self.misses += 1
+            _counter(_names.PROFILE_STORE_MISSES).inc()
+            return None
+        if rec.get("fp") != self.fingerprint():
+            self.invalidations += 1
+            _counter(_names.PROFILE_STORE_INVALIDATIONS).inc()
+            self.misses += 1
+            _counter(_names.PROFILE_STORE_MISSES).inc()
+            return None
+        self.hits += 1
+        _counter(_names.PROFILE_STORE_HITS).inc()
+        return dict(rec.get("m", {}))
+
+    def entries(
+        self,
+        key_prefix: str = "",
+        shape: Optional[str] = None,
+        rows: Optional[str] = None,
+        backend: Optional[str] = None,
+    ) -> Iterator[Tuple[str, str, Dict[str, Any]]]:
+        """Iterate valid (key, shape, measurements) tuples filtered by key
+        prefix, exact shape class, or coarse rows bucket — the knob rule's
+        query surface. Fingerprint-stale entries are skipped silently
+        (invalidation is counted at lookup, the authoritative read)."""
+        backend = backend or self.fingerprint()["backend"]
+        fp = self.fingerprint()
+        with self._lock:
+            snapshot: List[Dict[str, Any]] = list(self._entries.values())
+        for rec in snapshot:
+            if str(rec.get("b", "")) != backend or rec.get("fp") != fp:
+                continue
+            if key_prefix and not rec["k"].startswith(key_prefix):
+                continue
+            if shape is not None and rec["s"] != shape:
+                continue
+            if rows is not None and rows_bucket(rec["s"]) != rows:
+                continue
+            yield rec["k"], rec["s"], dict(rec.get("m", {}))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "entries": len(self),
+            "hits": self.hits,
+            "misses": self.misses,
+            "writes": self.writes,
+            "invalidations": self.invalidations,
+        }
+
+
+# ---------------------------------------------------------- process singleton
+
+_store: Optional[ProfileStore] = None
+_store_target: Optional[str] = None
+_store_lock = threading.Lock()
+
+
+def store_enabled() -> bool:
+    return os.environ.get("KEYSTONE_PROFILE_STORE", "").lower() not in (
+        "off", "0", "disabled",
+    )
+
+
+def default_store_path() -> str:
+    """The store file location: ``KEYSTONE_PROFILE_STORE`` when it names
+    a path, else ``profile-store.jsonl`` under the same root as the XLA
+    compilation cache (the two persistence layers travel together)."""
+    env = os.environ.get("KEYSTONE_PROFILE_STORE", "")
+    if env and env.lower() not in ("on", "1", "true"):
+        return env
+    cache = os.environ.get("KEYSTONE_COMPILATION_CACHE", "")
+    if cache and cache.lower() not in ("off", "0", "disabled"):
+        root = os.path.dirname(cache.rstrip(os.sep)) or cache
+    else:
+        root = os.path.join(os.path.expanduser("~"), ".cache", "keystone_tpu")
+    return os.path.join(root, "profile-store.jsonl")
+
+
+def get_store() -> Optional[ProfileStore]:
+    """The process-wide :class:`ProfileStore`, or None when disabled.
+    Re-resolves when ``KEYSTONE_PROFILE_STORE`` changes (tests point it at
+    per-test temp files)."""
+    global _store, _store_target
+    if not store_enabled():
+        return None
+    target = default_store_path()
+    with _store_lock:
+        if _store is None or _store_target != target:
+            try:
+                _store = ProfileStore(target)
+                _store_target = target
+            except Exception as e:
+                logger.warning("profile store unavailable (%s)", e)
+                return None
+        return _store
+
+
+def set_store(store: Optional[ProfileStore]) -> None:
+    """Install a specific store instance (tests); None drops the
+    singleton so the next :func:`get_store` re-resolves from env."""
+    global _store, _store_target
+    with _store_lock:
+        _store = store
+        _store_target = store.path if store is not None else None

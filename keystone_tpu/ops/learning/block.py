@@ -133,6 +133,9 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             d, k = _stream_shapes(feat_aval, y_aval)
             return linalg.gram_stream_init(d, k)
 
+        import time as _time
+
+        t_fit = _time.perf_counter()
         with solver_obs.fit_span("block_ls_stream", epochs=self.num_iter):
             carry, info = stream.fold(init, linalg.gram_stream_step)
             n = info["num_examples"]
@@ -151,6 +154,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             w = linalg.bcd_from_gram(
                 gc, cc, reg=reg, num_epochs=self.num_iter, block_size=block
             )
+        _record_solver_observation(
+            "block_ls_stream",
+            rows=n,
+            d=d,
+            block_size=block,
+            wall_s=_time.perf_counter() - t_fit,
+            rungs_attempted=1,
+        )
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a
         )
@@ -189,12 +200,23 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             with solver_obs.rung_span("block_ls", block, next(attempts)):
                 return fit_impl(features, targets, mesh, block)
 
+        import time as _time
+
+        t_fit = _time.perf_counter()
         with solver_obs.fit_span(
             "block_ls", d=d, epochs=self.num_iter, streaming=stream
         ):
             model = ladder.run(attempt)
         if ladder.reduced:
             model.degradation = dict(ladder.record)
+        _record_solver_observation(
+            "block_ls",
+            rows=features.num_examples,
+            d=d,
+            block_size=model.block_size,
+            wall_s=_time.perf_counter() - t_fit,
+            rungs_attempted=1 + int(ladder.record.get("rung_index", 0)),
+        )
         return model
 
     def _fit_streaming(self, features, targets, mesh, block) -> BlockLinearMapper:
@@ -264,6 +286,37 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a
         )
+
+
+def _record_solver_observation(
+    solver: str,
+    rows: int,
+    d: int,
+    block_size: int,
+    wall_s: float,
+    rungs_attempted: int,
+) -> None:
+    """Remember what this (block size, precision) pair cost on this shape
+    class so MeasuredKnobRule can prefer the best recorded pair when the
+    env knobs are unset (docs/OPTIMIZER.md). Best effort — a disabled or
+    broken store never blocks a fit."""
+    try:
+        from ...obs import store as obs_store
+
+        store = obs_store.get_store()
+        if store is None:
+            return
+        mode = linalg.solver_mode()
+        store.record(
+            f"solver:{solver}:bs{block_size}:prec{mode}",
+            obs_store.shape_class(rows, (d,), "float32"),
+            wall_s=round(wall_s, 6),
+            block_size=block_size,
+            precision=mode,
+            solver_rung=rungs_attempted,
+        )
+    except Exception:  # pragma: no cover - observability must not fail fits
+        pass
 
 
 def _stream_shapes(feat_aval, y_aval):

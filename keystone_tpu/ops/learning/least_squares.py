@@ -148,8 +148,35 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
             with solver_obs.rung_span("least_squares", name, next(attempts)):
                 return factory().fit(data, labels)
 
+        import time as _time
+
+        t_fit = _time.perf_counter()
         with solver_obs.fit_span("least_squares"):
             model = ladder.run(attempt)
+        # Meta-solver observation: the rung that finally held and what it
+        # cost, keyed per shape class — the profile store's record of
+        # which concrete solver this problem size actually wants.
+        try:
+            from ...obs import store as obs_store
+
+            store = obs_store.get_store()
+            if store is not None:
+                n_rows = len(data)
+                d_cols = 0
+                if isinstance(data, ArrayDataset):
+                    arr = data.data
+                    d_cols = int(arr.shape[1]) if getattr(arr, "ndim", 1) > 1 else 1
+                rung = "dense_lbfgs" if not ladder.reduced else (
+                    ladder.record["rung"][0]
+                )
+                store.record(
+                    f"solver:least_squares:rung_{rung}",
+                    obs_store.shape_class(n_rows, (d_cols,), "float32"),
+                    wall_s=round(_time.perf_counter() - t_fit, 6),
+                    solver_rung=rung,
+                )
+        except Exception:
+            pass
         if ladder.reduced:
             record = dict(
                 ladder.record, rung=ladder.record["rung"][0],

@@ -17,7 +17,9 @@ jitted; shapes are static.
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 import warnings
 from typing import Optional, Tuple
 
@@ -54,6 +56,49 @@ _PRECISION_MODES = {
 }
 
 
+# Measured-knob override (workflow/knobs.py MeasuredKnobRule): replaces
+# the DEFAULT precision mode only — an explicit KEYSTONE_SOLVER_PRECISION
+# always wins, so an operator's pinned choice can never be overridden by
+# a measurement. Read per call like the env var, so the mode-keyed
+# compilation caches below key on it correctly. THREAD-LOCAL: the knob
+# rule scopes its override to the fit it planned (solver_mode_scope), so
+# a concurrent fit on another thread must not observe it.
+_mode_override_local = threading.local()
+
+
+def set_solver_mode_override(mode: "str | None") -> None:
+    """Install (or clear, with None) the measured default-precision mode
+    for the CURRENT THREAD. Raises on unknown modes — a bad stored
+    observation must fail loudly at decision time, not mislead every
+    subsequent solve. Prefer :func:`solver_mode_scope` — an unscoped
+    install leaks into every later solve on the thread."""
+    if mode is not None and mode not in _PRECISION_MODES:
+        raise ValueError(
+            f"solver mode override {mode!r}: expected one of "
+            f"{sorted(_PRECISION_MODES)}"
+        )
+    _mode_override_local.mode = mode
+
+
+@contextlib.contextmanager
+def solver_mode_scope(mode: "str | None"):
+    """Scoped default-precision override: installed on entry, restored on
+    exit, thread-local throughout. ``None`` is a no-op scope. This is how
+    MeasuredKnobRule's per-operator precision choice is applied — only
+    around the planned fit, never as lingering process state, so a solve
+    that was never planned under the measurement (direct ``fit_datasets``
+    calls, another pipeline on another thread) keeps its own default."""
+    if mode is None:
+        yield
+        return
+    prev = getattr(_mode_override_local, "mode", None)
+    set_solver_mode_override(mode)
+    try:
+        yield
+    finally:
+        _mode_override_local.mode = prev
+
+
 def solver_mode() -> str:
     """The KEYSTONE_SOLVER_PRECISION mode, read PER CALL — one lifetime
     for the whole knob (r4 verdict item 8: an import-frozen ``PRECISION``
@@ -61,10 +106,20 @@ def solver_mode() -> str:
     but silently not BCD/kernel/TSQR matmuls). Every solver-grade matmul
     reads this at trace time, and every compiled-function cache in this
     package keys on it (``mode_jit`` / the ``_*_fn`` factories), so a
-    flip re-traces instead of silently reusing the old precision."""
+    flip re-traces instead of silently reusing the old precision.
+
+    Resolution order: explicit env var > measured override
+    (:func:`set_solver_mode_override`) > the shipped "refine" default."""
     import os
 
-    name = os.environ.get("KEYSTONE_SOLVER_PRECISION", "refine").lower()
+    env = os.environ.get("KEYSTONE_SOLVER_PRECISION")
+    override = getattr(_mode_override_local, "mode", None)
+    if env is not None:
+        name = env.lower()
+    elif override is not None:
+        name = override
+    else:
+        name = "refine"
     if name not in _PRECISION_MODES:  # loud, not silent: a typo'd "fast
         raise ValueError(  # mode" that silently ran 6-pass would mislead
             f"KEYSTONE_SOLVER_PRECISION={name!r}: expected one of "

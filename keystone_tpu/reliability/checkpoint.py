@@ -24,10 +24,12 @@ a miss and refit.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import os
 import pickle
 import tempfile
+import threading
 from typing import Any, Callable, Dict, Optional
 
 from ..obs import names as _names
@@ -46,6 +48,31 @@ def _store_counters():
 
 # ------------------------------------------------------------ stable digests
 
+_token_memo_local = threading.local()
+
+
+@contextlib.contextmanager
+def token_memo():
+    """Memoize expensive :func:`_value_token` results by object identity
+    for the duration of one multi-node digest pass.
+
+    Digesting N node prefixes of one pipeline re-tokenizes the SAME
+    dataset object N times — each pass content-hashes the full training
+    matrix (or worse, ``collect()``s an ObjectDataset). Within a single
+    plan the objects are unchanged, so the autocache warm-start loop
+    wraps its digest pass in this scope and pays each hash once. The memo
+    holds a strong reference to every memoized value, which also pins its
+    ``id`` against reuse; it dies with the scope, so nothing outlives the
+    plan. Nested scopes reuse the outermost memo."""
+    fresh = getattr(_token_memo_local, "memo", None) is None
+    if fresh:
+        _token_memo_local.memo = {}
+    try:
+        yield
+    finally:
+        if fresh:
+            _token_memo_local.memo = None
+
 
 def _value_token(value: Any) -> Any:
     """Deterministic, process-independent token for an operator attribute."""
@@ -53,6 +80,18 @@ def _value_token(value: Any) -> Any:
         return ("s", repr(value))
     if isinstance(value, float):
         return ("f", value.hex())
+    memo = getattr(_token_memo_local, "memo", None)
+    if memo is not None:
+        hit = memo.get(id(value))
+        if hit is not None and hit[0] is value:
+            return hit[1]
+        token = _value_token_uncached(value)
+        memo[id(value)] = (value, token)
+        return token
+    return _value_token_uncached(value)
+
+
+def _value_token_uncached(value: Any) -> Any:
     if isinstance(value, bytes):
         return ("b", hashlib.sha1(value).hexdigest())
     if isinstance(value, (list, tuple)):

@@ -54,6 +54,7 @@ from .streaming import (
     streaming_disabled,
     streaming_enabled,
 )
+from .knobs import MeasuredKnobRule, knob_mode
 from .tracing import PipelineTrace, current_trace, trace
 
 __all__ = [
@@ -73,5 +74,6 @@ __all__ = [
     "ChunkStream", "StreamingFitOperator", "StreamingPlanRule",
     "stream_pipelined", "last_stream_report",
     "streaming_enabled", "streaming_disabled", "set_streaming_enabled",
+    "MeasuredKnobRule", "knob_mode",
     "PipelineTrace", "current_trace", "trace",
 ]

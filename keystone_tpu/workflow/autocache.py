@@ -32,6 +32,8 @@ import numpy as np
 from ..data.dataset import ArrayDataset, Dataset, ObjectDataset
 from ..obs import names as _names
 from ..obs import spans as _spans
+from ..obs import store as _store
+from .analysis import get_ancestors
 from .graph import Graph, NodeId, SinkId, SourceId
 from .operators import (
     DatasetOperator,
@@ -95,21 +97,39 @@ def _estimate_bytes(value) -> int:
     return 64
 
 
-def _fit_linear(samples: List[SampleProfile], full_n: int) -> Profile:
-    """Per-metric linear fit in scale, evaluated at full scale
-    (reference: AutoCacheRule.scala:104-135 ``X \\ y``)."""
+def _fit_linear_coeffs(
+    samples: List[SampleProfile],
+) -> Tuple[float, float, float, float]:
+    """Per-metric linear-fit coefficients ``(t0, t1, b0, b1)`` in scale —
+    the REUSABLE form of a profile: plain floats that JSON-round-trip
+    exactly, so a profile persisted by one process evaluates to the
+    byte-identical :class:`Profile` in the next."""
     if len(samples) == 1:
         s = samples[0]
-        ratio = full_n / max(1, s.scale)
-        return Profile(s.run_time_s * ratio, int(s.size_bytes * ratio))
+        scale = max(1, s.scale)
+        return (0.0, s.run_time_s / scale, 0.0, s.size_bytes / scale)
     xs = np.array([[1.0, s.scale] for s in samples])
     times = np.array([s.run_time_s for s in samples])
     sizes = np.array([float(s.size_bytes) for s in samples])
     t_coef, *_ = np.linalg.lstsq(xs, times, rcond=None)
     s_coef, *_ = np.linalg.lstsq(xs, sizes, rcond=None)
-    t = float(t_coef[0] + t_coef[1] * full_n)
-    b = float(s_coef[0] + s_coef[1] * full_n)
+    return (
+        float(t_coef[0]), float(t_coef[1]), float(s_coef[0]), float(s_coef[1])
+    )
+
+
+def _profile_from_coeffs(
+    coeffs: Tuple[float, float, float, float], full_n: int
+) -> Profile:
+    t = coeffs[0] + coeffs[1] * full_n
+    b = coeffs[2] + coeffs[3] * full_n
     return Profile(max(t, 0.0), max(int(b), 0))
+
+
+def _fit_linear(samples: List[SampleProfile], full_n: int) -> Profile:
+    """Per-metric linear fit in scale, evaluated at full scale
+    (reference: AutoCacheRule.scala:104-135 ``X \\ y``)."""
+    return _profile_from_coeffs(_fit_linear_coeffs(samples), full_n)
 
 
 class _ProfilingInterpreter:
@@ -177,6 +197,7 @@ class AutoCacheRule(Rule):
         profile_scales: Tuple[int, ...] = (2, 4),
         num_trials: int = 1,
         clock=time.perf_counter,
+        profile_store="auto",
     ):
         assert strategy in ("greedy", "aggressive")
         self.budget_bytes = budget_bytes
@@ -187,6 +208,15 @@ class AutoCacheRule(Rule):
         # with a deterministic fake so cache choices don't depend on
         # machine load.
         self.clock = clock
+        # Persistent profile store (docs/OBSERVABILITY.md): "auto" uses
+        # the process store (None when KEYSTONE_PROFILE_STORE=off), None
+        # disables warm-starting for this rule, an instance pins one.
+        self.profile_store = profile_store
+
+    def _store(self):
+        if self.profile_store == "auto":
+            return _store.get_store()
+        return self.profile_store
 
     # ------------------------------------------------------------- structure
     def _dependents(self, graph: Graph) -> Dict[NodeId, List]:
@@ -221,10 +251,62 @@ class AutoCacheRule(Rule):
         return result
 
     # ------------------------------------------------------------- profiling
+    def _profiled_nodes(self, graph: Graph) -> List[NodeId]:
+        """The nodes sample-profiling will time: SOURCE-FREE operator
+        nodes in the ancestry of any sink. Source-dependent branches (the
+        delegating apply path of a ``with_data`` pipeline) are excluded
+        rather than aborting the whole profile — the fit-cost subgraph is
+        exactly the source-free part."""
+        live: set = set()
+        for sink in graph.sinks:
+            live |= get_ancestors(graph, sink)
+            live.add(graph.get_sink_dependency(sink))
+        out: List[NodeId] = []
+        for node in sorted(n for n in live if isinstance(n, NodeId)):
+            if any(
+                isinstance(a, SourceId) for a in get_ancestors(graph, node)
+            ):
+                continue
+            if isinstance(graph.get_operator(node), DatasetOperator):
+                continue
+            out.append(node)
+        return out
+
+    def _node_digests(
+        self, graph: Graph, nodes: List[NodeId]
+    ) -> Optional[Dict[NodeId, str]]:
+        """Cross-process stable digest per node (structural prefix +
+        content-hashed operator state — the checkpoint layer's key), or
+        None when any node can't be digested (store is then skipped)."""
+        from ..reliability.checkpoint import prefix_digest, token_memo
+        from .prefix import find_prefix
+
+        digests: Dict[NodeId, str] = {}
+        try:
+            # One memo for the whole pass: every prefix re-tokenizes the
+            # same DatasetOperator, and without the memo each node pays a
+            # full content hash of the training data.
+            with token_memo():
+                for node in nodes:
+                    prefix = find_prefix(graph, node)
+                    if prefix is None:
+                        return None
+                    digests[node] = prefix_digest(prefix)
+        except Exception:
+            return None
+        return digests
+
     def _profile(self, graph: Graph) -> Dict[NodeId, Profile]:
         """Profile EVERY executed node, not just cache candidates: caching a
         shared node also saves recomputing its whole (possibly expensive)
-        ancestry, and the cost model must see those ancestor times."""
+        ancestry, and the cost model must see those ancestor times.
+
+        With a persistent profile store attached, a plan whose every node
+        has a fresh stored profile (same structural digest, shape class,
+        backend, environment fingerprint, and full row count) skips
+        sample execution entirely and rebuilds byte-identical profiles
+        from the stored linear-fit coefficients; a cold plan records its
+        coefficients back so the NEXT process skips."""
         full_n = max(
             (len(graph.get_operator(n).dataset) for n in graph.nodes
              if isinstance(graph.get_operator(n), DatasetOperator)),
@@ -232,6 +314,41 @@ class AutoCacheRule(Rule):
         )
         if full_n == 0:
             return {}
+        targets = self._profiled_nodes(graph)
+        if not targets:
+            return {}
+
+        store = self._store()
+        digests: Optional[Dict[NodeId, str]] = None
+        sc = _store.shape_class(full_n)
+        if store is not None:
+            digests = self._node_digests(graph, targets)
+        if store is not None and digests is not None:
+            warm: Optional[Dict[NodeId, Profile]] = {}
+            for node in targets:
+                m = store.lookup(f"autocache:{digests[node]}", sc)
+                # An entry only covers this plan when it was measured
+                # under the SAME profiling config: coefficients fit from
+                # different sample scales/trial counts are different
+                # measurements, and reusing them would make a
+                # reconfigured rule silently inert.
+                if (
+                    m is None
+                    or m.get("full_n") != full_n
+                    or m.get("scales") != str(self.profile_scales)
+                    or m.get("trials") != self.num_trials
+                ):
+                    warm = None
+                    break
+                warm[node] = _profile_from_coeffs(
+                    (m["t0"], m["t1"], m["b0"], m["b1"]), full_n
+                )
+            if warm is not None:
+                _spans.add_span_event(
+                    "autocache_profile_store", nodes=len(warm), full_n=full_n
+                )
+                return warm
+
         samples: Dict[NodeId, List[SampleProfile]] = {}
         t_profile = time.perf_counter()
         with _spans.span(
@@ -241,8 +358,8 @@ class AutoCacheRule(Rule):
                 for _ in range(self.num_trials):
                     interp = _ProfilingInterpreter(graph, scale, clock=self.clock)
                     try:
-                        for sink in graph.sinks:
-                            interp.execute(sink)
+                        for node in targets:
+                            interp.execute(node)
                     except Exception as e:
                         # unbound sources etc.: no profile, no caching
                         logging.getLogger(__name__).warning(
@@ -257,7 +374,23 @@ class AutoCacheRule(Rule):
         _names.metric(_names.AUTOCACHE_PROFILE_SECONDS).observe(
             time.perf_counter() - t_profile
         )
-        return {n: _fit_linear(obs, full_n) for n, obs in samples.items() if obs}
+        coeffs = {n: _fit_linear_coeffs(obs) for n, obs in samples.items() if obs}
+        profiles = {
+            n: _profile_from_coeffs(c, full_n) for n, c in coeffs.items()
+        }
+        if store is not None and digests is not None:
+            for n, c in coeffs.items():
+                store.record(
+                    f"autocache:{digests[n]}",
+                    sc,
+                    full_n=full_n,
+                    scales=str(self.profile_scales),
+                    trials=self.num_trials,
+                    t0=c[0], t1=c[1], b0=c[2], b1=c[3],
+                    run_time_s=profiles[n].run_time_s,
+                    size_bytes=profiles[n].size_bytes,
+                )
+        return profiles
 
     # ------------------------------------------------------------- cost model
     def _estimate_runtime(

@@ -1,0 +1,328 @@
+"""MeasuredKnobRule: plan knobs chosen from measured history, not env
+defaults.
+
+BENCH_r05 showed per-shape fp32/bf16 spreads of 1.4-8× and MFU cliffs
+that no single static default survives — yet chunk rows, solver block
+size, and solver precision all default to env vars today. This rule
+closes the loop the profile store opens (docs/OBSERVABILITY.md): every
+streaming fit and solver fit records what its knob settings achieved per
+shape class; this rule, running as the LAST optimizer batch (after
+streaming, so the ``StreamingFitOperator`` nodes it tunes exist —
+docs/OPTIMIZER.md), overrides the *defaults* from the best recorded
+observation:
+
+- **stream chunk rows** — the best-throughput recorded ``chunk_rows``
+  for this featurize chain + data shape class is pinned onto the
+  ``StreamingFitOperator`` (an explicit ``KEYSTONE_STREAM_CHUNK_ROWS``
+  always wins). Semantics-free: chunking is parity-tested at any size.
+- **solver precision** — the fastest recorded precision mode for this
+  shape class is pinned onto the estimator operator
+  (``solver_precision``) and applied ONLY around that operator's fit via
+  ``parallel.linalg.solver_mode_scope`` — never as process state, so
+  unplanned solves and concurrent fits keep their own default (an
+  explicit ``KEYSTONE_SOLVER_PRECISION`` always wins).
+- **solver block size** — estimators carrying a ``block_size`` are
+  re-created with the best recorded block for the shape class; setting
+  ``KEYSTONE_SOLVER_BLOCK`` (to any value — it is consumed only here)
+  pins constructor-chosen block sizes against measured overrides.
+
+Precision and block size change *numerics within solver tolerance*
+(different Gauss-Seidel block order, different matmul precision), so
+they are gated behind ``KEYSTONE_MEASURED_KNOBS=all``; the default
+(``on``) applies only the semantics-free chunk-rows override, and
+``off`` disables the rule entirely.
+
+Every override is recorded as a span attribute on the
+``optimize:measured-knobs`` span and counted in
+``keystone_profile_store_knob_overrides_total{knob=...}``.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from ..obs import names as _names
+from ..obs import spans as _spans
+from ..obs import store as _store
+from .graph import Graph
+from .operators import DatasetOperator, EstimatorOperator
+from .rules import PrefixMap, Rule
+
+logger = logging.getLogger(__name__)
+
+
+def knob_mode() -> str:
+    """``KEYSTONE_MEASURED_KNOBS``: ``on`` (default — semantics-free
+    overrides only), ``all`` (also precision/block size), ``off``."""
+    mode = os.environ.get("KEYSTONE_MEASURED_KNOBS", "on").lower()
+    if mode in ("off", "0", "disabled"):
+        return "off"
+    return "all" if mode == "all" else "on"
+
+
+def _best_entry(
+    store, key_prefix: str, measure: str, shape: Optional[str] = None,
+    rows: Optional[str] = None, maximize: bool = True,
+    require: Tuple[str, ...] = (),
+) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """The (key, measurements) with the best ``measure`` among matching
+    entries that also carry every ``require`` field — ties broken by key
+    for determinism across runs."""
+    best: Optional[Tuple[str, Dict[str, Any]]] = None
+    best_v: Optional[float] = None
+    for key, _shape, m in sorted(
+        store.entries(key_prefix=key_prefix, shape=shape, rows=rows)
+    ):
+        if measure not in m or any(r not in m for r in require):
+            continue
+        v = float(m[measure])
+        better = (
+            best_v is None
+            or (v > best_v if maximize else v < best_v)
+        )
+        if better:
+            best, best_v = (key, m), v
+    return best
+
+
+def _unanimous_winner(
+    store, key_prefix: str, rows: str, field: str
+) -> Optional[Dict[str, Any]]:
+    """Group matching entries by their FULL shape class (exact d, not
+    just the rows bucket), take the best-wall entry per group, and return
+    a winner only when every group agrees on ``field``. Absolute walls
+    across different feature widths are incommensurable — a knob measured
+    fast on a 64-wide problem must not win a 4096-wide one — but when
+    every width in the scale band independently picked the same setting,
+    the measurement transfers."""
+    groups: Dict[str, Tuple[float, Dict[str, Any]]] = {}
+    for key, shape, m in sorted(
+        store.entries(key_prefix=key_prefix, rows=rows)
+    ):
+        if "wall_s" not in m or field not in m:
+            continue
+        wall = float(m["wall_s"])
+        cur = groups.get(shape)
+        if cur is None or wall < cur[0]:
+            groups[shape] = (wall, m)
+    if not groups:
+        return None
+    winners = {repr(m[field]) for _, m in groups.values()}
+    if len(winners) != 1:
+        return None  # the widths disagree: no defensible override
+    return next(iter(groups.values()))[1]
+
+
+class MeasuredKnobRule(Rule):
+    """Override plan-knob defaults per shape class from the profile
+    store's best recorded observations (docs/OPTIMIZER.md)."""
+
+    def __init__(self, profile_store="auto"):
+        self.profile_store = profile_store
+
+    def _store(self):
+        if self.profile_store == "auto":
+            return _store.get_store()
+        return self.profile_store
+
+    def apply(self, graph: Graph, prefixes: PrefixMap) -> Tuple[Graph, PrefixMap]:
+        mode = knob_mode()
+        store = self._store()
+        # This rule never installs thread/process precision state itself —
+        # measured precision is pinned onto operators and scoped around
+        # their fits (linalg.solver_mode_scope). The clear below is
+        # defensive hygiene for MANUAL set_solver_mode_override() calls
+        # left unscoped on this thread by embedding code: planning a new
+        # pipeline is the natural boundary past which such a leftover
+        # default must not silently persist (pinned by
+        # test_stale_precision_override_cleared_by_next_plan).
+        from ..parallel import linalg
+
+        linalg.set_solver_mode_override(None)
+        if mode == "off" or store is None:
+            return graph, prefixes
+        overrides = _names.metric(_names.PROFILE_STORE_KNOB_OVERRIDES)
+        with _spans.span("optimize:measured-knobs", mode=mode) as sp:
+            graph = self._tune_stream_chunks(graph, store, overrides, sp)
+            if mode == "all":
+                graph = self._tune_solver_block(graph, store, overrides, sp)
+                graph = self._tune_solver_precision(graph, store, overrides, sp)
+        return graph, prefixes
+
+    # ------------------------------------------------------- chunk rows
+    def _tune_stream_chunks(self, graph, store, overrides, sp):
+        from .streaming import StreamingFitOperator, chain_class
+
+        if os.environ.get("KEYSTONE_STREAM_CHUNK_ROWS"):
+            return graph  # explicit env knob always wins
+        for node in sorted(graph.nodes):
+            op = graph.operators.get(node)
+            if not isinstance(op, StreamingFitOperator) or op.chunk_rows:
+                continue
+            deps = graph.get_dependencies(node)
+            head = graph.operators.get(deps[0]) if deps else None
+            if not isinstance(head, DatasetOperator):
+                continue
+            shape = _store.dataset_shape_class(head.dataset)
+            best = _best_entry(
+                store,
+                f"stream:{chain_class(op.members)}:",
+                "rows_per_s",
+                shape=shape,
+            )
+            if best is None:
+                continue
+            rows = int(best[1].get("chunk_rows", 0))
+            if rows <= 0:
+                continue
+            tuned = StreamingFitOperator(
+                op.estimator, op.members,
+                chunk_rows=rows, prefetch=op.prefetch,
+            )
+            graph = graph.set_operator(node, tuned)
+            overrides.inc(knob="stream_chunk_rows")
+            sp.set_attribute(f"stream_chunk_rows:{node}", rows)
+            _spans.add_span_event(
+                "measured_knob", knob="stream_chunk_rows",
+                value=rows, shape=shape,
+            )
+        return graph
+
+    # ------------------------------------------------------- block size
+    def _tune_solver_block(self, graph, store, overrides, sp):
+        from .streaming import StreamingFitOperator
+
+        if os.environ.get("KEYSTONE_SOLVER_BLOCK"):
+            return graph
+        for node in sorted(graph.nodes):
+            op = graph.operators.get(node)
+            target = op
+            if isinstance(op, StreamingFitOperator):
+                target = op.estimator
+            if not isinstance(target, EstimatorOperator):
+                continue
+            block = getattr(target, "block_size", None)
+            if not isinstance(block, int):
+                continue
+            rows = self._head_rows_bucket(graph, node)
+            if rows is None:
+                continue
+            # Trailing colon: "solver:block_ls:" must NOT match
+            # "solver:block_ls_stream:*", whose wall covers the whole
+            # ingest+featurize+Gram fold — incommensurable with the
+            # solver-only in-core walls this knob selects among. And the
+            # winner must be unanimous across feature widths in the
+            # bucket: absolute walls from different d never compete.
+            best = _unanimous_winner(
+                store, "solver:block_ls:", rows, "block_size"
+            )
+            if best is None:
+                continue
+            best_block = int(best.get("block_size", 0))
+            if best_block <= 0 or best_block == block:
+                continue
+            tuned = copy.copy(target)
+            tuned.block_size = best_block
+            if isinstance(op, StreamingFitOperator):
+                new_op = StreamingFitOperator(
+                    tuned, op.members,
+                    chunk_rows=op.chunk_rows, prefetch=op.prefetch,
+                )
+            else:
+                new_op = tuned
+            graph = graph.set_operator(node, new_op)
+            overrides.inc(knob="solver_block_size")
+            sp.set_attribute(f"solver_block_size:{node}", best_block)
+            _spans.add_span_event(
+                "measured_knob", knob="solver_block_size",
+                value=best_block, was=block,
+            )
+        return graph
+
+    # -------------------------------------------------------- precision
+    def _tune_solver_precision(self, graph, store, overrides, sp):
+        from ..parallel import linalg
+        from .streaming import StreamingFitOperator
+
+        if os.environ.get("KEYSTONE_SOLVER_PRECISION") is not None:
+            return graph  # explicit env knob always wins
+        for node in sorted(graph.nodes):
+            op = graph.operators.get(node)
+            target = op.estimator if isinstance(op, StreamingFitOperator) else op
+            if not isinstance(target, EstimatorOperator):
+                continue
+            if getattr(target, "solver_precision", None):
+                continue  # operator already pinned its own choice
+            rows = self._head_rows_bucket(graph, node)
+            if rows is None:
+                continue
+            # Only in-core block_ls entries participate (same solver
+            # family → commensurable walls; streaming-fold walls and the
+            # meta-solver's precision-less rung entries never vote), and
+            # the winning precision must be unanimous across the bucket's
+            # feature widths.
+            best = _unanimous_winner(
+                store, "solver:block_ls:", rows, "precision"
+            )
+            if best is None:
+                continue
+            precision = best.get("precision")
+            if not precision:
+                continue
+            try:
+                linalg.precision_for_mode(str(precision))
+            except KeyError:
+                logger.warning(
+                    "measured precision override rejected: unknown mode %r",
+                    precision,
+                )
+                continue
+            # Scoped to THIS operator's fit (operators.py wraps
+            # fit_datasets / streaming wraps fit_stream in
+            # linalg.solver_mode_scope) — never process state, so solves
+            # that were not planned under the measurement keep their own
+            # default.
+            tuned = copy.copy(target)
+            tuned.solver_precision = str(precision)
+            if isinstance(op, StreamingFitOperator):
+                new_op = StreamingFitOperator(
+                    tuned, op.members,
+                    chunk_rows=op.chunk_rows, prefetch=op.prefetch,
+                )
+            else:
+                new_op = tuned
+            graph = graph.set_operator(node, new_op)
+            overrides.inc(knob="solver_precision")
+            sp.set_attribute(f"solver_precision:{node}", str(precision))
+            _spans.add_span_event(
+                "measured_knob", knob="solver_precision",
+                value=str(precision),
+            )
+        return graph
+
+    # ---------------------------------------------------------- helpers
+    def _head_rows_bucket(self, graph, node) -> Optional[str]:
+        """Rows bucket of the dataset feeding ``node``'s chain head — the
+        coarse shape key when featurized width is unknowable at plan
+        time (solver entries record exact d; the bucket still confines a
+        measurement to its scale band)."""
+        seen = set()
+        cur = node
+        while cur in graph.operators:
+            op = graph.operators[cur]
+            if isinstance(op, DatasetOperator):
+                try:
+                    return _store.rows_bucket(
+                        _store.shape_class(len(op.dataset))
+                    )
+                except Exception:
+                    return None
+            deps = graph.get_dependencies(cur)
+            if not deps or deps[0] in seen:
+                return None
+            seen.add(cur)
+            cur = deps[0]
+        return None

@@ -220,7 +220,17 @@ class EstimatorOperator(Operator):
                 if not isinstance(value, Dataset):
                     raise TypeError(f"{self.label}: estimator dependencies must be datasets")
                 datasets.append(value)
-            return self.fit_datasets(datasets)
+            # A measured precision choice (MeasuredKnobRule pins
+            # ``solver_precision`` onto the operator) applies only around
+            # THIS fit — thread-local and restored on exit, so it can
+            # never leak into solves that were not planned under it.
+            mode = getattr(self, "solver_precision", None)
+            if mode is None:
+                return self.fit_datasets(datasets)
+            from ..parallel import linalg
+
+            with linalg.solver_mode_scope(mode):
+                return self.fit_datasets(datasets)
 
         return TransformerExpression(thunk)
 

@@ -197,9 +197,12 @@ def default_optimizer() -> RuleExecutor:
     → chain fusion → streaming (reference: DefaultOptimizer.scala:8-26;
     fusion and streaming are TPU-native, docs/OPTIMIZER.md +
     docs/STREAMING.md). Fusion runs late so every structural decision
-    upstream sees real node boundaries; streaming runs LAST so it can
-    absorb already-fused chains into chunked fit plans."""
+    upstream sees real node boundaries; streaming runs second-to-last so
+    it can absorb already-fused chains into chunked fit plans; the
+    measured-knob pass runs LAST so the StreamingFitOperator nodes it
+    tunes from profile-store history already exist."""
     from .fusion import NodeFusionRule
+    from .knobs import MeasuredKnobRule
     from .optimize import NodeOptimizationRule
     from .streaming import StreamingPlanRule
 
@@ -213,6 +216,7 @@ def default_optimizer() -> RuleExecutor:
             Batch("node-level-optimization", [NodeOptimizationRule()]),
             Batch("fusion", [NodeFusionRule()]),
             Batch("streaming", [StreamingPlanRule()]),
+            Batch("measured-knobs", [MeasuredKnobRule()]),
         ]
     )
 
@@ -228,6 +232,7 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
     output, never crosses it)."""
     from .autocache import AutoCacheRule
     from .fusion import NodeFusionRule
+    from .knobs import MeasuredKnobRule
     from .optimize import NodeOptimizationRule
     from .streaming import StreamingPlanRule
 
@@ -242,5 +247,6 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
             Batch("auto-cache", [AutoCacheRule(budget_bytes=budget_bytes, strategy=strategy)]),
             Batch("fusion", [NodeFusionRule()]),
             Batch("streaming", [StreamingPlanRule()]),
+            Batch("measured-knobs", [MeasuredKnobRule()]),
         ]
     )

@@ -63,6 +63,7 @@ from ..data.dataset import (
 )
 from ..obs import names as _names
 from ..obs import spans as _spans
+from ..obs import store as _store
 from ..reliability.faultinject import probe
 from .graph import Graph, NodeId, SourceId
 from .operators import DatasetOperator, EstimatorOperator, TransformerOperator
@@ -130,6 +131,19 @@ def stream_prefetch_depth() -> int:
     queued plus one in hand being uploaded — so the default keeps peak
     host residency at 2× chunk while still hiding decode behind compute."""
     return max(1, int(os.environ.get("KEYSTONE_STREAM_PREFETCH", 1)))
+
+
+def chain_class(members: Sequence[Any]) -> str:
+    """Process-stable identity of a featurize chain for knob keys: the
+    member type sequence, hashed. Deliberately coarser than the autocache
+    structural digest — a chunk-size observation transfers across fits
+    whose chains have the same op sequence even when weights differ."""
+    import hashlib
+
+    token = "|".join(
+        f"{type(m).__module__}.{type(m).__qualname__}" for m in members
+    )
+    return hashlib.sha1(token.encode()).hexdigest()[:16]
 
 
 class StreamingFallback(Exception):
@@ -208,6 +222,10 @@ class StreamReport:
     stall_s: float = 0.0
     compiles_first_chunk: int = 0
     compiles_steady_state: int = 0
+    #: perf_counter at fold start — the event lists below are offsets
+    #: from this, so exporters can place chunk slices on a session
+    #: timeline (obs/export.py Perfetto view).
+    t0_s: float = 0.0
     upload_issued_t: List[float] = field(default_factory=list)
     dispatch_t: List[float] = field(default_factory=list)
     compute_done_t: List[float] = field(default_factory=list)
@@ -221,6 +239,20 @@ class StreamReport:
             self.upload_issued_t[i + 1] <= self.compute_done_t[i]
             for i in range(self.chunks - 1)
         )
+
+    def overlap_efficiency(self) -> float:
+        """Fraction of chunk boundaries where the next upload was in
+        flight before the previous compute finished — 1.0 is perfect
+        double-buffering, the number the profile store remembers per
+        shape class."""
+        if self.chunks < 2:
+            return 1.0
+        good = sum(
+            1
+            for i in range(self.chunks - 1)
+            if self.upload_issued_t[i + 1] <= self.compute_done_t[i]
+        )
+        return good / (self.chunks - 1)
 
 
 _last_report: Optional[StreamReport] = None
@@ -469,6 +501,7 @@ class ChunkStream:
             num_examples=n,
             prefetch_depth=self.prefetch,
         )
+        data_shape = _store.dataset_shape_class(data)
         chunks_c = _names.metric(_names.STREAM_CHUNKS)
         bytes_c = _names.metric(_names.STREAM_BYTES)
         from ..data.ingest import PrefetchQueue
@@ -482,6 +515,7 @@ class ChunkStream:
         )
         in_hand_peak = 0
         t0 = time.perf_counter()
+        report.t0_s = t0
 
         # The loop below IS stream_pipelined — the same engine that runs
         # the flagship's per-bucket encode — with the carry threaded and
@@ -543,12 +577,35 @@ class ChunkStream:
             )
             _publish_report(report)
 
+        # A COMPLETED fold is a knob observation: remember what this
+        # chunk size achieved on this data shape, so MeasuredKnobRule can
+        # prefer the best recorded chunk_rows next plan (a failed fold
+        # recorded nothing — its throughput would be a lie).
+        if report.chunks == len(windows):
+            self._record_observation(report, data_shape)
+
         info = {
             "num_examples": n,
             "chunks": report.chunks,
             "report": report,
         }
         return carry, info
+
+    def _record_observation(self, report: StreamReport, data_shape: str) -> None:
+        store = _store.get_store()
+        if store is None or not report.compute_done_t:
+            return
+        wall = max(report.compute_done_t[-1], 1e-9)
+        store.record(
+            f"stream:{chain_class(self.members)}:cr{report.chunk_rows}",
+            data_shape,
+            chunk_rows=report.chunk_rows,
+            rows_per_s=report.num_examples / wall,
+            overlap_efficiency=report.overlap_efficiency(),
+            stall_s=round(report.stall_s, 6),
+            prefetch_depth=report.prefetch_depth,
+            host_buffer_peak_bytes=report.host_buffer_peak_bytes,
+        )
 
 
 def _chunk_spec(data: Dataset, chunk_rows: int):
@@ -627,6 +684,13 @@ class StreamingFitOperator(EstimatorOperator):
     def label(self) -> str:
         est = getattr(self.estimator, "label", type(self.estimator).__name__)
         return f"StreamFit[{est}+{len(self.members)}ops]"
+
+    @property
+    def solver_precision(self):
+        """The wrapped estimator's measured precision pin, surfaced so the
+        inherited ``EstimatorOperator.execute`` scopes the whole fit
+        (stream and materialized-fallback paths alike) under it."""
+        return getattr(self.estimator, "solver_precision", None)
 
     def fit_datasets(self, datasets: List[Dataset]) -> TransformerOperator:
         data = datasets[0]

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Perf-regression gate over BENCH json artifacts (docs/OBSERVABILITY.md).
+
+Thin wrapper so CI and operators can run the comparison without an
+installed entry point:
+
+    python scripts/bench_diff.py --baseline BENCH_r05.json \
+        --current /tmp/bench_fresh.json --legs fusion,streaming
+
+Equivalent to ``keystone-tpu bench-diff``; stdlib-only (no jax import),
+exit code 1 on a perf regression.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from keystone_tpu.obs.benchdiff import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,7 +3,10 @@
 # and assert both export artifacts are produced, non-empty, and loadable —
 # the Chrome trace with nested pipeline → node → solver spans, the
 # Prometheus snapshot with executor/autocache/reliability/serving metric
-# families. Exercises the exact path docs/OBSERVABILITY.md documents.
+# families. Then run a SECOND profile against the same persistent profile
+# store and assert the store round-trip: run 1 writes observations, run 2
+# reads them back (hits > 0) — the cross-process persistence the
+# optimizer's warm-start path depends on (docs/OBSERVABILITY.md).
 #
 # Usage: scripts/profile_smoke.sh [out_dir]
 set -euo pipefail
@@ -12,10 +15,11 @@ cd "$(dirname "$0")/.."
 OUT="${1:-$(mktemp -d)}"
 mkdir -p "$OUT"
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export KEYSTONE_PROFILE_STORE="$OUT/profile-store.jsonl"
 
 timeout -k 10 280 python -m keystone_tpu profile \
     --rows 64 --num-ffts 1 --block-size 32 --serve-requests 8 \
-    --out "$OUT" > "$OUT/profile_stdout.txt"
+    --out-dir "$OUT" > "$OUT/profile_stdout.txt"
 
 python - "$OUT" <<'EOF'
 import json, sys, os
@@ -28,7 +32,7 @@ assert os.path.getsize(prom_path) > 0, "empty prometheus snapshot"
 trace = json.load(open(trace_path))
 events = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
 assert events, "no complete events in chrome trace"
-by_id = {e["args"]["span_id"]: e for e in events}
+by_id = {e["args"]["span_id"]: e for e in events if "span_id" in e.get("args", {})}
 def chain(e):
     seen = [e["name"]]
     while e["args"].get("parent_id") in by_id:
@@ -43,6 +47,7 @@ assert any(e["name"] == "serve:request" for e in events), "no request spans"
 prom = open(prom_path).read()
 for family in ("keystone_executor_nodes_executed_total",
                "keystone_autocache_cached_nodes_total",
+               "keystone_profile_store_writes_total",
                "keystone_reliability_events_total",
                "keystone_serving_requests_total",
                "keystone_serving_latency_seconds"):
@@ -53,6 +58,31 @@ summary = [l for l in stdout.splitlines() if l.startswith("PROFILE_JSON:")]
 assert len(summary) == 1, "missing PROFILE_JSON summary line"
 s = json.loads(summary[0][len("PROFILE_JSON:"):])
 assert s["spans"] > 10, s
-print(f"profile_smoke OK: {s['spans']} spans, fit={s['fit_s']}s, "
-      f"serve_rps={s.get('serve', {}).get('rps')}, artifacts in {out}")
+store_line = [l for l in stdout.splitlines() if l.startswith("PROFILE_STORE:")]
+assert len(store_line) == 1, "missing PROFILE_STORE summary line"
+st = json.loads(store_line[0][len("PROFILE_STORE:"):])
+assert st["enabled"] and st["writes"] > 0, f"run 1 wrote nothing: {st}"
+print(f"profile_smoke run 1 OK: {s['spans']} spans, fit={s['fit_s']}s, "
+      f"store writes={st['writes']}, serve_rps={s.get('serve', {}).get('rps')}")
+EOF
+
+# Run 2, FRESH process, same store: must read run 1's measurements back.
+timeout -k 10 280 python -m keystone_tpu profile \
+    --rows 64 --num-ffts 1 --block-size 32 --no-serve \
+    --out-dir "$OUT/run2" > "$OUT/profile_stdout2.txt"
+
+python - "$OUT" <<'EOF'
+import json, sys, os
+out = sys.argv[1]
+stdout = open(os.path.join(out, "profile_stdout2.txt")).read()
+store_line = [l for l in stdout.splitlines() if l.startswith("PROFILE_STORE:")]
+assert len(store_line) == 1, "missing PROFILE_STORE summary line (run 2)"
+st = json.loads(store_line[0][len("PROFILE_STORE:"):])
+assert st["enabled"] and st["hits"] > 0, \
+    f"store round-trip failed: run 2 saw no hits from run 1: {st}"
+summary = json.loads([l for l in stdout.splitlines()
+                      if l.startswith("PROFILE_JSON:")][0][len("PROFILE_JSON:"):])
+assert "previous" in summary, "run 2 summary missing previous-run comparison"
+print(f"profile_smoke OK: store round-trip verified "
+      f"(run 2 hits={st['hits']}, previous fit_s={summary['previous'].get('fit_s')})")
 EOF

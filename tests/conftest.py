@@ -8,11 +8,21 @@ the process-wide PipelineEnv is reset after every test.
 """
 
 import os
+import tempfile
 
 # Must run before any backend is touched. The session may preset
 # JAX_PLATFORMS to a TPU platform and pre-import jax via sitecustomize, so
 # set the config post-import too: tests always use the virtual CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Isolate the persistent profile store per test session: tests must never
+# warm-start from (or pollute) the developer's ~/.cache store — a warm
+# store changes which tests sample-profile. Tests that need their own
+# store monkeypatch KEYSTONE_PROFILE_STORE further.
+os.environ["KEYSTONE_PROFILE_STORE"] = os.path.join(
+    tempfile.mkdtemp(prefix="keystone-test-profile-store-"),
+    "profile-store.jsonl",
+)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

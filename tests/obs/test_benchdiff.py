@@ -1,0 +1,250 @@
+"""bench-diff verdict tests: improved / regressed / noisy synthetic
+inputs, exact count asserts, platform guards, and artifact-format
+parsing (docs/OBSERVABILITY.md)."""
+
+import json
+
+from keystone_tpu.obs.benchdiff import (
+    compare_leg,
+    diff_reports,
+    load_bench_report,
+    main,
+    report_legs,
+)
+
+
+def leg(**kw):
+    base = {
+        "n": 1024, "d": 64,
+        "fit_ms": 100.0, "wall_s": 5.0,
+        "fused_dispatches_per_apply": 1.0,
+        "parity_rel_err": 1e-6,
+    }
+    base.update(kw)
+    return base
+
+
+def report(platform="cpu", **legs):
+    return {"platform": platform, **legs}
+
+
+def diff(base_leg, cur_leg, **kw):
+    return diff_reports(
+        report(timit=base_leg), report(timit=cur_leg), **kw
+    )
+
+
+# ----------------------------------------------------------------- verdicts
+
+
+def test_unchanged_rerun_passes():
+    v = diff(leg(), leg())
+    assert v["ok"] and v["legs"]["timit"]["status"] == "ok"
+
+
+def test_synthetic_2x_slowdown_is_flagged():
+    v = diff(leg(), leg(fit_ms=200.0))
+    assert not v["ok"]
+    assert v["regressions"] == ["timit"]
+    bad = [c for c in v["legs"]["timit"]["checks"] if c["verdict"] == "regression"]
+    assert bad and bad[0]["key"] == "fit_ms" and bad[0]["ratio"] == 2.0
+
+
+def test_noise_within_tolerance_passes():
+    # +30% on a 100 ms leg is CI noise at the default 50% tolerance
+    v = diff(leg(), leg(fit_ms=130.0))
+    assert v["ok"]
+
+
+def test_small_absolute_deltas_never_regress():
+    # 3 ms -> 9 ms is a 3x ratio but below the 50 ms floor: jitter
+    v = diff(leg(fit_ms=3.0), leg(fit_ms=9.0))
+    assert v["ok"]
+
+
+def test_improvement_is_reported_not_failed():
+    v = diff(leg(), leg(fit_ms=40.0))
+    assert v["ok"] and v["legs"]["timit"]["status"] == "improved"
+
+
+def test_dispatch_count_compared_exactly():
+    v = diff(leg(), leg(fused_dispatches_per_apply=2.0))
+    assert not v["ok"]
+    bad = [c for c in v["legs"]["timit"]["checks"] if c["verdict"] == "regression"]
+    assert bad[0]["kind"] == "exact"
+
+
+def test_compile_counts_compared_exactly():
+    b = leg(streaming_report={"compiles_first_chunk": 1, "compiles_steady_state": 0})
+    c = leg(streaming_report={"compiles_first_chunk": 1, "compiles_steady_state": 2})
+    v = diff(b, c)
+    assert not v["ok"]
+    bad = [x for x in v["legs"]["timit"]["checks"] if x["verdict"] == "regression"]
+    assert bad[0]["key"] == "streaming_report.compiles_steady_state"
+
+
+def test_parity_blowup_is_flagged_and_jitter_is_not():
+    assert diff(leg(), leg(parity_rel_err=5e-6))["ok"]  # fp jitter
+    assert not diff(leg(parity_rel_err=1e-4), leg(parity_rel_err=0.5))["ok"]
+
+
+def test_overlap_flag_regression():
+    b = leg(streaming_report={"overlap_ok": True})
+    c = leg(streaming_report={"overlap_ok": False})
+    assert not diff(b, c)["ok"]
+
+
+def test_config_mismatch_is_incomparable_not_regression():
+    v = diff(leg(n=1024), leg(n=2048, fit_ms=500.0))
+    assert v["ok"]
+    assert v["legs"]["timit"]["status"] == "incomparable"
+
+
+def test_platform_mismatch_skips_timings_keeps_counts():
+    base = report(platform="tpu", timit=leg())
+    cur = report(platform="cpu", timit=leg(fit_ms=5000.0))
+    v = diff_reports(base, cur)
+    assert v["ok"] and not v["timings_comparable"]
+    # but a count delta still fails across platforms
+    cur_bad = report(platform="cpu", timit=leg(fused_dispatches_per_apply=3.0))
+    assert not diff_reports(base, cur_bad)["ok"]
+
+
+def test_leg_now_failing_is_a_regression():
+    v = diff(leg(), {"error": "RESOURCE_EXHAUSTED"})
+    assert not v["ok"]
+    assert "failure" in v["legs"]["timit"]["note"]
+
+
+def test_errored_baseline_and_missing_legs_are_skipped():
+    base = report(timit={"error": "died"}, other=leg())
+    cur = report(timit=leg())
+    v = diff_reports(base, cur)
+    assert v["ok"]
+    assert v["legs"]["timit"]["status"] == "skipped"
+    assert v["legs"]["other"]["status"] == "skipped"
+
+
+def test_wall_s_and_environment_keys_are_ignored():
+    b = leg(wall_s=5.0, obs={"xla_compiles": 3}, peak_host_rss_mb=1000.0)
+    c = leg(wall_s=50.0, obs={"xla_compiles": 40}, peak_host_rss_mb=9000.0)
+    assert diff(b, c)["ok"]
+
+
+# ----------------------------------------------------------- artifact formats
+
+
+def test_load_raw_child_report(tmp_path):
+    p = tmp_path / "r.json"
+    p.write_text(json.dumps(report(timit=leg())))
+    r = load_bench_report(str(p))
+    assert report_legs(r) == ["timit"]
+
+
+def test_load_driver_wrapper_with_embedded_report(tmp_path):
+    inner = report(timit=leg())
+    wrapper = {"n": 5, "cmd": "python bench.py", "rc": 0,
+               "tail": "noise\nBENCH_CHILD_JSON:" + json.dumps(inner) + "\n",
+               "parsed": None}
+    p = tmp_path / "w.json"
+    p.write_text(json.dumps(wrapper))
+    r = load_bench_report(str(p))
+    assert r["timit"]["fit_ms"] == 100.0
+
+
+def test_load_truncated_tail_recovers_whole_legs(tmp_path):
+    # the committed driver artifacts keep only the last N bytes: the
+    # outer object is beheaded but whole legs survive
+    inner = report(timit=leg(), gram=leg(fit_ms=8.0))
+    tail = json.dumps(inner)
+    wrapper = {"n": 5, "cmd": "x", "rc": 0, "tail": tail[len(tail) // 2:],
+               "parsed": None}
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(wrapper))
+    r = load_bench_report(str(p))
+    assert "gram" in r or "timit" in r  # at least the unbeheaded legs
+
+
+def test_committed_artifacts_parse():
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    for name in ("BENCH_CI_BASELINE.json", "BENCH_r05.json"):
+        r = load_bench_report(os.path.join(root, name))
+        assert report_legs(r), name
+
+
+def test_main_exit_codes(tmp_path):
+    good = tmp_path / "good.json"
+    bad = tmp_path / "bad.json"
+    good.write_text(json.dumps(report(timit=leg())))
+    bad.write_text(json.dumps(report(timit=leg(fit_ms=300.0))))
+    assert main(["--baseline", str(good), "--current", str(good)]) == 0
+    assert main(["--baseline", str(good), "--current", str(bad)]) == 1
+    out = tmp_path / "verdict.json"
+    main(["--baseline", str(good), "--current", str(bad), "--out", str(out)])
+    verdict = json.loads(out.read_text())
+    assert verdict["regressions"] == ["timit"]
+
+
+def test_unknown_platform_skips_timings():
+    """A truncated driver wrapper loses its platform key; its recovered
+    legs may carry TPU walls — never ratio them against CPU walls."""
+    base = {"timit": leg()}  # no platform key at all
+    cur = report(platform="cpu", timit=leg(fit_ms=5000.0))
+    v = diff_reports(base, cur)
+    assert v["ok"] and not v["timings_comparable"]
+    # counts still exact across the unknown boundary
+    cur_bad = report(platform="cpu", timit=leg(fused_dispatches_per_apply=9.0))
+    assert not diff_reports(base, cur_bad)["ok"]
+
+
+def test_truncated_current_leg_is_a_regression():
+    """A leg that used to finish and now blows its child deadline is the
+    gate's reason to exist — partial surviving keys must not read ok."""
+    v = diff(leg(), dict(leg(), truncated="child deadline (150s)"))
+    assert not v["ok"]
+    assert "failure" in v["legs"]["timit"]["note"]
+
+
+def test_obs_registry_deltas_are_never_exact_compared():
+    """obs.* metric deltas span warmups/incidental applies — a benign
+    warmup change must not fail the gate even when the key mentions
+    dispatches."""
+    b = leg(obs={"metrics_delta": {"keystone_fusion_batch_dispatches_total{fused=0}": 168.0}})
+    c = leg(obs={"metrics_delta": {"keystone_fusion_batch_dispatches_total{fused=0}": 170.0}})
+    assert diff(b, c)["ok"]
+
+
+def test_toplevel_chunks_is_config_nested_chunks_is_exact():
+    # reconfigured leg (different chunking plan) → incomparable, not failed
+    v = diff(leg(chunks=8), leg(chunks=4))
+    assert v["ok"] and v["legs"]["timit"]["status"] == "incomparable"
+    # but the ENGINE dispatching fewer chunks than planned is a regression
+    b = leg(streaming_report={"chunks": 8})
+    c = leg(streaming_report={"chunks": 6})
+    assert not diff(b, c)["ok"]
+
+
+def test_explicitly_requested_missing_leg_fails_the_gate():
+    """A leg named via --legs that is absent from either artifact must be
+    a regression, not a silent skip — a typo'd CI leg list or a renamed
+    bench leg would otherwise leave the gate green forever."""
+    base = report(fusion=leg())
+    cur = report(fusion=leg())
+    verdict = diff_reports(base, cur, legs=["fusion", "streamin"])
+    assert not verdict["ok"]
+    assert verdict["legs"]["streamin"]["status"] == "regression"
+    assert "required leg missing" in verdict["legs"]["streamin"]["note"]
+    # missing only from the baseline is equally fatal for a required leg
+    verdict = diff_reports(report(), report(fusion=leg()), legs=["fusion"])
+    assert not verdict["ok"]
+
+
+def test_auto_discovered_one_sided_leg_still_skips():
+    """Without an explicit --legs list, artifacts may legitimately differ
+    in coverage: one-sided legs skip instead of failing."""
+    verdict = diff_reports(report(fusion=leg()), report(serving=leg()))
+    assert verdict["ok"]
+    assert verdict["legs"]["fusion"]["status"] == "skipped"
+    assert verdict["legs"]["serving"]["status"] == "skipped"

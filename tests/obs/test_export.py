@@ -95,3 +95,110 @@ def test_report_renders_hierarchy_and_durations():
     assert "  node:featurize" in text  # indented child
     assert "    solver:iteration" in text  # grandchild
     assert "ms" in text.splitlines()[0]
+
+
+# ------------------------------------------------------- stream chunk slices
+
+
+def test_stream_report_exports_perfetto_slices():
+    """last_stream_report() per-chunk events render as ph:X slices on
+    named stream-upload/stream-compute tracks, placed on the session
+    timeline, so the double-buffer overlap is visually inspectable."""
+    from keystone_tpu.obs.export import chrome_trace
+    from keystone_tpu.obs.spans import TraceSession
+    from keystone_tpu.workflow.streaming import StreamReport
+
+    session = TraceSession("t")
+    report = StreamReport(
+        chunks=3, chunk_rows=64, num_examples=192,
+        t0_s=session.started_s + 0.5,
+        upload_issued_t=[0.0, 0.01, 0.02],
+        dispatch_t=[0.005, 0.015, 0.025],
+        compute_done_t=[0.012, 0.022, 0.032],
+    )
+    trace = chrome_trace(session, stream_report=report)
+    slices = [e for e in trace["traceEvents"]
+              if e.get("cat") == "stream" and e.get("ph") == "X"]
+    assert len(slices) == 6  # 3 uploads + 3 computes
+    uploads = [e for e in slices if "upload" in e["name"]]
+    computes = [e for e in slices if "compute" in e["name"]]
+    assert len(uploads) == len(computes) == 3
+    # upload slice of chunk 1 starts before compute of chunk 0 ends —
+    # the overlap is visible in the timestamps themselves
+    assert uploads[1]["ts"] < computes[0]["ts"] + computes[0]["dur"]
+    # anchored on the session timeline: chunk 0 upload at ~0.5 s
+    assert abs(uploads[0]["ts"] - 0.5e6) < 1e3
+    names = {e["args"]["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "M"}
+    assert {"stream-upload", "stream-compute"} <= names
+
+
+def test_chrome_trace_without_stream_report_unchanged():
+    from keystone_tpu.obs.export import chrome_trace
+    from keystone_tpu.obs.spans import TraceSession
+
+    trace = chrome_trace(TraceSession("t"))
+    assert all(e.get("cat") != "stream" for e in trace["traceEvents"])
+
+
+# ------------------------------------------------------- per-device payloads
+
+
+def test_per_device_memory_gauges_and_payload():
+    """CPU meshes collapse to one host entry; the gauges carry a device
+    label and the dryrun payload is JSON-serializable."""
+    import json as _json
+
+    from keystone_tpu.obs import names as obs_names
+    from keystone_tpu.obs.device import (
+        device_obs_payload, per_device_snapshots, publish_per_device_memory,
+    )
+
+    snaps = per_device_snapshots()
+    assert snaps, "at least the host fallback entry"
+    assert all("device" in s and "bytes_in_use" in s for s in snaps)
+    published = publish_per_device_memory(stage="test")
+    gauge = obs_names.metric(obs_names.MEMORY_IN_USE_BYTES)
+    for snap in published:
+        assert gauge.value(
+            source=snap["source"], device=snap["device"]
+        ) == snap["bytes_in_use"]
+    payload = device_obs_payload()
+    assert _json.dumps(payload)  # artifact-embeddable
+    assert payload["devices"] and "xla_compiles" in payload
+
+
+def test_failing_device_yields_error_entry_not_omission(monkeypatch):
+    """A chip whose memory_stats() raises (the wedged/OOMing one — exactly
+    the chip the per-device series exists to expose) must appear as an
+    error entry, not vanish from the list. Backends without memory_stats
+    (AttributeError) still collapse to the host fallback."""
+    import jax
+
+    from keystone_tpu.obs.device import (
+        device_obs_payload, per_device_snapshots, publish_per_device_memory,
+    )
+
+    class Wedged:
+        platform, id = "tpu", 3
+
+        def memory_stats(self):
+            raise RuntimeError("RESOURCE_EXHAUSTED: stats unavailable")
+
+    class Healthy:
+        platform, id = "tpu", 0
+
+        def memory_stats(self):
+            return {"bytes_in_use": 123, "peak_bytes_in_use": 456}
+
+    monkeypatch.setattr(jax, "local_devices", lambda: [Healthy(), Wedged()])
+    snaps = per_device_snapshots()
+    assert [s["device"] for s in snaps] == ["tpu:0", "tpu:3"]
+    assert snaps[1]["source"] == "error"
+    assert "RESOURCE_EXHAUSTED" in snaps[1]["error"]
+    # publishing skips the error entry (no bytes) without raising
+    published = publish_per_device_memory(stage="test")
+    assert len(published) == 2
+    # the payload reuses a passed snapshot instead of re-walking devices
+    payload = device_obs_payload(snapshots=snaps)
+    assert payload["devices"] is snaps

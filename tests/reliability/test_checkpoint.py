@@ -228,3 +228,31 @@ def test_killed_then_resumed_run_reuses_fitted_prefixes(tmp_path):
     assert "RESUME_OK" in run2.stdout
     # A fit exactly once ACROSS BOTH PROCESSES; B fit once in run 2.
     assert sorted(open(countfile).read().splitlines()) == ["A", "B"]
+
+
+def test_token_memo_hashes_shared_values_once(monkeypatch):
+    """Digesting N prefixes of one plan re-tokenizes the same training
+    array N times; inside token_memo() the content hash is paid once and
+    the digests are unchanged."""
+    import numpy as np
+
+    from keystone_tpu.reliability import checkpoint as cp
+
+    arr = np.arange(64, dtype=np.float32)
+    cold = cp._value_token(arr)
+
+    calls = {"n": 0}
+    real_sha1 = cp.hashlib.sha1
+
+    def counting_sha1(*a, **kw):
+        calls["n"] += 1
+        return real_sha1(*a, **kw)
+
+    monkeypatch.setattr(cp.hashlib, "sha1", counting_sha1)
+    with cp.token_memo():
+        tokens = [cp._value_token(arr) for _ in range(5)]
+    assert calls["n"] == 1
+    assert all(t == cold for t in tokens)
+    # the memo dies with the scope: a later call re-hashes
+    assert cp._value_token(arr) == cold
+    assert calls["n"] == 2

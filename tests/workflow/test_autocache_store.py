@@ -1,0 +1,243 @@
+"""AutoCacheRule × ProfileStore: warm-starting the cost model from
+persisted profiles (docs/OBSERVABILITY.md, docs/OPTIMIZER.md).
+
+The acceptance contract: a second fit of an identical pipeline — in a
+FRESH PROCESS — skips sample execution entirely (zero profiling-
+interpreter runs) and reaches byte-identical cache decisions from the
+stored linear-fit coefficients. KEYSTONE_PROFILE_STORE=off restores the
+always-reprofile behavior; an environment-fingerprint change invalidates
+the warm start.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs.store import ProfileStore
+from keystone_tpu.ops.util.misc import CacherOperator
+from keystone_tpu.workflow.autocache import AutoCacheRule
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator, TransformerOperator
+
+FP = {"jax": "test", "backend": "cpu", "device_kind": "virtual"}
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class CountingOp(TransformerOperator):
+    """Identity op counting sample executions, charging fake time."""
+
+    def __init__(self, name, delay_s=0.0, clock=None):
+        self.name = name
+        self.delay_s = delay_s
+        self.clock = clock
+        self.batch_calls = 0
+
+    @property
+    def label(self):
+        return self.name
+
+    def single_transform(self, datums):
+        return datums[0]
+
+    def batch_transform(self, datasets):
+        self.batch_calls += 1
+        if self.delay_s and self.clock is not None:
+            self.clock.t += self.delay_s
+        return datasets[0]
+
+
+def diamond(clock, n=64):
+    """dataset → expensive shared → two consumers → sinks; returns
+    (graph, ops)."""
+    data = ArrayDataset(np.ones((n, 4), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    ops = [CountingOp("shared", delay_s=0.01, clock=clock)]
+    g, sh = g.add_node(ops[0], [d])
+    for name in ("left", "right"):
+        op = CountingOp(name, clock=clock)
+        ops.append(op)
+        g, c = g.add_node(op, [sh])
+        g, _ = g.add_sink(c)
+    return g, ops
+
+
+def decisions(graph):
+    """Sorted labels of the nodes the planner chose to cache."""
+    return sorted(
+        graph.get_operator(graph.get_dependencies(c)[0]).label
+        for c in graph.nodes
+        if isinstance(graph.get_operator(c), CacherOperator)
+    )
+
+
+def rule(tmp_path, clock, fp=FP):
+    store = ProfileStore(str(tmp_path / "ps.jsonl"), fingerprint=dict(fp))
+    return AutoCacheRule(
+        budget_bytes=1 << 30, clock=clock, profile_store=store
+    )
+
+
+def test_warm_store_skips_sampling_with_identical_decisions(tmp_path):
+    clock = FakeClock()
+    g1, ops1 = diamond(clock)
+    out1, _ = rule(tmp_path, clock).apply(g1, {})
+    assert sum(op.batch_calls for op in ops1) > 0  # cold: sampled
+    first = decisions(out1)
+    assert first  # the expensive shared node was worth caching
+
+    # Fresh rule + fresh store INSTANCE over the same file + structurally
+    # identical graph: zero sample executions, identical cache set.
+    clock2 = FakeClock()
+    g2, ops2 = diamond(clock2)
+    out2, _ = rule(tmp_path, clock2).apply(g2, {})
+    assert sum(op.batch_calls for op in ops2) == 0
+    assert decisions(out2) == first
+
+
+def test_off_switch_reprofiles_every_plan(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_PROFILE_STORE", "off")
+    for _ in range(2):
+        clock = FakeClock()
+        g, ops = diamond(clock)
+        AutoCacheRule(budget_bytes=1 << 30, clock=clock).apply(g, {})
+        assert sum(op.batch_calls for op in ops) > 0
+
+
+def test_fingerprint_change_forces_reprofile(tmp_path):
+    clock = FakeClock()
+    g, _ = diamond(clock)
+    rule(tmp_path, clock).apply(g, {})
+    clock2 = FakeClock()
+    g2, ops2 = diamond(clock2)
+    out, _ = rule(
+        tmp_path, clock2, fp={**FP, "jax": "different-version"}
+    ).apply(g2, {})
+    assert sum(op.batch_calls for op in ops2) > 0  # re-sampled
+    assert decisions(out)  # and still decided from the fresh samples
+
+
+def test_changed_data_changes_digest_and_reprofiles(tmp_path):
+    clock = FakeClock()
+    g, _ = diamond(clock, n=64)
+    rule(tmp_path, clock).apply(g, {})
+    clock2 = FakeClock()
+    g2, ops2 = diamond(clock2, n=32)  # different training data
+    rule(tmp_path, clock2).apply(g2, {})
+    assert sum(op.batch_calls for op in ops2) > 0
+
+
+# ------------------------------------------------------ fresh-process contract
+
+_FIT_SCRIPT = r"""
+import json, os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs import metrics as obs_metrics
+from keystone_tpu.obs import names as obs_names
+from keystone_tpu.obs.store import get_store
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats.core import LinearRectifier, RandomSignNode
+from keystone_tpu.ops.util.misc import CacherOperator
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.rules import auto_caching_optimizer
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(96, 8)).astype(np.float32)
+y = rng.normal(size=(96, 2)).astype(np.float32)
+feat = RandomSignNode.create(8, seed=3).to_pipeline().then(LinearRectifier(0.0))
+pipe = feat.then_label_estimator(
+    BlockLeastSquaresEstimator(4, num_iter=2, reg=1e-3),
+    ArrayDataset(x), ArrayDataset(y),
+)
+env = PipelineEnv.get_or_create()
+env.optimizer = auto_caching_optimizer()
+
+# The same optimize step Pipeline.fit() runs first — captured here so the
+# chosen cache set is observable, then the fit itself completes end to end.
+graph, prefixes = env.optimizer.execute(pipe.graph)
+cached = sorted(
+    type(graph.get_operator(graph.get_dependencies(c)[0])).__name__
+    for c in graph.nodes
+    if isinstance(graph.get_operator(c), CacherOperator)
+)
+fitted = pipe.fit()
+out = np.asarray(fitted(ArrayDataset(x)).get().data)
+assert out.shape[0] == 96 and np.isfinite(out).all()
+
+hist = obs_metrics.get_registry().get(obs_names.AUTOCACHE_PROFILE_SECONDS)
+store = get_store()
+print("RESULT " + json.dumps({
+    "sampling_runs": hist.count() if hist is not None else 0,
+    "decisions": cached,
+    "store": store.stats() if store is not None else None,
+}))
+"""
+
+
+def test_second_fit_in_fresh_process_skips_sampling(tmp_path):
+    """The acceptance contract, end to end: run the SAME real pipeline
+    fit in two fresh processes sharing one persisted store. Run 1
+    sample-profiles and records; run 2 performs ZERO sample-interpreter
+    runs and reaches byte-identical cache decisions."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["KEYSTONE_PROFILE_STORE"] = str(tmp_path / "ps.jsonl")
+    env.pop("KEYSTONE_MEASURED_KNOBS", None)
+
+    def run():
+        proc = subprocess.run(
+            [sys.executable, "-c", _FIT_SCRIPT], env=env,
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT ")]
+        assert line, proc.stdout[-2000:]
+        return json.loads(line[0][len("RESULT "):])
+
+    first = run()
+    assert first["sampling_runs"] > 0, first
+    assert first["store"]["writes"] > 0, first
+
+    second = run()
+    assert second["sampling_runs"] == 0, second  # zero sample-interpreter runs
+    assert second["decisions"] == first["decisions"]  # byte-identical choices
+    assert second["store"]["hits"] > 0, second
+
+
+def test_changed_profiling_config_reprofiles(tmp_path):
+    """Warm-start entries only cover plans profiled under the SAME
+    profiling config: a rule reconfigured with different sample scales or
+    trial counts must re-execute sample profiling, not silently reuse
+    coefficients measured under the old config."""
+    clock = FakeClock()
+    g, ops = diamond(clock)
+    rule(tmp_path, clock).apply(g, {})
+    assert sum(op.batch_calls for op in ops) > 0  # cold: sampled
+
+    clock2 = FakeClock()
+    g2, ops2 = diamond(clock2)
+    rule(tmp_path, clock2).apply(g2, {})
+    assert sum(op.batch_calls for op in ops2) == 0  # warm, same config
+
+    clock3 = FakeClock()
+    g3, ops3 = diamond(clock3)
+    store = ProfileStore(str(tmp_path / "ps.jsonl"), fingerprint=dict(FP))
+    AutoCacheRule(
+        budget_bytes=1 << 30, clock=clock3, profile_store=store,
+        profile_scales=(2, 4, 8),
+    ).apply(g3, {})
+    # same store, different scales: measured afresh, not silently reused
+    assert sum(op.batch_calls for op in ops3) > 0

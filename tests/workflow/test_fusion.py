@@ -111,15 +111,16 @@ def test_four_node_chain_fuses_to_one_node():
 
 def test_fusion_rule_is_in_default_optimizer():
     # fusion is the last STRUCTURAL batch; only the streaming planner
-    # (which absorbs already-fused chains) may follow it.
+    # (which absorbs already-fused chains) and the measured-knob pass
+    # (which re-parameterizes, never restructures) may follow it.
     names = [b.name for b in default_optimizer().batches]
-    assert names[-2:] == ["fusion", "streaming"]
+    assert names[-3:] == ["fusion", "streaming", "measured-knobs"]
     from keystone_tpu.workflow.rules import auto_caching_optimizer
 
     names = [b.name for b in auto_caching_optimizer().batches]
     # fusion strictly after auto-cache: cache planning sees real nodes
     assert names.index("fusion") == names.index("auto-cache") + 1
-    assert names[-1] == "streaming"
+    assert names[-2:] == ["streaming", "measured-knobs"]
 
 
 def test_cacher_is_a_fusion_boundary():

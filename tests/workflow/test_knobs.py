@@ -1,0 +1,324 @@
+"""MeasuredKnobRule: plan knobs overridden from the profile store's best
+recorded observations (docs/OPTIMIZER.md).
+
+Default mode (``on``) applies only the semantics-free chunk-rows
+override; precision and block size — which move numerics within solver
+tolerance — require ``KEYSTONE_MEASURED_KNOBS=all``; explicit env knobs
+always beat measurements.
+"""
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs.store import ProfileStore, dataset_shape_class, shape_class
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.knobs import MeasuredKnobRule, knob_mode
+from keystone_tpu.workflow.operators import DatasetOperator
+from keystone_tpu.workflow.streaming import StreamingFitOperator, chain_class
+
+FP = {"jax": "test", "backend": "cpu", "device_kind": "virtual"}
+N_ROWS = 4096
+
+
+def store(tmp_path):
+    return ProfileStore(str(tmp_path / "ps.jsonl"), fingerprint=dict(FP))
+
+
+def stream_graph(chunk_rows=None):
+    """dataset → StreamingFitOperator(estimator) → sink, the shape the
+    rule sees after the streaming batch ran."""
+    data = ArrayDataset(np.ones((N_ROWS, 8), dtype=np.float32))
+    est = BlockLeastSquaresEstimator(512, num_iter=1, reg=1e-3)
+    op = StreamingFitOperator(est, (), chunk_rows=chunk_rows)
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, s = g.add_node(op, [d])
+    g, _ = g.add_sink(s)
+    return g, s, data
+
+
+def record_stream_obs(st, data, best_rows=1024, worse_rows=256):
+    shape = dataset_shape_class(data)
+    cc = chain_class(())
+    st.record(f"stream:{cc}:cr{worse_rows}", shape,
+              chunk_rows=worse_rows, rows_per_s=1e5)
+    st.record(f"stream:{cc}:cr{best_rows}", shape,
+              chunk_rows=best_rows, rows_per_s=5e5)
+    return shape
+
+
+def test_knob_mode_parsing(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_MEASURED_KNOBS", raising=False)
+    assert knob_mode() == "on"
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    assert knob_mode() == "all"
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "off")
+    assert knob_mode() == "off"
+
+
+def test_chunk_rows_overridden_from_best_recorded_throughput(
+    tmp_path, monkeypatch
+):
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    record_stream_obs(st, data, best_rows=1024)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).chunk_rows == 1024
+
+
+def test_explicit_env_knob_beats_measurement(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", "2048")
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    record_stream_obs(st, data)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).chunk_rows is None  # untouched
+
+
+def test_operator_pinned_chunk_rows_untouched(tmp_path, monkeypatch):
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph(chunk_rows=512)
+    record_stream_obs(st, data)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).chunk_rows == 512
+
+
+def test_no_matching_shape_class_no_override(tmp_path, monkeypatch):
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    # observation from a 100x larger dataset: different rows bucket
+    st.record(f"stream:{chain_class(())}:cr8192",
+              shape_class(100 * N_ROWS, (8,), "float32"),
+              chunk_rows=8192, rows_per_s=1e6)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).chunk_rows is None
+
+
+def test_off_mode_is_a_no_op(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "off")
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    record_stream_obs(st, data)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).chunk_rows is None
+
+
+def test_precision_override_requires_all_mode(tmp_path, monkeypatch):
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    st = store(tmp_path)
+    st.record("solver:block_ls:bs512:precdefault",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.1, block_size=512, precision="default")
+    st.record("solver:block_ls:bs512:precrefine",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.9, block_size=512, precision="refine")
+    g, node, data = stream_graph()
+    # default mode: numerics-touching knobs stay put
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).solver_precision is None
+    # all mode: fastest recorded precision is pinned onto the OPERATOR —
+    # never installed as process state, so solver_mode() outside the
+    # planned fit stays at the shipped default
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).solver_precision == "default"
+    assert linalg.solver_mode() == "refine"
+    # an explicit env choice beats the measurement: the rule skips
+    monkeypatch.setenv("KEYSTONE_SOLVER_PRECISION", "highest")
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).solver_precision is None
+    assert linalg.solver_mode() == "highest"
+
+
+def test_pinned_precision_scopes_only_the_planned_fit(tmp_path, monkeypatch):
+    """The operator's measured precision applies around ITS fit via
+    linalg.solver_mode_scope and is restored afterwards — unplanned
+    solves and other threads never observe it."""
+    import threading
+
+    from keystone_tpu.parallel import linalg
+    from keystone_tpu.workflow.operators import EstimatorOperator
+
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    seen = {}
+
+    class Probe(EstimatorOperator):
+        label = "Probe"
+
+        def fit_datasets(self, datasets):
+            seen["during"] = linalg.solver_mode()
+            other = {}
+            t = threading.Thread(
+                target=lambda: other.setdefault("mode", linalg.solver_mode())
+            )
+            t.start()
+            t.join()
+            seen["other_thread"] = other["mode"]
+            return None
+
+    class Dep:
+        def get(self):
+            return ArrayDataset(np.ones((4, 2), dtype=np.float32))
+
+    op = Probe()
+    op.solver_precision = "default"
+    op.execute([Dep()]).get()
+    assert seen["during"] == "default"
+    assert seen["other_thread"] == "refine"  # thread-local, no leak
+    assert linalg.solver_mode() == "refine"  # restored after the fit
+
+
+def test_block_size_override_in_all_mode(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    from keystone_tpu.parallel import linalg
+
+    st = store(tmp_path)
+    st.record("solver:block_ls:bs128:precrefine",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.05, block_size=128, precision="refine")
+    g, node, data = stream_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        tuned = out.get_operator(node)
+        assert isinstance(tuned, StreamingFitOperator)
+        assert tuned.estimator.block_size == 128
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_override_metrics_are_counted(tmp_path, monkeypatch):
+    from keystone_tpu.obs import names as obs_names
+
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    record_stream_obs(st, data)
+    counter = obs_names.metric(obs_names.PROFILE_STORE_KNOB_OVERRIDES)
+    before = counter.value(knob="stream_chunk_rows")
+    MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert counter.value(knob="stream_chunk_rows") == before + 1
+
+
+def test_precisionless_best_entry_does_not_veto_override(tmp_path, monkeypatch):
+    """The meta-solver's rung entries carry walls but no precision; a
+    cheap one winning on wall_s must not disable the precision knob."""
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    st = store(tmp_path)
+    st.record("solver:least_squares:rung_dense_lbfgs",
+              shape_class(N_ROWS, (8,), "float32"), wall_s=0.001)
+    st.record("solver:block_ls:bs512:precdefault",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.2, block_size=512, precision="default")
+    g, node, data = stream_graph()
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(node).solver_precision == "default"
+    assert linalg.solver_mode() == "refine"  # pinned, not process state
+
+
+def test_stale_precision_override_cleared_by_next_plan(tmp_path, monkeypatch):
+    """A plan with no measured winner for ITS shape class must clear a
+    previous plan's process-global override, not inherit it."""
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    st = store(tmp_path)  # empty: nothing measured
+    linalg.set_solver_mode_override("default")  # leftover from elsewhere
+    g, node, data = stream_graph()
+    try:
+        MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert linalg.solver_mode() == "refine"  # back to the default
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_stream_solver_walls_do_not_drive_block_size(tmp_path, monkeypatch):
+    """block_ls_stream walls cover the whole ingest+featurize+Gram fold;
+    they must not win the in-core block-size selection."""
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    st = store(tmp_path)
+    st.record("solver:block_ls_stream:bs32:precrefine",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.001, block_size=32, precision="refine")
+    g, node, data = stream_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert out.get_operator(node).estimator.block_size == 512  # untouched
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_override_cleared_even_when_rule_disabled(tmp_path, monkeypatch):
+    """Flipping KEYSTONE_MEASURED_KNOBS off (or disabling the store) must
+    not preserve a previously-installed measured precision."""
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "off")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    linalg.set_solver_mode_override("default")
+    g, node, data = stream_graph()
+    try:
+        MeasuredKnobRule(profile_store=store(tmp_path)).apply(g, {})
+        assert linalg.solver_mode() == "refine"
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_disagreeing_widths_block_solver_overrides(tmp_path, monkeypatch):
+    """Absolute walls from different feature widths are incommensurable:
+    when the widths in a rows bucket disagree on the winner, neither
+    block size nor precision is overridden."""
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    st = store(tmp_path)
+    # d=8: tiny problem, tiny wall, block 16 / precision default
+    st.record("solver:block_ls:bs16:precdefault",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.001, block_size=16, precision="default")
+    # d=4096: real problem, its own winner is block 512 / refine
+    st.record("solver:block_ls:bs512:precrefine",
+              shape_class(N_ROWS, (4096,), "float32"),
+              wall_s=2.0, block_size=512, precision="refine")
+    g, node, data = stream_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert out.get_operator(node).estimator.block_size == 512  # untouched
+        assert out.get_operator(node).solver_precision is None  # no pin
+        assert linalg.solver_mode() == "refine"  # no override installed
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_solver_block_env_pins_block_size(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.setenv("KEYSTONE_SOLVER_BLOCK", "keep")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    from keystone_tpu.parallel import linalg
+
+    st = store(tmp_path)
+    st.record("solver:block_ls:bs128:precrefine",
+              shape_class(N_ROWS, (8,), "float32"),
+              wall_s=0.05, block_size=128, precision="refine")
+    g, node, data = stream_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert out.get_operator(node).estimator.block_size == 512
+    finally:
+        linalg.set_solver_mode_override(None)
