@@ -1099,6 +1099,98 @@ def _bench_serving(small: bool) -> dict:
     return out
 
 
+def _bench_serving_multiworker(small: bool) -> dict:
+    """Supervised multi-worker serving (docs/SERVING.md): the offered-
+    load sweep pushed through :class:`WorkerSupervisor` at 1 then 2 REAL
+    worker processes sharing this run's persistent XLA cache, with a
+    deterministic SIGKILL of worker 0 mid-sweep on the 2-worker leg
+    (``KEYSTONE_FAULT_SPECS_WORKER_0`` at its 10th request). Headlines:
+    per-fleet throughput and worst-worker p99, plus the chaos invariants
+    bench-diff gates exactly — zero dropped requests and zero steady-
+    state compiles once the restarted worker re-warms from the shared
+    cache. The requeued count is reported (>=1 proves the kill stranded
+    in-flight work) but not exact-gated: how much was in flight at kill
+    time is scheduler timing, not a pinned invariant."""
+    from keystone_tpu.reliability.retry import RetryPolicy
+    from keystone_tpu.serving.supervisor import (
+        FAULT_SPECS_WORKER_ENV,
+        SupervisorConfig,
+        WorkerSupervisor,
+    )
+
+    d = 8 if small else 32
+    n_load = 96 if small else 384
+    kill_at = 10
+    out: dict = {"d": d, "requests": n_load, "kill_at_request": kill_at}
+
+    def sweep(workers: int, chaos_env: dict | None = None):
+        sup = WorkerSupervisor(
+            {"synthetic": {"d": d, "seed": 0}},
+            SupervisorConfig(
+                workers=workers,
+                heartbeat_s=0.2,
+                hang_timeout_s=15.0,
+                ready_timeout_s=240.0,
+                max_batch=8,
+                # Queues sized to the burst at BOTH levels (as the in-
+                # process serving leg does): the figure is throughput,
+                # not shed accounting, so nothing may overflow.
+                queue_depth=n_load + 64,
+                worker_queue_depth=n_load + 32,
+                restart_policy=RetryPolicy(
+                    max_attempts=4, base_delay_s=0.2, max_delay_s=2.0
+                ),
+            ),
+            env=chaos_env,
+        ).start()
+        try:
+            sup.wait_ready()
+            payloads = [[float(i % 7)] * d for i in range(n_load)]
+            t0 = time.perf_counter()
+            futures = sup.submit_many(payloads, deadline_s=180.0)
+            errors = sum(
+                1 for f in futures if f.exception(timeout=240) is not None
+            )
+            wall = time.perf_counter() - t0
+            time.sleep(0.5)  # one beat: final worker stats reach the sup
+            stats = sup.stats()
+        finally:
+            sup.stop()
+        return wall, errors, stats
+
+    # Leg 1 — one worker, no chaos: the per-process throughput floor.
+    wall, errors, stats = sweep(1)
+    out["one_worker_rps"] = round((n_load - errors) / wall, 1)
+    out["one_worker_p99_ms"] = stats.get("p99_ms")
+    out["one_worker_dropped"] = errors
+
+    # Leg 2 — two workers, worker 0 SIGKILLed mid-sweep. The chaos arms
+    # the first incarnation only (supervisor contract), so the restart
+    # comes up clean and finishes the sweep.
+    chaos = {
+        FAULT_SPECS_WORKER_ENV + "0": json.dumps(
+            [{"match": "serving.worker.request", "kind": "kill",
+              "calls": [kill_at]}]
+        )
+    }
+    wall, errors, stats = sweep(2, chaos_env=chaos)
+    out["two_worker_kill_rps"] = round((n_load - errors) / wall, 1)
+    out["two_worker_p99_ms"] = stats.get("p99_ms")
+    out["dropped"] = errors
+    out["requeued"] = stats["supervisor"]["requeued"]
+    out["worker_restarts"] = stats["supervisor"]["restarts"]
+    steady = [
+        w["stats"].get("xla_compiles_since_warmup")
+        for w in stats["workers"].values()
+        if isinstance(w["stats"].get("xla_compiles_since_warmup"), (int, float))
+    ]
+    out["compiles_steady_state"] = int(max(steady)) if steady else None
+    out["throughput_vs_one_worker"] = round(
+        out["two_worker_kill_rps"] / max(out["one_worker_rps"], 1e-9), 2
+    )
+    return out
+
+
 def _bench_fusion(small: bool) -> dict:
     """Whole-pipeline fusion (docs/OPTIMIZER.md): an 8-node dense chain
     applied through a FittedPipeline both fused (ONE XLA dispatch per
@@ -1287,6 +1379,7 @@ def _workload_registry() -> dict:
         "fusion": _bench_fusion,
         "streaming": _bench_streaming,
         "serving": _bench_serving,
+        "serving_multiworker": _bench_serving_multiworker,
         "ingest": _bench_ingest,
         "imagenet_fv": _bench_imagenet_fv,
         "imagenet_native": _bench_imagenet_native,

@@ -9,9 +9,10 @@ compares every shared leg with *noise-aware* rules and emits a verdict
 Comparison rules, per flattened leg key:
 
 - **counts** (``*dispatches*``, ``compiles_first_chunk``,
-  ``compiles_steady_state``, ``chunks``) are compared **exactly** — a
-  fused chain that suddenly dispatches twice, or a steady-state compile
-  appearing, is a structural regression no tolerance should forgive.
+  ``compiles_steady_state``, ``chunks``, ``*dropped*``) are compared
+  **exactly** — a fused chain that suddenly dispatches twice, a steady-
+  state compile appearing, or a serving leg dropping a request under
+  chaos is a structural regression no tolerance should forgive.
 - **timings** (``*_ms``, ``*_s``, ``*_seconds``) are compared as ratios
   with a configurable tolerance (default ±50% — CI machines are noisy)
   and an absolute floor (default 50 ms — jitter on a 3 ms leg is not a
@@ -56,6 +57,7 @@ _CONFIG_KEYS = {
 _EXACT_SUBSTRINGS = (
     "dispatches", "compiles_first_chunk", "compiles_steady_state",
     "bytes_transferred",  # deterministic for a pinned dataset + dtype plan
+    "dropped",  # serving chaos invariant: a dropped request is never OK
 )
 _SKIP_SUBSTRINGS = (
     # Environment-dependent measurements no two runs share: compile
@@ -239,6 +241,15 @@ def compare_leg(
                 }
             continue
         if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+            # An exact-gated invariant must not evaporate when the value
+            # degrades to None/non-numeric — that happens precisely when
+            # the measured path is broken (e.g. compiles_steady_state is
+            # None because no worker stats flowed), the one run the gate
+            # exists to catch.
+            if kind == "exact" and b != c:
+                checks.append({"key": key, "kind": "exact", "base": b,
+                               "current": c, "verdict": "regression"})
+                regressions += 1
             continue
         if kind == "exact":
             verdict = "ok" if b == c else "regression"
@@ -269,6 +280,21 @@ def compare_leg(
             checks.append({"key": key, "kind": "timing", "base": b,
                            "current": c, "ratio": round(ratio, 3),
                            "verdict": verdict})
+    for key in sorted(set(fb) - set(fc)):
+        # Same rule for an invariant that DISAPPEARED from the current
+        # run: a renamed or no-longer-measured exact key — or a bool
+        # invariant that held true in the baseline (overlap_ok) — fails
+        # loudly instead of silently un-gating itself.
+        b = fb[key]
+        kind = _classify(key)
+        if kind == "exact":
+            checks.append({"key": key, "kind": "exact", "base": b,
+                           "current": None, "verdict": "regression"})
+            regressions += 1
+        elif kind != "skip" and isinstance(b, bool) and b:
+            checks.append({"key": key, "kind": "bool", "base": b,
+                           "current": None, "verdict": "regression"})
+            regressions += 1
     status = "ok"
     if regressions:
         status = "regression"

@@ -90,6 +90,16 @@ SERVING_LATENCY_SECONDS = "keystone_serving_latency_seconds"
 SERVING_QUEUE_WAIT_SECONDS = "keystone_serving_queue_wait_seconds"
 SERVING_BATCH_OCCUPANCY = "keystone_serving_batch_occupancy"
 
+# ------------------------------------------------- multi-worker serving / SLO
+SERVING_WORKER_RESTARTS = "keystone_serving_worker_restarts_total"
+SERVING_WORKER_REQUEUED = "keystone_serving_requeued_requests_total"
+SERVING_WORKERS_ALIVE = "keystone_serving_workers_alive"
+SERVING_WORKER_HEARTBEATS = "keystone_serving_worker_heartbeats_total"
+SERVING_SLO_P99_MS = "keystone_serving_slo_p99_ms"
+SERVING_SLO_TARGET_MS = "keystone_serving_slo_target_ms"
+SERVING_SLO_RUNG = "keystone_serving_slo_rung"
+SERVING_SLO_TRANSITIONS = "keystone_serving_slo_transitions_total"
+
 # ---------------------------------------------------------------------- memory
 MEMORY_IN_USE_BYTES = "keystone_memory_in_use_bytes"
 PEAK_MEMORY_BYTES = "keystone_peak_memory_bytes"
@@ -149,6 +159,14 @@ SCHEMA: Dict[str, Tuple] = {
     SERVING_LATENCY_SECONDS: ("histogram", "End-to-end request latency", ()),
     SERVING_QUEUE_WAIT_SECONDS: ("histogram", "Submit-to-apply queue wait", ()),
     SERVING_BATCH_OCCUPANCY: ("histogram", "Batch size / max_batch", (), "ratio"),
+    SERVING_WORKER_RESTARTS: ("counter", "Worker processes restarted by the supervisor", ("reason",)),
+    SERVING_WORKER_REQUEUED: ("counter", "In-flight requests requeued off a dead worker", ()),
+    SERVING_WORKERS_ALIVE: ("gauge", "Worker processes currently serving", ()),
+    SERVING_WORKER_HEARTBEATS: ("counter", "Worker heartbeats received by the supervisor", ("status",)),
+    SERVING_SLO_P99_MS: ("gauge", "Observed serving p99 latency, per worker and aggregate", ("worker",)),
+    SERVING_SLO_TARGET_MS: ("gauge", "SLO controller p99 target", ()),
+    SERVING_SLO_RUNG: ("gauge", "Admission ladder rung index pinned by the SLO controller", ()),
+    SERVING_SLO_TRANSITIONS: ("counter", "SLO-driven admission ladder transitions", ("direction",)),
     MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source", "device")),
     PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage", "device")),
 }

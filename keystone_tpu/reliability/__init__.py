@@ -32,7 +32,17 @@ from .errors import (
     classify_error,
     is_oom,
 )
-from .faultinject import FaultInjector, FaultSpec, InjectedOOM, InjectedTransient, injected, probe
+from .faultinject import (
+    FaultInjector,
+    FaultSpec,
+    InjectedOOM,
+    InjectedTransient,
+    injected,
+    install_from_env,
+    probe,
+    specs_from_env,
+    specs_to_env,
+)
 from .recovery import RecoveryLog, get_recovery_log, reset_recovery_log
 from .retry import Deadline, RetryPolicy, run_with_deadline, wait_until
 
@@ -56,10 +66,13 @@ __all__ = [
     "get_recovery_log",
     "halving_rungs",
     "injected",
+    "install_from_env",
     "is_oom",
     "prefix_digest",
     "probe",
     "reset_recovery_log",
     "run_with_deadline",
+    "specs_from_env",
+    "specs_to_env",
     "wait_until",
 ]

@@ -17,15 +17,34 @@ Faults are deterministic: specs name exact 1-based call numbers (or a
 ``first_n`` prefix) per matched label, and the injector counts calls —
 including retried ones, which is exactly what lets a test say "fail the
 first two attempts, succeed on the third".
+
+Process-level chaos (docs/RELIABILITY.md, docs/SERVING.md): the
+``kill`` kind SIGKILLs the *current process* at a probed call — from
+inside a serving worker that is a real ``kill -9`` mid-load, the crash
+the :class:`~keystone_tpu.serving.supervisor.WorkerSupervisor` must
+survive. Because the injector is per-process, specs cross the
+supervisor → worker boundary through the environment:
+:func:`specs_to_env` serializes a spec list to JSON and
+:func:`install_from_env` (called by the worker at startup) installs a
+process-lifetime injector from ``KEYSTONE_FAULT_SPECS``. Env-carried
+specs can't ship a ``corrupt`` callable; the default corruption garbles
+strings into non-JSON bytes, which at the worker's heartbeat site is
+exactly the wire corruption the supervisor has to treat as a dead
+heartbeat.
 """
 
 from __future__ import annotations
 
+import json
+import os
+import signal
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FAULT_SPECS_ENV = "KEYSTONE_FAULT_SPECS"
 
 from .recovery import get_recovery_log
 
@@ -51,14 +70,18 @@ class FaultSpec:
     """What to inject, where, and on which calls.
 
     ``match``   — substring of the node label / probe site ("*" = every site).
-    ``kind``    — "oom" | "transient" | "hang" | "corrupt".
+    ``kind``    — "oom" | "transient" | "hang" | "corrupt" | "kill".
     ``calls``   — exact 1-based call numbers to fault at.
     ``first_n`` — alternative to ``calls``: fault calls 1..first_n.
     ``hang_s``  — sleep length for kind="hang" (pair with a policy whose
-                  ``deadline_s`` is shorter to exercise the watchdog).
+                  ``deadline_s`` is shorter to exercise the watchdog; at a
+                  worker's apply site a long hang IS the straggler fault).
     ``corrupt`` — value transform for kind="corrupt" (default NaN-fills
                   array leaves, the shape-preserving corruption an XLA
-                  consumer actually notices).
+                  consumer actually notices; strings garble into non-JSON
+                  bytes — the heartbeat-corruption fault).
+    ``kind="kill"`` SIGKILLs the current process — un-catchable, exactly
+    a ``kill -9`` of a serving worker mid-load.
     """
 
     match: str
@@ -77,6 +100,12 @@ class FaultSpec:
 
 
 def _nan_corrupt(value: Any) -> Any:
+    # Strings garble into bytes that cannot parse as JSON (or decode as
+    # UTF-8 text cleanly) — wire-level corruption for line protocols like
+    # the serving worker's heartbeat channel.
+    if isinstance(value, str):
+        return "\x00garbled\x00" + value[::-1][: max(len(value) // 2, 1)]
+
     import numpy as np
 
     # Dataset-like wrappers (ArrayDataset & friends): poison the payload,
@@ -139,6 +168,18 @@ class FaultInjector:
             if spec.kind == "hang":
                 self._sleep(spec.hang_s)
                 return
+            if spec.kind == "kill":
+                # Flush whatever this process has said so far — the
+                # supervisor's reader must see everything emitted BEFORE
+                # the kill, and nothing after.
+                import sys
+
+                for stream in (sys.stdout, sys.stderr):
+                    try:
+                        stream.flush()
+                    except Exception:
+                        pass
+                os.kill(os.getpid(), signal.SIGKILL)
             raise ValueError(f"unknown fault kind {spec.kind!r}")
 
     def wrap(self, label: str, thunk: Callable[[], Any]) -> Callable[[], Any]:
@@ -184,3 +225,44 @@ def injected(*specs: FaultSpec, sleep: Callable[[float], None] = time.sleep):
         yield injector
     finally:
         _current = None
+
+
+# ------------------------------------------------------- cross-process specs
+
+_ENV_FIELDS = ("match", "kind", "calls", "first_n", "hang_s")
+
+
+def specs_to_env(specs: Tuple[FaultSpec, ...]) -> str:
+    """Serialize specs for a child process's ``KEYSTONE_FAULT_SPECS``.
+    ``corrupt`` callables don't cross the boundary — env-carried corrupt
+    specs use the default corruption (NaN arrays / garbled strings)."""
+    return json.dumps(
+        [
+            {k: getattr(s, k) for k in _ENV_FIELDS if getattr(s, k) is not None}
+            for s in specs
+        ]
+    )
+
+
+def specs_from_env(value: str) -> List[FaultSpec]:
+    out = []
+    for obj in json.loads(value):
+        if "calls" in obj:
+            obj["calls"] = tuple(int(c) for c in obj["calls"])
+        out.append(FaultSpec(**obj))
+    return out
+
+
+def install_from_env(env_var: str = FAULT_SPECS_ENV) -> Optional[FaultInjector]:
+    """Install a process-LIFETIME injector from the environment (no
+    context manager — the process is the scope). Called by worker-process
+    entry points before serving; a no-op when the variable is unset/empty
+    or an injector is already active. Chaos-in-env is how the supervisor
+    arms faults inside the worker it spawns."""
+    global _current
+    raw = os.environ.get(env_var, "").strip()
+    if not raw or _current is not None:
+        return None
+    injector = FaultInjector(*specs_from_env(raw))
+    _current = injector
+    return injector

@@ -127,12 +127,27 @@ class RetryPolicy:
             delay *= 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
         return max(delay, 0.0)
 
-    def call(self, fn: Callable[..., Any], *args: Any, label: str = None, **kwargs: Any) -> Any:
+    def call(
+        self,
+        fn: Callable[..., Any],
+        *args: Any,
+        label: str = None,
+        deadline: Optional[Deadline] = None,
+        **kwargs: Any,
+    ) -> Any:
         """Invoke ``fn(*args, **kwargs)`` under this policy.
 
         Each attempt runs under ``deadline_s`` (when set). A failure is
         classified; classes outside ``retry_on`` — and the final attempt —
         re-raise unchanged. Retries are recorded in the recovery log.
+
+        ``deadline`` bounds the WHOLE retry loop by the caller's budget:
+        once backing off + retrying cannot finish inside what remains of
+        the deadline, the last error re-raises instead of retrying past
+        it (a serving request's retry clock must never outlive the
+        request — docs/SERVING.md). The retry budget and the per-attempt
+        ``deadline_s`` watchdog compose: one bounds attempts, the other
+        bounds the loop.
         """
         label = label or getattr(fn, "__name__", "call")
         rng = random.Random(self.seed)
@@ -148,6 +163,16 @@ class RetryPolicy:
                 if error_class not in self.retry_on or attempt >= self.max_attempts:
                     raise
                 delay = self._delay(attempt - 1, rng)
+                if deadline is not None and deadline.remaining() <= delay:
+                    get_recovery_log().record(
+                        "retry_abandoned",
+                        label,
+                        attempt=attempt,
+                        error_class=error_class.value,
+                        remaining_s=round(max(deadline.remaining(), 0.0), 4),
+                        delay_s=round(delay, 4),
+                    )
+                    raise
                 get_recovery_log().record(
                     "retry",
                     label,

@@ -18,6 +18,15 @@ investments into one subsystem:
                       bucket-warmth hit rate, shed/timeout counters.
 - :mod:`server`     — the threaded front-end: ``submit``/``submit_many``
                       plus the ``keystone-tpu serve`` stdin/JSON CLI.
+- :mod:`worker`     — one server behind a JSON-lines control pipe: the
+                      worker-process side of the multi-worker runtime.
+- :mod:`supervisor` — N worker processes with heartbeat monitoring,
+                      backoff restarts, and in-flight requeue (a SIGKILL
+                      mid-batch drops zero requests).
+- :mod:`slo`        — drives the admission ladder from observed p99 vs
+                      target instead of queue depth.
+- :mod:`frontend`   — stdlib HTTP JSON front door over the supervisor;
+                      the stdin CLI is just another client.
 - :mod:`synthetic`  — synthetic fitted pipelines for bench/smoke tests
                       (imports jax; resolved lazily below).
 
@@ -30,6 +39,9 @@ See docs/SERVING.md for architecture and knobs.
 
 from .admission import DEFAULT_RUNGS, AdmissionController, AdmissionRung
 from .batcher import MicroBatcher
+from .frontend import ServingFrontend
+from .slo import SLO_RUNGS, SLOController
+from .supervisor import HashRing, SupervisorConfig, WorkerSupervisor
 from .config import (
     Request,
     RequestShed,
@@ -55,7 +67,13 @@ __all__ = [
     "AdmissionController",
     "AdmissionRung",
     "DEFAULT_RUNGS",
+    "HashRing",
     "MicroBatcher",
+    "SLOController",
+    "SLO_RUNGS",
+    "ServingFrontend",
+    "SupervisorConfig",
+    "WorkerSupervisor",
     "ModelEntry",
     "ModelRegistry",
     "PipelineServer",

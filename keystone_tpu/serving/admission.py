@@ -22,6 +22,20 @@ degradation lands one ``degrade`` event in the recovery ledger exactly
 like a solver shrinking its block size — bounded log growth even under a
 shed storm, and ``summary()["degradations"]`` counts service-level drops
 across training and serving alike.
+
+Two transition drivers share this controller:
+
+- **depth mode** (default, the in-process server): each ``admit`` walks
+  the rung whose ``queue_frac`` bound the current depth satisfies —
+  queue depth IS the overload signal.
+- **external mode** (the multi-worker supervisor): rung transitions come
+  only from :meth:`force_rung` — the
+  :class:`~keystone_tpu.serving.slo.SLOController` pins the rung from
+  *observed p99 vs target*, and ``admit`` just enforces the pinned
+  rung's depth bound. Rungs then read inverted: the normal rung admits
+  to the full bound and degraded rungs admit to SHRINKING fractions
+  (shedding earlier is how a latency SLO is defended — see
+  ``slo.SLO_RUNGS``).
 """
 
 from __future__ import annotations
@@ -63,12 +77,18 @@ class AdmissionController:
         capacity: int,
         rungs: Sequence[AdmissionRung] = DEFAULT_RUNGS,
         label: str = "serving-admission",
+        external: bool = False,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         fracs = [r.queue_frac for r in rungs]
-        if fracs != sorted(fracs):
+        if not external and fracs != sorted(fracs):
+            # Depth mode searches rungs shallow→deep, which only makes
+            # sense for non-decreasing bounds; externally-driven rungs
+            # are pinned by index, so any monotonicity (slo.SLO_RUNGS
+            # shrinks) is legal.
             raise ValueError("rung queue_fracs must be non-decreasing")
+        self.external = external
         self.capacity = capacity
         self.rungs: List[AdmissionRung] = list(rungs)
         self.label = label
@@ -97,6 +117,20 @@ class AdmissionController:
         """Admit a request at queue depth ``depth`` or raise
         :class:`RequestShed`. Returns the service-level rung in effect."""
         with self._lock:
+            if self.external:
+                # Externally-pinned rung (SLOController): enforce its
+                # bound, never walk. The rung only changes via force_rung.
+                rung = self.rungs[self._rung_index]
+                if depth >= rung.queue_frac * self.capacity:
+                    self.sheds += 1
+                    self.consecutive_sheds += 1
+                    raise RequestShed(
+                        f"depth {depth} >= {rung.queue_frac:g}x{self.capacity} "
+                        f"at SLO rung {rung.name!r}"
+                    )
+                self.admitted += 1
+                self.consecutive_sheds = 0
+                return rung
             index = self._match_index(depth)
             if index is None:
                 self.sheds += 1
@@ -123,6 +157,22 @@ class AdmissionController:
             self.admitted += 1
             self.consecutive_sheds = 0
             return self.rungs[self._rung_index]
+
+    def force_rung(self, index: int) -> Optional[int]:
+        """Pin the service level to ``index`` (external drivers — the SLO
+        controller). Returns the PREVIOUS index, or None when already
+        there. Ledger/metric accounting for the transition belongs to
+        the driver, which knows WHY it moved."""
+        if not 0 <= index < len(self.rungs):
+            raise ValueError(
+                f"rung index {index} out of range 0..{len(self.rungs) - 1}"
+            )
+        with self._lock:
+            previous = self._rung_index
+            if previous == index:
+                return None
+            self._rung_index = index
+            return previous
 
     # -------------------------------------------------------------- observers
     @property

@@ -53,6 +53,49 @@ class UnknownModel(ServingError):
         super().__init__(f"no model {name!r} in registry (known: {sorted(known)})")
 
 
+def parse_stdin_request(
+    obj: Any, default_deadline_s: Optional[float] = None
+) -> Tuple[Any, Any, Optional[float], Optional[str], Optional[str]]:
+    """One decoded stdin/JSON request line (dict or bare array) →
+    ``(request_id, x, deadline_s, key, model)`` — the one parser behind
+    both serve doors (single-worker ``serve_from_args`` and the
+    multiworker front-end), so the contract can't drift between them.
+    ``deadline_ms`` is ``is not None``-checked, never truthiness: 0 is an
+    exhausted budget that must time out, not fall through to the default.
+    Raises ValueError on a malformed ``deadline_ms``."""
+    if not isinstance(obj, dict):
+        return None, obj, default_deadline_s, None, None
+    raw_deadline = obj.get("deadline_ms")
+    if raw_deadline is None:
+        deadline_s = default_deadline_s
+    else:
+        try:
+            deadline_s = float(raw_deadline) / 1e3
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"deadline_ms must be a number, got {raw_deadline!r}"
+            ) from None
+    key = str(obj["key"]) if "key" in obj else None
+    return obj.get("id"), obj.get("x"), deadline_s, key, obj.get("model")
+
+
+def settle_result(future: Future, value: Any) -> None:
+    """set_result tolerating an already-settled future (a request can be
+    raced by shutdown settling — exactly one outcome wins, never a crash
+    in the worker)."""
+    try:
+        future.set_result(value)
+    except Exception:
+        pass
+
+
+def settle_exception(future: Future, exc: Exception) -> None:
+    try:
+        future.set_exception(exc)
+    except Exception:
+        pass
+
+
 def default_bucket_sizes(max_batch: int) -> Tuple[int, ...]:
     """Powers of two up to (and including) ``max_batch``: the static batch
     shapes the apply path compiles for. A partial batch pads up to the

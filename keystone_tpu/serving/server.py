@@ -43,28 +43,14 @@ from .config import (
     ServingConfig,
     ServingError,
     bucket_for,
+    parse_stdin_request,
+    settle_exception as _settle_exception,
+    settle_result as _settle_result,
 )
 from .registry import ModelEntry, ModelRegistry
 from .telemetry import ServingTelemetry
 
 logger = logging.getLogger("keystone_tpu.serving")
-
-
-def _settle_result(future: Future, value: Any) -> None:
-    """set_result tolerating an already-settled future (a request can be
-    raced by shutdown settling — exactly one outcome wins, never a crash
-    in the worker)."""
-    try:
-        future.set_result(value)
-    except Exception:
-        pass
-
-
-def _settle_exception(future: Future, exc: Exception) -> None:
-    try:
-        future.set_exception(exc)
-    except Exception:
-        pass
 
 
 class PipelineServer:
@@ -280,7 +266,18 @@ class PipelineServer:
         ):
             try:
                 entry = self.registry.resolve(model_name)
-                rows = self._apply_padded(entry, [r.payload for r in group])
+                # The tightest member deadline bounds the retry loop:
+                # backing off past it would spend budget no member has
+                # left (satellite contract — the retry clock and the
+                # request deadline are one clock, docs/SERVING.md).
+                deadlines = [r.deadline for r in group if r.deadline is not None]
+                group_deadline = (
+                    min(deadlines, key=lambda d: d.remaining())
+                    if deadlines else None
+                )
+                rows = self._apply_padded(
+                    entry, [r.payload for r in group], deadline=group_deadline
+                )
             except Exception as exc:
                 self.telemetry.record_failure(len(group))
                 for req in group:
@@ -323,7 +320,9 @@ class PipelineServer:
                 queue_wait_s=t_apply - req.enqueued_at,
             )
 
-    def _apply_padded(self, entry: ModelEntry, payloads: List[Any]) -> List[Any]:
+    def _apply_padded(
+        self, entry: ModelEntry, payloads: List[Any], deadline: Any = None
+    ) -> List[Any]:
         """Stack payloads, zero-pad to the nearest bucket, apply with
         retries, slice the real rows back out (host-side)."""
         import jax
@@ -355,7 +354,11 @@ class PipelineServer:
         policy = self.config.retry_policy
         try:
             if policy is not None:
-                out = policy.call(attempt, label=f"serving.apply:{entry.name}")
+                out = policy.call(
+                    attempt,
+                    label=f"serving.apply:{entry.name}",
+                    deadline=deadline,
+                )
             else:
                 out = attempt()
         finally:
@@ -402,12 +405,34 @@ def add_serve_arguments(parser) -> None:
                         help="default per-request deadline")
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip AOT bucket warmup before serving")
+    parser.add_argument(
+        "--workers", type=int, default=1,
+        help="worker PROCESSES; >1 runs the supervised multi-worker "
+             "runtime (docs/SERVING.md), 1 keeps the in-process server",
+    )
+    parser.add_argument(
+        "--listen", default=None, metavar="HOST:PORT",
+        help="also serve the HTTP JSON front-end (stdin stays a client)",
+    )
+    parser.add_argument(
+        "--slo-p99-ms", type=float, default=None,
+        help="enable the SLO controller: drive admission from observed "
+             "p99 against this target (multi-worker path)",
+    )
 
 
 def serve_from_args(args) -> int:
     """Run the stdin/JSON front-end: one request per line
     (``{"id": ..., "x": [...]}`` or a bare array), one response line per
     request as it completes, then a final ``SERVE_STATS:{...}`` line."""
+    if args.workers > 1 or args.listen:
+        # The supervised out-of-process runtime: N worker processes, a
+        # crash-recovering supervisor, optional HTTP front-end. The
+        # single-worker in-process path below stays the default.
+        from .frontend import serve_multiworker_from_args
+
+        return serve_multiworker_from_args(args)
+
     import numpy as np
 
     from ..reliability.retry import RetryPolicy
@@ -478,11 +503,11 @@ def serve_from_args(args) -> int:
         except json.JSONDecodeError as exc:
             emit({"error": f"bad request line: {exc}"})
             continue
-        if isinstance(obj, dict):
-            request_id, x = obj.get("id"), obj.get("x")
-            deadline_s = (obj["deadline_ms"] / 1e3) if obj.get("deadline_ms") else None
-        else:
-            request_id, x, deadline_s = None, obj, None
+        try:
+            request_id, x, deadline_s, _, model = parse_stdin_request(obj)
+        except ValueError as exc:
+            emit({"id": obj.get("id"), "error": str(exc)})
+            continue
         try:
             payload = np.asarray(x, np.float32)
             if x is None or payload.ndim == 0:
@@ -497,7 +522,7 @@ def serve_from_args(args) -> int:
             warmed = True
         t0 = time.monotonic()
         try:
-            future = server.submit(payload, deadline_s=deadline_s)
+            future = server.submit(payload, deadline_s=deadline_s, model=model)
         except (RequestShed, RequestTimeout, ServerClosed) as exc:
             emit({"id": request_id, "error": f"{type(exc).__name__}: {exc}"})
             continue

@@ -1,0 +1,118 @@
+#!/usr/bin/env bash
+# Multi-worker chaos smoke: run `keystone-tpu serve --workers 2` on CPU,
+# SIGKILL worker 0 mid-load (deterministic kill spec via
+# KEYSTONE_FAULT_SPECS_WORKER_0), and assert the supervisor invariants:
+#
+#   - ZERO dropped requests (every request answered, no errors)
+#   - the killed worker's in-flight work was requeued (requeued >= 1)
+#   - the restart lands within the backoff budget (polled over the HTTP
+#     front-end's /stats while the sweep is still running)
+#   - worker_crash + worker_restart events appear in the recovery ledger
+#     (carried on the SERVE_STATS line)
+#   - surviving + restarted workers serve at zero steady-state compiles
+#
+# This is the CI face of the invariant tests/serving/test_multiworker_e2e.py
+# pins in-process. docs/SERVING.md documents the failure matrix.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+timeout -k 10 280 python - <<'EOF'
+import json, os, subprocess, sys, time, threading, urllib.request
+
+D = 8
+KILL_AT = 12          # worker 0's 12th request: mid-load, deterministically
+N_MAIN, N_POST = 120, 20
+RESTART_BUDGET_S = 6.5 + 90.0  # backoff schedule sum (default policy) + spawn slack
+
+env = dict(
+    os.environ,
+    JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+    KEYSTONE_FAULT_SPECS_WORKER_0=json.dumps(
+        [{"match": "serving.worker.request", "kind": "kill", "calls": [KILL_AT]}]
+    ),
+)
+proc = subprocess.Popen(
+    [sys.executable, "-m", "keystone_tpu", "serve",
+     "--synthetic", str(D), "--workers", "2", "--max-batch", "4",
+     "--listen", "127.0.0.1:0"],
+    stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    text=True, bufsize=1, env=env,
+)
+
+# The front-end prints SERVE_LISTEN:<host>:<port> on stderr once bound.
+port_box, stderr_tail = [], []
+def read_stderr():
+    for line in proc.stderr:
+        stderr_tail.append(line.rstrip())
+        if line.startswith("SERVE_LISTEN:"):
+            port_box.append(int(line.strip().rsplit(":", 1)[1]))
+threading.Thread(target=read_stderr, daemon=True).start()
+
+deadline = time.monotonic() + 240
+while not port_box:
+    assert proc.poll() is None, "server died during startup:\n" + "\n".join(stderr_tail[-20:])
+    assert time.monotonic() < deadline, "no SERVE_LISTEN within 240s"
+    time.sleep(0.1)
+port = port_box[0]
+
+def http_stats():
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/stats", timeout=10) as r:
+        return json.loads(r.read())
+
+# Main sweep, gently paced so the kill strikes with work in flight.
+for i in range(N_MAIN):
+    proc.stdin.write(json.dumps({"id": i, "x": [float(i % 7)] * D,
+                                 "deadline_ms": 120000}) + "\n")
+    proc.stdin.flush()
+    time.sleep(0.01)
+
+# Restart must land within the backoff budget: poll /stats for worker 0
+# back at ready on its next incarnation.
+t0 = time.monotonic()
+while True:
+    stats = http_stats()
+    w0 = stats["workers"]["0"]
+    if w0["state"] == "ready" and w0["incarnation"] >= 1:
+        restart_wait = time.monotonic() - t0
+        break
+    assert time.monotonic() - t0 < RESTART_BUDGET_S, (
+        f"worker 0 not restarted within {RESTART_BUDGET_S}s: {w0}")
+    time.sleep(0.25)
+
+# Post-restart traffic proves the recycled worker serves.
+for i in range(N_MAIN, N_MAIN + N_POST):
+    proc.stdin.write(json.dumps({"id": i, "x": [1.0] * D,
+                                 "deadline_ms": 120000}) + "\n")
+    proc.stdin.flush()
+    time.sleep(0.01)
+proc.stdin.close()
+out = proc.stdout.read()  # stderr is drained by the reader thread
+assert proc.wait(timeout=240) == 0, "\n".join(stderr_tail[-20:])
+
+lines = [l for l in out.splitlines() if l.strip()]
+stats_lines = [l for l in lines if l.startswith("SERVE_STATS:")]
+assert len(stats_lines) == 1, f"expected one stats line, got {len(stats_lines)}"
+stats = json.loads(stats_lines[0][len("SERVE_STATS:"):])
+responses = [json.loads(l) for l in lines if not l.startswith("SERVE_STATS:")]
+
+n = N_MAIN + N_POST
+errors = [r for r in responses if "error" in r]
+assert not errors, f"{len(errors)} errored responses, first: {errors[0]}"
+assert len(responses) == n, f"DROPPED: {n - len(responses)} of {n} requests unanswered"
+assert {r["id"] for r in responses} == set(range(n)), "response ids incomplete"
+
+sup = stats["supervisor"]
+assert sup["restarts"] >= 1, sup
+assert sup["requeued"] >= 1, f"kill stranded nothing: {sup}"
+kinds = {e["kind"] for e in stats["recovery"]["events"]}
+assert "worker_crash" in kinds and "worker_restart" in kinds, kinds
+for wid, w in stats["workers"].items():
+    compiles = w["stats"].get("xla_compiles_since_warmup")
+    assert compiles == 0, f"worker {wid} compiled in steady state: {compiles}"
+
+print(f"serve_chaos_smoke OK: {n} requests, 0 dropped, "
+      f"requeued={sup['requeued']}, restarts={sup['restarts']}, "
+      f"restart_wait={restart_wait:.1f}s, steady-state compiles=0")
+EOF

@@ -248,3 +248,93 @@ def test_auto_discovered_one_sided_leg_still_skips():
     assert verdict["ok"]
     assert verdict["legs"]["fusion"]["status"] == "skipped"
     assert verdict["legs"]["serving"]["status"] == "skipped"
+
+
+# ------------------------------------------------- serving_multiworker leg
+
+
+def mw_leg(**kw):
+    base = {
+        "d": 8, "requests": 96, "kill_at_request": 10,
+        "one_worker_rps": 2700.0, "one_worker_p99_ms": 25.0,
+        "one_worker_dropped": 0,
+        "two_worker_kill_rps": 1000.0, "two_worker_p99_ms": 12.0,
+        "dropped": 0, "requeued": 48, "worker_restarts": 1,
+        "compiles_steady_state": 0, "throughput_vs_one_worker": 0.37,
+    }
+    base.update(kw)
+    return base
+
+
+def test_dropped_request_counts_compared_exactly():
+    """The chaos invariant: ONE dropped request under the mid-sweep kill
+    is a regression no tolerance forgives — on either sweep."""
+    for key in ("dropped", "one_worker_dropped"):
+        v = diff(mw_leg(), mw_leg(**{key: 1}))
+        assert not v["ok"], key
+        bad = [c for c in v["legs"]["timit"]["checks"]
+               if c["verdict"] == "regression"]
+        assert bad and bad[0]["key"] == key and bad[0]["kind"] == "exact"
+    # a steady-state compile appearing after the restart is equally exact
+    assert not diff(mw_leg(), mw_leg(compiles_steady_state=2))["ok"]
+
+
+def test_exact_key_degrading_to_none_is_a_regression_not_a_skip():
+    """compiles_steady_state=None happens precisely when the measured
+    path is broken (no worker stats flowed) — the exact gate must fire,
+    not silently evaporate."""
+    v = diff(mw_leg(), mw_leg(compiles_steady_state=None))
+    assert not v["ok"]
+    bad = [c for c in v["legs"]["timit"]["checks"]
+           if c["verdict"] == "regression"]
+    assert bad and bad[0]["key"] == "compiles_steady_state"
+
+
+def test_exact_key_missing_from_current_is_a_regression():
+    """A renamed / no-longer-measured exact invariant fails loudly; a
+    missing non-exact key (timing, info) is still just skipped."""
+    cur = mw_leg()
+    del cur["dropped"]
+    v = diff(mw_leg(), cur)
+    assert not v["ok"]
+    bad = [c for c in v["legs"]["timit"]["checks"]
+           if c["verdict"] == "regression"]
+    assert [c["key"] for c in bad] == ["dropped"] and bad[0]["current"] is None
+    cur = mw_leg()
+    del cur["one_worker_p99_ms"], cur["requeued"]
+    assert diff(mw_leg(), cur)["ok"]
+
+
+def test_true_bool_invariant_missing_from_current_is_a_regression():
+    """A bool invariant that held (overlap_ok=True) and then vanished
+    un-gates itself exactly like a renamed exact key — regression. A
+    False baseline bool vanishing gates nothing (there was no invariant
+    to lose)."""
+    v = diff(mw_leg(overlap_ok=True), mw_leg())
+    assert not v["ok"]
+    bad = [c for c in v["legs"]["timit"]["checks"]
+           if c["verdict"] == "regression"]
+    assert bad and bad[0]["key"] == "overlap_ok" and bad[0]["kind"] == "bool"
+    assert diff(mw_leg(overlap_ok=False), mw_leg())["ok"]
+
+
+def test_requeued_and_restart_variance_is_not_gated():
+    """How MUCH work was in flight at kill time (requeued) and whether a
+    CI flake cost an extra restart are scheduler timing, not pinned
+    invariants — runs differing only there must pass."""
+    v = diff(mw_leg(), mw_leg(requeued=7, worker_restarts=2,
+                              throughput_vs_one_worker=0.9))
+    assert v["ok"]
+
+
+def test_committed_baseline_gates_the_multiworker_leg():
+    """The committed CI baseline must carry the leg (tier1 names it via
+    --legs, so losing it fails the gate) with the invariants at zero."""
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    r = load_bench_report(os.path.join(root, "BENCH_CI_BASELINE.json"))
+    assert "serving_multiworker" in report_legs(r)
+    mw = r["serving_multiworker"]
+    assert mw["dropped"] == 0 and mw["one_worker_dropped"] == 0
+    assert mw["compiles_steady_state"] == 0 and mw["worker_restarts"] >= 1

@@ -71,3 +71,81 @@ def test_no_nested_injectors():
         with pytest.raises(RuntimeError, match="already active"):
             with injected(FaultSpec(match="b")):
                 pass
+
+
+# ------------------------------------------------- process-level chaos (PR 7)
+
+
+def test_specs_round_trip_through_env():
+    specs = (
+        FaultSpec(match="serving.worker.request", kind="kill", calls=(7,)),
+        FaultSpec(match="serving.worker.heartbeat", kind="corrupt", first_n=3),
+        FaultSpec(match="apply", kind="hang", hang_s=2.5, calls=(1, 4)),
+    )
+    decoded = faultinject.specs_from_env(faultinject.specs_to_env(specs))
+    assert [
+        (s.match, s.kind, s.calls, s.first_n, s.hang_s) for s in decoded
+    ] == [(s.match, s.kind, s.calls, s.first_n, s.hang_s) for s in specs]
+
+
+def test_install_from_env_is_process_lifetime(monkeypatch):
+    monkeypatch.setenv(
+        "KEYSTONE_FAULT_SPECS",
+        faultinject.specs_to_env((FaultSpec(match="site", kind="oom", calls=(1,)),)),
+    )
+    injector = faultinject.install_from_env()
+    try:
+        assert injector is not None and faultinject.current() is injector
+        with pytest.raises(InjectedOOM):
+            probe("site")
+        # idempotent while active
+        assert faultinject.install_from_env() is None
+    finally:
+        faultinject._current = None
+
+
+def test_install_from_env_noop_when_unset(monkeypatch):
+    monkeypatch.delenv("KEYSTONE_FAULT_SPECS", raising=False)
+    assert faultinject.install_from_env() is None
+    assert faultinject.current() is None
+
+
+def test_corrupt_garbles_strings_into_non_json(injector):
+    inj = injector(FaultSpec(match="hb", kind="corrupt", calls=(1,)))
+    import json
+
+    garbled = inj.wrap("hb", lambda: '{"kind": "heartbeat", "seq": 1}')()
+    with pytest.raises(json.JSONDecodeError):
+        json.loads(garbled)
+    # call 2 passes through intact
+    assert json.loads(inj.wrap("hb", lambda: '{"seq": 2}')()) == {"seq": 2}
+
+
+def test_kill_spec_sigkills_the_process():
+    import signal
+    import subprocess
+    import sys
+
+    code = (
+        "from keystone_tpu.reliability import faultinject\n"
+        "faultinject.install_from_env()\n"
+        "from keystone_tpu.reliability.faultinject import probe\n"
+        "probe('safe')\n"
+        "print('before', flush=True)\n"
+        "probe('serving.worker.request')\n"
+        "print('after', flush=True)\n"
+    )
+    import os
+
+    env = dict(
+        os.environ,
+        KEYSTONE_FAULT_SPECS=faultinject.specs_to_env(
+            (FaultSpec(match="serving.worker.request", kind="kill", calls=(1,)),)
+        ),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=60, env=env,
+    )
+    assert proc.returncode == -signal.SIGKILL
+    assert "before" in proc.stdout and "after" not in proc.stdout

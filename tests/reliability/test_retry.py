@@ -167,3 +167,47 @@ def test_wait_until_polls_then_deadline():
     with pytest.raises(DeadlineExceeded, match="coordinator"):
         wait_until(lambda: False, Deadline.after(0.05), interval=0.01,
                    label="coordinator")
+
+
+# --------------------------------------------- retry bounded by a deadline
+
+
+def test_call_stops_retrying_past_the_deadline():
+    """The retry clock and the request deadline are ONE clock: when the
+    next backoff would sleep past the caller's remaining budget, the
+    last error surfaces instead of a retry the deadline has already
+    disowned (the serving _apply_group contract)."""
+    fake_now = [100.0]
+    slept = []
+    policy = RetryPolicy(
+        max_attempts=5, base_delay_s=1.0, multiplier=1.0, jitter=0.0, seed=0,
+        sleep=lambda s: (slept.append(s), fake_now.__setitem__(0, fake_now[0] + s)),
+    )
+    deadline = Deadline(2.5, clock=lambda: fake_now[0])
+
+    def always_transient():
+        raise ConnectionError("UNAVAILABLE: flaky")
+
+    with pytest.raises(ConnectionError):
+        policy.call(always_transient, label="bounded", deadline=deadline)
+    # budget 2.5s, 1s backoffs: attempt, sleep, attempt, sleep, attempt,
+    # then the third backoff (0.5s left < 1s delay) abandons.
+    assert slept == [1.0, 1.0]
+    abandoned = get_recovery_log().events("retry_abandoned")
+    assert abandoned and abandoned[-1].detail["attempt"] == 3
+
+
+def test_call_with_roomy_deadline_retries_normally():
+    policy = RetryPolicy(
+        max_attempts=3, base_delay_s=0.001, jitter=0.0, seed=0
+    )
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise ConnectionError("UNAVAILABLE: flaky")
+        return "ok"
+
+    assert policy.call(flaky, deadline=Deadline(30.0)) == "ok"
+    assert attempts["n"] == 3

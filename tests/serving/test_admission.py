@@ -58,3 +58,43 @@ def test_rung_fracs_must_be_monotone():
             capacity=4,
             rungs=[AdmissionRung(0.9, 1.0), AdmissionRung(0.5, 0.5)],
         )
+
+
+# ----------------------------------------------- external (SLO-driven) mode
+
+
+def test_external_mode_never_walks_on_depth():
+    from keystone_tpu.serving.slo import SLO_RUNGS
+
+    controller = AdmissionController(100, rungs=SLO_RUNGS, external=True)
+    # deep queue at the normal rung: admitted right up to the full bound
+    assert controller.admit(99).name == "normal"
+    assert controller.rung_index == 0  # depth moved nothing
+    with pytest.raises(RequestShed):
+        controller.admit(100)
+
+
+def test_force_rung_pins_and_reports_previous():
+    from keystone_tpu.serving.slo import SLO_RUNGS
+
+    controller = AdmissionController(100, rungs=SLO_RUNGS, external=True)
+    assert controller.force_rung(2) == 0
+    assert controller.force_rung(2) is None  # already there
+    assert controller.rungs[controller.rung_index].name == "overload"
+    with pytest.raises(RequestShed):
+        controller.admit(40)  # 0.3 * 100 bound now
+    assert controller.force_rung(0) == 2
+    with pytest.raises(ValueError):
+        controller.force_rung(7)
+
+
+def test_external_mode_allows_non_monotonic_rungs():
+    from keystone_tpu.serving.admission import AdmissionRung
+
+    shrinking = (
+        AdmissionRung(queue_frac=1.0, wait_scale=1.0, name="a"),
+        AdmissionRung(queue_frac=0.5, wait_scale=0.5, name="b"),
+    )
+    with pytest.raises(ValueError):
+        AdmissionController(10, rungs=shrinking)  # depth mode refuses
+    assert AdmissionController(10, rungs=shrinking, external=True)

@@ -1,0 +1,262 @@
+"""HTTP front-end: routes, status mapping, health, deadline forwarding.
+
+Runs against a fake in-process dispatcher — the HTTP layer's contract
+(JSON in/out, status codes per failure class, health states) is
+independent of worker processes; the full stack is covered by
+test_multiworker_e2e.py and the chaos smoke."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import pytest
+
+from keystone_tpu.serving.config import RequestShed, ServerClosed
+from keystone_tpu.serving.frontend import ServingFrontend, parse_listen
+
+pytestmark = pytest.mark.serving
+
+
+class FakeSupervisor:
+    """submit/stats/config shape the frontend consumes."""
+
+    class config:
+        drain_timeout_s = 5.0
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.submissions = []
+        self.mode = "ok"
+        self.worker_states = {"0": "ready", "1": "ready"}
+
+    def submit(self, payload, deadline_s=None, model=None, key=None):
+        with self.lock:
+            self.submissions.append(
+                {"x": payload, "deadline_s": deadline_s, "model": model, "key": key}
+            )
+        future = Future()
+        if self.mode == "shed":
+            raise RequestShed("queue full (test)")
+        if self.mode == "closed":
+            raise ServerClosed()
+        if self.mode == "hang":
+            return future  # never settles → deadline/timeout path
+        if self.mode == "error":
+            future.set_exception(RuntimeError("apply exploded"))
+        else:
+            future.set_result([2.0 * v for v in payload])
+        return future
+
+    def stats(self):
+        alive = sum(1 for s in self.worker_states.values() if s == "ready")
+        return {
+            "served": len(self.submissions),
+            "workers": {k: {"state": v} for k, v in self.worker_states.items()},
+            "supervisor": {"alive": alive},
+        }
+
+
+@pytest.fixture()
+def frontend():
+    supervisor = FakeSupervisor()
+    front = ServingFrontend(supervisor, "127.0.0.1", 0).start()
+    yield front, supervisor
+    front.stop()
+
+
+def _post(front, path, obj, timeout=10):
+    request = urllib.request.Request(
+        f"http://{front.host}:{front.port}{path}",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as response:
+            return response.status, json.loads(response.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(front, path, timeout=10):
+    try:
+        with urllib.request.urlopen(
+            f"http://{front.host}:{front.port}{path}", timeout=timeout
+        ) as response:
+            return response.status, json.loads(response.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_apply_round_trip_forwards_everything(frontend):
+    front, supervisor = frontend
+    code, out = _post(front, "/v1/apply", {
+        "x": [1.0, 2.0], "model": "m2", "deadline_ms": 1500, "key": "tenant",
+    })
+    assert code == 200
+    assert out["y"] == [2.0, 4.0] and out["latency_ms"] >= 0
+    sub = supervisor.submissions[0]
+    assert sub == {"x": [1.0, 2.0], "deadline_s": 1.5, "model": "m2",
+                   "key": "tenant"}
+
+
+def test_status_codes_per_failure_class(frontend):
+    front, supervisor = frontend
+    assert _post(front, "/v1/apply", {"x": "nope"})[0] == 400
+    assert _post(front, "/v1/apply", {})[0] == 400
+    supervisor.mode = "shed"
+    assert _post(front, "/v1/apply", {"x": [1.0]})[0] == 429
+    supervisor.mode = "closed"
+    assert _post(front, "/v1/apply", {"x": [1.0]})[0] == 503
+    supervisor.mode = "error"
+    code, out = _post(front, "/v1/apply", {"x": [1.0]})
+    assert code == 500 and "apply exploded" in out["error"]
+    supervisor.mode = "hang"
+    code, out = _post(front, "/v1/apply", {"x": [1.0], "deadline_ms": 100})
+    assert code == 504
+    assert _get(front, "/nowhere")[0] == 404
+
+
+def test_healthz_tracks_worker_states(frontend):
+    front, supervisor = frontend
+    assert _get(front, "/healthz") == (
+        200, {"status": "ok", "alive": 2, "workers": {"0": "ready", "1": "ready"}},
+    )
+    supervisor.worker_states["1"] = "dead"
+    code, out = _get(front, "/healthz")
+    assert (code, out["status"]) == (200, "degraded")
+    supervisor.worker_states = {"0": "dead", "1": "failed"}
+    code, out = _get(front, "/healthz")
+    assert (code, out["status"]) == (503, "down")
+
+
+def test_stats_route_returns_supervisor_snapshot(frontend):
+    front, supervisor = frontend
+    _post(front, "/v1/apply", {"x": [1.0]})
+    code, out = _get(front, "/stats")
+    assert code == 200 and out["served"] == 1 and "workers" in out
+
+
+def test_default_deadline_applies_when_request_carries_none():
+    """--deadline-ms on the multiworker path: requests without their own
+    budget get the default; an explicit deadline_ms still wins."""
+    supervisor = FakeSupervisor()
+    front = ServingFrontend(
+        supervisor, "127.0.0.1", 0, default_deadline_s=0.25
+    ).start()
+    try:
+        assert _post(front, "/v1/apply", {"x": [1.0]})[0] == 200
+        assert _post(front, "/v1/apply", {"x": [1.0], "deadline_ms": 1500})[0] == 200
+    finally:
+        front.stop()
+    assert [s["deadline_s"] for s in supervisor.submissions] == [0.25, 1.5]
+
+
+def test_deadline_ms_zero_is_exhausted_not_default():
+    """deadline_ms=0 means the budget is gone — it must forward 0.0 (and
+    time out), never fall through to the default by truthiness."""
+    supervisor = FakeSupervisor()
+    supervisor.mode = "hang"
+    front = ServingFrontend(
+        supervisor, "127.0.0.1", 0, default_deadline_s=30.0
+    ).start()
+    try:
+        code, out = _post(front, "/v1/apply", {"x": [1.0], "deadline_ms": 0})
+    finally:
+        front.stop()
+    assert code == 504
+    assert supervisor.submissions[0]["deadline_s"] == 0.0
+
+
+def test_wedged_fleet_without_deadline_is_503_not_504():
+    """A request that carried NO deadline and hit the drain-ceiling wait
+    bound was failed by a wedged fleet, not by its own budget: 503."""
+    supervisor = FakeSupervisor()
+    supervisor.mode = "hang"
+    supervisor.config = type("C", (), {"drain_timeout_s": 0.2})
+    front = ServingFrontend(supervisor, "127.0.0.1", 0).start()
+    try:
+        code, out = _post(front, "/v1/apply", {"x": [1.0]})
+    finally:
+        front.stop()
+    assert code == 503 and "UNAVAILABLE" in out["error"]
+
+
+def test_malformed_deadline_ms_answers_400_not_dropped_connection(frontend):
+    front, _ = frontend
+    for bad in ("abc", [100], {"ms": 100}):
+        code, out = _post(front, "/v1/apply", {"x": [1.0], "deadline_ms": bad})
+        assert code == 400 and "deadline_ms" in out["error"], (bad, code, out)
+
+
+def test_worker_zero_remaining_deadline_is_forwarded_not_unbounded():
+    """The supervisor sends REMAINING budget; 0.0 means exhausted. The
+    worker must forward deadline_s=0.0 (which times out at assembly),
+    never drop the deadline and serve unbounded."""
+    from concurrent.futures import Future
+
+    from keystone_tpu.serving import worker as worker_mod
+
+    forwarded = []
+
+    class FakeServer:
+        def submit(self, payload, deadline_s=None, model=None):
+            forwarded.append(deadline_s)
+            future = Future()
+            future.set_result([0.0])
+            return future
+
+    backend = worker_mod.ServerBackend.__new__(worker_mod.ServerBackend)
+    backend.server = FakeServer()
+    backend._warmed = True
+    emitted = []
+
+    class Emitter:
+        emit = staticmethod(emitted.append)
+
+    backend.handle({"id": 1, "x": [1.0], "deadline_ms": 0.0}, Emitter)
+    backend.handle({"id": 2, "x": [1.0]}, Emitter)
+    assert forwarded == [0.0, None]
+    assert len(emitted) == 2
+
+
+def test_fleet_exhausted_unavailable_maps_to_503(frontend):
+    """UNAVAILABLE (every worker out of restart budget) is retryable
+    against another replica — 503, not a 500 server bug."""
+    from keystone_tpu.serving.config import ServingError
+
+    front, supervisor = frontend
+
+    def submit(payload, deadline_s=None, model=None, key=None):
+        future = Future()
+        future.set_exception(
+            ServingError("UNAVAILABLE: every worker exhausted its restart budget")
+        )
+        return future
+
+    supervisor.submit = submit
+    code, out = _post(front, "/v1/apply", {"x": [1.0]})
+    assert code == 503 and "UNAVAILABLE" in out["error"]
+
+
+def test_stdin_parser_carries_model_and_key_to_both_doors():
+    """parse_stdin_request is the one parser behind every door: the
+    model and affinity key a stdin client sends must reach submit()."""
+    from keystone_tpu.serving.config import parse_stdin_request
+
+    rid, x, deadline_s, key, model = parse_stdin_request(
+        {"id": 7, "x": [1.0], "model": "m2", "key": "tenant",
+         "deadline_ms": 100}
+    )
+    assert (rid, x, deadline_s, key, model) == (7, [1.0], 0.1, "tenant", "m2")
+    assert parse_stdin_request([1.0], 0.5) == (None, [1.0], 0.5, None, None)
+
+
+def test_parse_listen():
+    assert parse_listen("0.0.0.0:8080") == ("0.0.0.0", 8080)
+    assert parse_listen(":9000") == ("127.0.0.1", 9000)
+    assert parse_listen("9000") == ("127.0.0.1", 9000)
+    with pytest.raises(ValueError):
+        parse_listen("localhost")

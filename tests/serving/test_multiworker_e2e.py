@@ -1,0 +1,130 @@
+"""Multi-worker serving, end to end with REAL jax worker processes.
+
+Two invariants the ISSUE pins, exercised against actual
+``keystone_tpu.serving.worker`` subprocesses sharing one persistent XLA
+cache:
+
+1. **hot-swap under multi-process load** — swapping the live model
+   version mid-sweep across 2 workers drops/fails zero requests, and
+   once the swap settles every worker serves at zero steady-state XLA
+   compiles (each worker re-warms before acking; siblings and restarts
+   warm from the shared on-disk cache).
+2. **chaos: SIGKILL mid-sweep** — a worker killed mid-load loses zero
+   requests (requeued and completed), the supervisor restarts it within
+   the backoff budget, and worker_crash/worker_restart land in the
+   recovery ledger.
+
+Lean on purpose (d=8, 2 workers) — but each worker still pays a jax
+import (and the chaos test pays a third for the restart), so the module
+is slow-marked: tier-1 keeps the same invariants via the jax-free stub
+workers in test_supervisor.py, and CI exercises THIS real-process path
+through scripts/serve_chaos_smoke.sh. The offered-load version runs in
+bench.py's serving_multiworker leg.
+"""
+
+import json
+import time
+
+import pytest
+
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.serving.supervisor import SupervisorConfig, WorkerSupervisor
+
+pytestmark = [pytest.mark.serving, pytest.mark.slow]
+
+D = 8
+SPEC = {"synthetic": {"d": D, "seed": 0}}
+
+
+def make_supervisor(tmp_path, chaos=None):
+    env = {"KEYSTONE_COMPILATION_CACHE": str(tmp_path / "shared-xla-cache")}
+    for worker_id, specs in (chaos or {}).items():
+        env[f"KEYSTONE_FAULT_SPECS_WORKER_{worker_id}"] = json.dumps(specs)
+    return WorkerSupervisor(
+        SPEC,
+        SupervisorConfig(
+            workers=2,
+            heartbeat_s=0.2,
+            hang_timeout_s=5.0,
+            ready_timeout_s=180.0,
+            max_batch=4,
+            restart_policy=__import__(
+                "keystone_tpu.reliability.retry", fromlist=["RetryPolicy"]
+            ).RetryPolicy(max_attempts=4, base_delay_s=0.1, max_delay_s=1.0),
+        ),
+        env=env,
+    )
+
+
+def settle(futures, timeout=120):
+    return [f.result(timeout=timeout) for f in futures]
+
+
+def test_hot_swap_mid_sweep_zero_dropped_zero_steady_compiles(tmp_path):
+    sup = make_supervisor(tmp_path).start()
+    try:
+        sup.wait_ready()  # BOTH workers, so the sweep loads both
+        x = [0.5] * D
+        before = settle([sup.submit(x, deadline_s=90) for _ in range(24)])
+
+        # Mid-sweep: keep load in flight while the fleet swaps versions.
+        inflight = [sup.submit([float(i % 3)] * D, deadline_s=90) for i in range(24)]
+        acks = sup.swap({"synthetic": {"d": D, "seed": 2}})
+        settle(inflight)
+        assert set(acks) == {"0", "1"}
+        for ack in acks.values():
+            assert ack["kind"] == "swapped", acks
+            assert ack["version"] == 2
+
+        # Post-settle traffic: zero dropped, answered by the NEW weights.
+        after = settle([sup.submit(x, deadline_s=90) for _ in range(24)])
+        assert before[0] != after[0], "swap did not change the served model"
+        assert all(len(y) == D for y in after)
+
+        time.sleep(0.5)  # one beat: post-swap stats reach the supervisor
+        stats = sup.stats()
+        assert stats["failures"] == 0 and stats["timeouts"] == 0
+        assert stats["supervisor"]["requeued"] == 0
+        for worker_id, worker in stats["workers"].items():
+            assert worker["stats"].get("served", 0) > 0, (
+                f"worker {worker_id} took no traffic: load not multi-process"
+            )
+            assert worker["stats"]["xla_compiles_since_warmup"] == 0, (
+                f"worker {worker_id} compiled in steady state after the swap"
+            )
+    finally:
+        sup.stop()
+
+
+def test_sigkill_mid_sweep_zero_dropped_restart_in_budget(tmp_path):
+    chaos = {"0": [{"match": "serving.worker.request", "kind": "kill",
+                    "calls": [6]}]}
+    sup = make_supervisor(tmp_path, chaos=chaos).start()
+    try:
+        sup.wait_ready()
+        futures = [
+            sup.submit([float(i % 5)] * D, deadline_s=120) for i in range(48)
+        ]
+        results = settle(futures)
+        assert all(len(y) == D for y in results), "a request was dropped/failed"
+        assert sup.requeued > 0, "the kill stranded no in-flight work"
+
+        crashes = get_recovery_log().events("worker_crash")
+        assert crashes and crashes[0].detail["reason"] == "crash"
+        # Restart within the backoff budget: schedule sum + spawn slack.
+        policy = sup.config.restart_policy
+        budget_s = sum(policy.backoff_schedule()) + 60.0
+        sup.wait_ready(timeout_s=budget_s)
+        assert get_recovery_log().events("worker_restart"), (
+            "restart never recorded"
+        )
+        # The recycled worker serves again — and from the shared cache it
+        # re-warmed without steady-state compiles.
+        settle([sup.submit([1.0] * D, deadline_s=120) for _ in range(8)])
+        time.sleep(0.5)
+        stats = sup.stats()
+        worker0 = stats["workers"]["0"]
+        assert worker0["state"] == "ready" and worker0["incarnation"] == 1
+        assert worker0["stats"]["xla_compiles_since_warmup"] == 0
+    finally:
+        sup.stop()

@@ -1,0 +1,137 @@
+"""SLOController: p99-driven admission ladder transitions.
+
+The controller is pure control logic over an external-mode
+AdmissionController — a fake clock and hand-built worker snapshots
+exercise every transition rule without processes."""
+
+import pytest
+
+from keystone_tpu.obs import names as obs_names
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.serving.admission import AdmissionController
+from keystone_tpu.serving.config import RequestShed
+from keystone_tpu.serving.slo import SLO_RUNGS, SLOController
+
+pytestmark = pytest.mark.serving
+
+
+class Clock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def make_controller(target=50.0, **kw):
+    clock = Clock()
+    admission = AdmissionController(64, rungs=SLO_RUNGS, external=True)
+    controller = SLOController(
+        admission, target_p99_ms=target, clock=clock, min_served=4, **kw
+    )
+    return controller, admission, clock
+
+
+def snap(p99, served):
+    return {"0": {"p99_ms": p99, "served": served}}
+
+
+def test_requires_external_admission():
+    with pytest.raises(ValueError, match="external"):
+        SLOController(AdmissionController(8), target_p99_ms=10.0)
+
+
+def test_degrades_on_p99_over_target_and_records_ledger():
+    controller, admission, clock = make_controller(target=50.0)
+    record = controller.observe(snap(80.0, served=20))
+    assert record == {
+        "direction": "degrade",
+        "from_rung": "normal",
+        "to_rung": "pressure",
+        "rung_index": 1,
+        "p99_ms": 80.0,
+        "target_ms": 50.0,
+    }
+    assert admission.rung_index == 1
+    events = get_recovery_log().events("slo")
+    assert events and events[0].detail["direction"] == "degrade"
+
+
+def test_cooldown_rate_limits_degrades():
+    controller, admission, clock = make_controller(target=50.0, cooldown_s=1.0)
+    assert controller.observe(snap(80.0, 20)) is not None
+    # p99 still bad immediately after: within cooldown, no second step.
+    assert controller.observe(snap(90.0, 40)) is None
+    clock.now += 1.5
+    assert controller.observe(snap(90.0, 60))["to_rung"] == "overload"
+    # bottom of the ladder: nowhere further to degrade
+    clock.now += 1.5
+    assert controller.observe(snap(99.0, 80)) is None
+    assert admission.rung_index == 2
+
+
+def test_stale_windows_are_not_signal():
+    controller, admission, clock = make_controller(target=50.0)
+    # below min_served: ignored
+    assert controller.observe(snap(500.0, served=2)) is None
+    # served unchanged since last sweep: the p99 is history, ignored
+    assert controller.observe(snap(80.0, served=20)) is not None
+    clock.now += 10.0
+    assert controller.observe(snap(80.0, served=20)) is None
+    assert admission.rung_index == 1
+
+
+def test_recovery_needs_sustained_settle_under_threshold():
+    controller, admission, clock = make_controller(
+        target=50.0, recover_factor=0.5, settle_s=2.0
+    )
+    controller.observe(snap(80.0, 20))
+    assert admission.rung_index == 1
+    # under the recovery threshold but not yet settled
+    clock.now += 1.0
+    assert controller.observe(snap(10.0, 40)) is None
+    clock.now += 1.0
+    assert controller.observe(snap(10.0, 60)) is None  # starts the window
+    clock.now += 2.5
+    record = controller.observe(snap(10.0, 80))
+    assert record["direction"] == "recover" and admission.rung_index == 0
+    # middle band (between recover threshold and target): holds steady
+    clock.now += 5.0
+    assert controller.observe(snap(40.0, 100)) is None
+
+
+def test_worst_worker_is_the_aggregate_signal():
+    controller, admission, clock = make_controller(target=50.0)
+    stats = {
+        "0": {"p99_ms": 5.0, "served": 50},
+        "1": {"p99_ms": 120.0, "served": 50},  # the straggler
+    }
+    record = controller.observe(stats)
+    assert record["direction"] == "degrade" and record["p99_ms"] == 120.0
+    gauge = obs_names.metric(obs_names.SERVING_SLO_P99_MS)
+    assert gauge.value(worker="aggregate") == 120.0
+    assert gauge.value(worker="1") == 120.0
+
+
+def test_metrics_published():
+    controller, admission, clock = make_controller(target=75.0)
+    transitions = obs_names.metric(obs_names.SERVING_SLO_TRANSITIONS)
+    before = transitions.value(direction="degrade")
+    controller.observe(snap(100.0, 20))
+    assert transitions.value(direction="degrade") == before + 1
+    assert obs_names.metric(obs_names.SERVING_SLO_TARGET_MS).value() == 75.0
+    assert obs_names.metric(obs_names.SERVING_SLO_RUNG).value() == 1
+
+
+def test_external_admission_sheds_earlier_at_degraded_rungs():
+    controller, admission, clock = make_controller(target=50.0, cooldown_s=0.0)
+    assert admission.admit(50) is not None  # normal: full capacity bound
+    controller.observe(snap(80.0, 20))      # → pressure (frac 0.6 of 64)
+    admission.admit(30)
+    with pytest.raises(RequestShed):
+        admission.admit(50)
+    clock.now += 10.0
+    controller.observe(snap(90.0, 40))      # → overload (frac 0.3)
+    with pytest.raises(RequestShed):
+        admission.admit(30)
+    assert admission.admit(10) is not None

@@ -1,0 +1,349 @@
+"""WorkerSupervisor: crash/hang recovery, requeue, routing, admission.
+
+These tests run against STUB workers (``{"stub": ...}`` spec — the
+jax-free echo backend in serving/worker.py): the supervisor's contracts
+(process monitoring, restart backoff, the zero-dropped-requests requeue
+invariant, consistent-hash routing, deadline propagation) are properties
+of the control pipe, not of what computes ``y``; real-jax workers are
+covered by test_multiworker_e2e.py and scripts/serve_chaos_smoke.sh."""
+
+import json
+import sys
+import time
+
+import pytest
+
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.serving.config import (
+    RequestShed,
+    RequestTimeout,
+    ServerClosed,
+    ServingError,
+)
+from keystone_tpu.serving.supervisor import (
+    HashRing,
+    SupervisorConfig,
+    WorkerSupervisor,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def make_supervisor(workers=2, delay_ms=0, chaos=None, **cfg):
+    """Stub-worker supervisor tuned for test speed (fast beats, tight
+    hang detection, sub-second backoff)."""
+    defaults = dict(
+        workers=workers,
+        heartbeat_s=0.05,
+        hang_timeout_s=0.8,
+        ready_timeout_s=15.0,
+        monitor_interval_s=0.02,
+    )
+    defaults.update(cfg)
+    env = {}
+    for worker_id, specs in (chaos or {}).items():
+        env[f"KEYSTONE_FAULT_SPECS_WORKER_{worker_id}"] = json.dumps(specs)
+    return WorkerSupervisor(
+        {"stub": {"delay_ms": delay_ms}}, SupervisorConfig(**defaults), env=env
+    )
+
+
+def settle(futures, timeout=30):
+    return [f.result(timeout=timeout) for f in futures]
+
+
+# ------------------------------------------------------------------ routing
+
+
+def test_hash_ring_spreads_and_is_consistent():
+    ring = HashRing(["0", "1", "2", "3"])
+    first = {f"k{i}": next(iter(ring.walk(f"k{i}"))) for i in range(400)}
+    by_node = {}
+    for node in first.values():
+        by_node[node] = by_node.get(node, 0) + 1
+    assert set(by_node) == {"0", "1", "2", "3"}
+    assert min(by_node.values()) > 40  # no starved node at 400 keys
+    # Same ring → identical placement (routing is a pure function).
+    again = HashRing(["0", "1", "2", "3"])
+    assert {k: next(iter(again.walk(k))) for k in first} == first
+    # walk yields every node exactly once
+    assert sorted(ring.walk("anything")) == ["0", "1", "2", "3"]
+
+
+def test_hash_ring_failover_moves_only_dead_nodes_keys():
+    ring = HashRing(["0", "1", "2"])
+    keys = [f"k{i}" for i in range(300)]
+    placements = {k: list(ring.walk(k)) for k in keys}
+    for k in keys:
+        order = placements[k]
+        # Skipping a dead first choice lands on the SECOND ring node —
+        # keys owned by healthy nodes never move.
+        assert order[1] != order[0]
+
+
+# ----------------------------------------------------------------- lifecycle
+
+
+def test_round_trip_and_aggregated_stats():
+    sup = make_supervisor(workers=2).start()
+    try:
+        sup.wait_ready()
+        futures = [sup.submit([float(i)]) for i in range(30)]
+        results = settle(futures)
+        assert [r[0] for r in results] == [2.0 * i for i in range(30)]
+        time.sleep(0.15)  # one beat so worker stats reach the supervisor
+        stats = sup.stats()
+        assert stats["served"] == 30
+        assert set(stats["workers"]) == {"0", "1"}
+        assert stats["supervisor"]["alive"] == 2
+        assert stats["supervisor"]["requeued"] == 0
+        # both workers took traffic (hash spread over request ids)
+        per_worker = [w["stats"].get("served", 0) for w in stats["workers"].values()]
+        assert all(v > 0 for v in per_worker), per_worker
+    finally:
+        sup.stop()
+
+
+def test_affinity_key_pins_one_worker():
+    sup = make_supervisor(workers=2).start()
+    try:
+        sup.wait_ready()
+        settle([sup.submit([1.0], key="tenant-A") for _ in range(12)])
+        time.sleep(0.15)
+        served = [
+            w["stats"].get("served", 0) for w in sup.stats()["workers"].values()
+        ]
+        assert sorted(served) == [0, 12], served
+    finally:
+        sup.stop()
+
+
+def test_submit_after_stop_refuses():
+    sup = make_supervisor(workers=1).start()
+    sup.wait_ready()
+    sup.stop()
+    with pytest.raises(ServerClosed):
+        sup.submit([1.0])
+
+
+# ------------------------------------------------------------ chaos: crash
+
+
+def test_sigkill_mid_load_drops_nothing_and_restarts():
+    """THE supervisor invariant: a worker SIGKILLed mid-load loses zero
+    requests — its in-flight work is requeued onto the healthy worker —
+    and the supervisor restarts it with backoff, landing worker_crash +
+    worker_restart in the recovery ledger."""
+    sup = make_supervisor(
+        workers=2,
+        delay_ms=2,
+        chaos={"0": [{"match": "serving.worker.request", "kind": "kill",
+                      "calls": [4]}]},
+    ).start()
+    try:
+        sup.wait_ready()
+        futures = [sup.submit([float(i)], deadline_s=30) for i in range(50)]
+        results = settle(futures)
+        assert [r[0] for r in results] == [2.0 * i for i in range(50)]
+        assert sup.requeued > 0  # the kill really stranded work
+        sup.wait_ready(timeout_s=20)  # the killed worker comes back
+        kinds = [e.kind for e in get_recovery_log().events()]
+        assert "worker_crash" in kinds
+        crash = get_recovery_log().events("worker_crash")[0]
+        assert crash.detail["reason"] == "crash"
+        # restart lands (backoff schedule is sub-second in this config)
+        assert get_recovery_log().events("worker_restart"), kinds
+        # the fleet serves again after recovery
+        assert settle([sup.submit([3.0])])[0] == [6.0]
+    finally:
+        sup.stop()
+
+
+def test_single_worker_kill_parks_requests_until_restart():
+    """With no healthy sibling, stranded requests PARK (pending queue)
+    rather than fail, and the restarted worker serves them."""
+    sup = make_supervisor(
+        workers=1,
+        delay_ms=2,
+        chaos={"0": [{"match": "serving.worker.request", "kind": "kill",
+                      "calls": [3]}]},
+    ).start()
+    try:
+        sup.wait_ready()
+        futures = [sup.submit([float(i)], deadline_s=30) for i in range(10)]
+        results = settle(futures)
+        assert [r[0] for r in results] == [2.0 * i for i in range(10)]
+        assert sup.stats()["supervisor"]["restarts"] == 1
+    finally:
+        sup.stop()
+
+
+def test_restart_budget_exhaustion_fails_outstanding_loudly():
+    """A crash-looping worker (exits immediately, never ready) consumes
+    its restart budget and outstanding requests fail with a classified
+    UNAVAILABLE error instead of hanging forever."""
+    sup = WorkerSupervisor(
+        {"stub": {}},
+        SupervisorConfig(
+            workers=1,
+            max_restarts=2,
+            monitor_interval_s=0.02,
+            restart_policy=__import__(
+                "keystone_tpu.reliability.retry", fromlist=["RetryPolicy"]
+            ).RetryPolicy(max_attempts=3, base_delay_s=0.02, max_delay_s=0.05),
+        ),
+        worker_cmd=lambda wid: [sys.executable, "-c", "import sys; sys.exit(3)"],
+    ).start()
+    try:
+        future = sup.submit([1.0])
+        with pytest.raises(ServingError, match="restart budget"):
+            future.result(timeout=20)
+        assert sup.stats()["workers"]["0"]["state"] == "failed"
+        assert get_recovery_log().events("worker_failed")
+        # A submit AFTER the fleet failed must fail fast too — parking it
+        # would strand the future (no worker will ever be ready again).
+        late = sup.submit([2.0])
+        with pytest.raises(ServingError, match="restart budget"):
+            late.result(timeout=5)
+    finally:
+        sup.stop(drain=False)
+
+
+# ------------------------------------------------------------- chaos: hang
+
+
+def test_stopped_heartbeats_detected_as_hang_and_restarted():
+    sup = make_supervisor(
+        workers=1,
+        chaos={"0": [{"match": "serving.worker.heartbeat", "kind": "hang",
+                      "calls": [2], "hang_s": 60.0}]},
+    ).start()
+    try:
+        sup.wait_ready()
+        deadline = time.monotonic() + 20
+        while not get_recovery_log().events("worker_crash"):
+            assert time.monotonic() < deadline, "hang never detected"
+            time.sleep(0.05)
+        crash = get_recovery_log().events("worker_crash")[0]
+        assert crash.detail["reason"] == "hang"
+        sup.wait_ready(timeout_s=20)
+        assert settle([sup.submit([1.0])])[0] == [2.0]
+    finally:
+        sup.stop()
+
+
+def test_corrupt_heartbeats_are_not_heartbeats():
+    """A garbled heartbeat line must not refresh liveness: a worker whose
+    channel is corrupt gets hang-detected and recycled."""
+    sup = make_supervisor(
+        workers=1,
+        chaos={"0": [{"match": "serving.worker.heartbeat", "kind": "corrupt",
+                      "first_n": 10000}]},
+    ).start()
+    try:
+        deadline = time.monotonic() + 20
+        while not get_recovery_log().events("worker_crash"):
+            assert time.monotonic() < deadline, "corrupt channel never detected"
+            time.sleep(0.05)
+        assert get_recovery_log().events("worker_crash")[0].detail["reason"] == "hang"
+        sup.wait_ready(timeout_s=20)  # clean incarnation takes over
+        assert settle([sup.submit([2.0])])[0] == [4.0]
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------------- deadlines and admission
+
+
+def test_deadline_budget_crosses_the_boundary():
+    """The REMAINING deadline crosses supervisor → worker: the worker
+    sees a positive budget no larger than what was submitted, and a
+    request submitted without a deadline crosses with none."""
+    sup = make_supervisor(workers=1).start()
+    try:
+        sup.wait_ready()
+        echoed = sup.submit(["deadline-echo"], deadline_s=5.0).result(timeout=10)
+        assert 0.0 < echoed[0] <= 5000.0, echoed
+        bare = sup.submit(["deadline-echo"]).result(timeout=10)
+        assert bare[0] == -1.0  # no deadline submitted → none forwarded
+    finally:
+        sup.stop()
+
+
+def test_expired_requeue_fails_as_timeout_not_zombie():
+    """A request whose deadline lapses while parked fails with
+    RequestTimeout instead of dispatching with zero budget."""
+    sup = WorkerSupervisor(
+        {"stub": {}},
+        SupervisorConfig(workers=1, monitor_interval_s=0.02, ready_timeout_s=15),
+        worker_cmd=lambda wid: [sys.executable, "-c", "import time; time.sleep(60)"],
+    ).start()
+    try:
+        future = sup.submit([1.0], deadline_s=0.2)  # parked: worker never ready
+        with pytest.raises(RequestTimeout):
+            future.result(timeout=10)
+    finally:
+        sup.stop(drain=False)
+
+
+def test_swap_survives_a_dead_worker_mid_broadcast():
+    """A worker whose pipe is already gone when the swap broadcast
+    reaches it fails ITS ack (swap_failed) — the remaining workers must
+    still receive and ack the swap, and swap() must not raise."""
+    sup = make_supervisor(workers=2).start()
+    try:
+        sup.wait_ready()
+        # Close worker 0's stdin under the supervisor: the write path
+        # raises deterministically while state still reads "ready".
+        sup._workers["0"].proc.stdin.close()
+        acks = sup.swap({"stub": {}})
+        assert set(acks) == {"0", "1"}
+        assert acks["0"]["kind"] == "swap_failed"
+        assert acks["1"]["kind"] == "swapped"
+    finally:
+        sup.stop(drain=False)
+
+
+def test_every_pipe_broken_parks_without_recursing():
+    """When EVERY ready worker's pipe breaks inside one routing pass, the
+    route loop must walk each worker once and park — not ping-pong
+    between two broken pipes until RecursionError. The parked request is
+    then served by the restarted fleet (EOF on stdin ends the workers,
+    the monitor recycles them)."""
+    sup = make_supervisor(workers=2).start()
+    try:
+        sup.wait_ready()
+        for worker in sup._workers.values():
+            worker.proc.stdin.close()  # every write now raises
+        future = sup.submit([5.0], deadline_s=30)
+        assert sup.requeued >= 2  # both pipes were tried, then it parked
+        assert future.result(timeout=20) == [10.0]
+    finally:
+        sup.stop()
+
+
+def test_park_after_final_drain_settles_closed_not_stranded():
+    """A submit that races stop() past the final drain must settle its
+    future with ServerClosed instead of parking on a queue nothing will
+    ever drain again."""
+    sup = make_supervisor(workers=1)  # never started: no ready workers
+    sup._drained = True  # the state stop() leaves behind
+    future = sup.submit([1.0])
+    with pytest.raises(ServerClosed):
+        future.result(timeout=5)
+
+
+def test_admission_sheds_at_capacity():
+    sup = make_supervisor(workers=1, delay_ms=200, queue_depth=4).start()
+    try:
+        sup.wait_ready()
+        futures, sheds = [], 0
+        for i in range(16):
+            try:
+                futures.append(sup.submit([float(i)]))
+            except RequestShed:
+                sheds += 1
+        assert sheds > 0, "capacity 4 never shed under 16 instant submits"
+        settle(futures)  # admitted requests all complete
+    finally:
+        sup.stop()
