@@ -165,9 +165,11 @@ def _apply_platform_flags(argv: list) -> None:
                 return a.split("=", 1)[1]
         return None
 
+    from .envknobs import env_str
+
     device_count = flag_value("--device-count")
     if device_count:
-        flags = os.environ.get("XLA_FLAGS", "")
+        flags = env_str("XLA_FLAGS")
         os.environ["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count={device_count}"
         ).strip()
@@ -247,6 +249,17 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_bench_diff_arguments(bench_diff_parser)
 
+    # Static tier (docs/VERIFICATION.md): keystone-lint over the
+    # codebase and/or plan-time graph verification of a pipeline —
+    # all before any data touches a device. Stdlib-only flag wiring.
+    from .lint.check import add_check_arguments
+
+    check_parser = sub.add_parser(
+        "check",
+        help="static checks: --lint the codebase, --pipeline verify a plan graph",
+    )
+    add_check_arguments(check_parser)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -259,14 +272,15 @@ def main(argv: Optional[list] = None) -> int:
         print(f"{'serve':28s} online serving front-end (micro-batched, stdin/JSON)")
         print(f"{'profile':28s} instrumented run → Chrome trace + Prometheus snapshot")
         print(f"{'bench-diff':28s} compare two BENCH json artifacts, fail on regression")
+        print(f"{'check':28s} static tier: keystone-lint + plan-time graph verification")
         return 0
 
     # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;
     # runbook: docs/MULTIHOST.md): join the pod's distributed runtime
     # BEFORE any device use so every host sees the global device set.
-    import os as _os
+    from .envknobs import env_set
 
-    if _os.environ.get("KEYSTONE_DISTRIBUTED"):
+    if env_set("KEYSTONE_DISTRIBUTED"):
         from .parallel.mesh import distributed_init
 
         distributed_init()
@@ -280,6 +294,11 @@ def main(argv: Optional[list] = None) -> int:
         from .obs.benchdiff import bench_diff_from_args
 
         return bench_diff_from_args(args)
+
+    if args.workload == "check":
+        from .lint.check import check_from_args
+
+        return check_from_args(args)
 
     if args.workload == "profile":
         from .obs.profile import profile_from_args

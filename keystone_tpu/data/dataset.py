@@ -31,6 +31,8 @@ from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence, 
 
 import numpy as np
 
+from ..envknobs import env_str
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -42,9 +44,9 @@ def default_ingest_workers() -> int:
     engine's prefetch pipeline. ``KEYSTONE_INGEST_WORKERS`` overrides;
     the default derives from the host's core count (capped — tar decode
     pools past ~32 threads just fight the GIL/page cache)."""
-    env = os.environ.get("KEYSTONE_INGEST_WORKERS", "").strip()
-    if env:
-        return max(1, int(env))
+    raw = env_str("KEYSTONE_INGEST_WORKERS").strip()
+    if raw:
+        return max(1, int(raw))
     return max(2, min(32, os.cpu_count() or 4))
 
 

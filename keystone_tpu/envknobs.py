@@ -1,0 +1,76 @@
+"""The one place process environment knobs are read.
+
+Every ``KEYSTONE_*`` (and infrastructure) environment variable is read
+through these helpers, at CALL time — never at import time, so tests can
+monkeypatch the environment and long-lived processes observe knob
+changes without a re-import. ``keystone-tpu check --lint`` enforces the
+discipline: a direct ``os.environ`` read anywhere else in the package is
+a KV501 finding (docs/VERIFICATION.md). Sites that must touch the raw
+environment structurally (a supervisor building a child's env, the
+fault harness carrying specs across a process boundary) annotate
+themselves with a ``# keystone: allow-env`` pragma instead.
+
+Keeping reads behind one choke point is what makes the knob surface
+auditable: ``grep env_`` here answers "what can the environment change"
+— the question docs/OPTIMIZER.md and docs/STREAMING.md tables are
+built from.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: Spellings that mean "off" for tri-state feature switches
+#: (KEYSTONE_FUSION, KEYSTONE_STREAMING, ... — docs/OPTIMIZER.md).
+_OFF_VALUES = ("off", "0", "disabled")
+
+#: Spellings that mean "on" for default-off switches.
+_ON_VALUES = ("1", "true", "on", "yes")
+
+
+def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The raw value of ``name`` (or ``default``). Prefer the typed
+    helpers below; this exists for pass-through plumbing (XLA_FLAGS,
+    coordinator addresses) where the value is opaque."""
+    return os.environ.get(name, default)
+
+
+def env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, default)
+
+
+def env_set(name: str) -> bool:
+    """True when ``name`` is present and non-empty."""
+    return bool(os.environ.get(name, "").strip())
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer knob; accepts float spellings like ``4e9`` (byte budgets
+    are often written in scientific notation)."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    return int(float(raw))
+
+
+def env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    return float(raw)
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Default-off boolean switch: on iff the value spells true."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() in _ON_VALUES
+
+
+def env_disabled(name: str) -> bool:
+    """True when a default-ON feature switch is explicitly off
+    (``off``/``0``/``disabled`` — the tri-state convention shared by
+    fusion, streaming, the profile store, and the compilation cache)."""
+    return os.environ.get(name, "").lower() in _OFF_VALUES

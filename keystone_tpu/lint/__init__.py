@@ -1,0 +1,33 @@
+"""keystone-lint: house-invariant checks over the codebase itself.
+
+The repo half of the static tier (the graph half is
+workflow/verify.py): stdlib-``ast`` rules encoding the invariants our
+runtime layers depend on — call-time env reads, sync-free hot paths,
+declared metric names, registered probe sites, annotated buffer
+donation. ``keystone-tpu check --lint`` runs them; tier-1 CI keeps the
+tree clean. See docs/VERIFICATION.md.
+"""
+
+from .rules import (
+    ALLOW_ENV,
+    ALLOW_SYNC,
+    LINT_CODES,
+    OWNS_DONATED,
+    Finding,
+    LintContext,
+    build_context,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "ALLOW_ENV",
+    "ALLOW_SYNC",
+    "LINT_CODES",
+    "OWNS_DONATED",
+    "Finding",
+    "LintContext",
+    "build_context",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,169 @@
+"""``keystone-tpu check`` — the static-tier CLI.
+
+Two halves, composable in one invocation (docs/VERIFICATION.md):
+
+``--lint [PATH ...]``
+    Run keystone-lint (lint/rules.py, stdlib ``ast``) over source trees
+    (default: the installed ``keystone_tpu`` package). Any finding fails
+    the run; tier-1 CI keeps the shipped tree clean
+    (scripts/check_smoke.sh).
+
+``--pipeline PATH|synthetic``
+    Plan-time graph verification (workflow/verify.py) of a saved
+    ``FittedPipeline.save`` artifact — or the synthetic serving chain —
+    with an optional bound ``--input-spec``. Pure spec propagation:
+    the run installs the compile counter and reports ``xla_compiles``
+    so CI can assert the whole pass compiled NOTHING. ``--seed-mismatch``
+    deliberately mis-sizes the input spec (the CI negative control: a
+    verifier that stops flagging a planted KV101 fails the smoke, not a
+    user).
+
+Exit code 0 iff zero lint findings and zero error-severity diagnostics
+(warnings don't fail — the same contract as ``KEYSTONE_VERIFY=warn``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional
+
+
+def add_check_arguments(parser: argparse.ArgumentParser) -> None:
+    """Stdlib-only flag wiring — ``keystone-tpu check --help`` must not
+    import jax."""
+    parser.add_argument(
+        "--lint",
+        nargs="*",
+        metavar="PATH",
+        default=None,
+        help="lint source trees (no PATH: the keystone_tpu package)",
+    )
+    parser.add_argument(
+        "--pipeline",
+        metavar="PATH|synthetic",
+        default=None,
+        help="verify a FittedPipeline.save artifact, or 'synthetic'",
+    )
+    parser.add_argument(
+        "--input-spec",
+        metavar="ROWSxCOLS:DTYPE",
+        default=None,
+        help="bind the pipeline input spec, e.g. 16x64:float32 "
+        "(default for synthetic: 16x64:float32)",
+    )
+    parser.add_argument(
+        "--buckets",
+        default=None,
+        help="comma-separated serving batch buckets the plan will pad onto",
+    )
+    parser.add_argument(
+        "--warmed-buckets",
+        default=None,
+        help="comma-separated buckets the AOT warmup covers "
+        "(utils/aot.warm_buckets); missing buckets are KV301 errors",
+    )
+    parser.add_argument(
+        "--seed-mismatch",
+        action="store_true",
+        help="deliberately mis-size the input spec (CI negative control)",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json", help="JSON output"
+    )
+
+
+def _parse_spec(text: str) -> Any:
+    """``16x64:float32`` → ShapeDtypeStruct((16, 64), float32)."""
+    import jax
+    import numpy as np
+
+    shape_part, _, dtype_part = text.partition(":")
+    shape = tuple(int(p) for p in shape_part.split("x") if p)
+    return jax.ShapeDtypeStruct(shape, np.dtype(dtype_part or "float32"))
+
+
+def _parse_buckets(text: Optional[str]) -> Optional[List[int]]:
+    if not text:
+        return None
+    return [int(p) for p in text.split(",") if p.strip()]
+
+
+def check_from_args(args: argparse.Namespace) -> int:
+    from . import lint_paths
+
+    out: Dict[str, Any] = {}
+    human: List[str] = []
+    ok = True
+
+    if args.lint is None and args.pipeline is None:
+        print("keystone-tpu check: nothing to do (pass --lint and/or --pipeline)")
+        return 2
+
+    if args.lint is not None:
+        import keystone_tpu
+
+        import os
+
+        paths = list(args.lint) or [os.path.dirname(keystone_tpu.__file__)]
+        findings = lint_paths(paths)
+        out["lint"] = {
+            "paths": paths,
+            "findings": [f.to_json() for f in findings],
+            "ok": not findings,
+        }
+        human.append(
+            f"lint[{', '.join(paths)}]: {len(findings)} findings"
+        )
+        human += ["  " + f.render() for f in findings]
+        ok = ok and not findings
+
+    if args.pipeline is not None:
+        # The compile counter must go in BEFORE anything traces: the
+        # whole point of plan-time verification is zero XLA compiles,
+        # and CI asserts the counter stayed at 0 (check_smoke.sh).
+        from ..utils.compilation_cache import install_compile_counter
+
+        compile_count = install_compile_counter()
+        from ..workflow.verify import verify_pipeline
+
+        if args.pipeline == "synthetic":
+            from ..serving.synthetic import synthetic_chain_pipeline
+
+            pipeline = synthetic_chain_pipeline(num_nodes=4, d=64)
+            spec_text = args.input_spec or "16x64:float32"
+        else:
+            from ..workflow.pipeline import FittedPipeline
+
+            pipeline = FittedPipeline.load(args.pipeline).fused()
+            spec_text = args.input_spec
+        input_spec = _parse_spec(spec_text) if spec_text else None
+        if args.seed_mismatch and input_spec is not None:
+            import jax
+
+            # Chop the trailing width: every downstream matmul/projection
+            # must reject it — the planted KV101.
+            shape = tuple(input_spec.shape)
+            bad = shape[:-1] + (max(1, shape[-1] - 1),)
+            input_spec = jax.ShapeDtypeStruct(bad, input_spec.dtype)
+        report = verify_pipeline(
+            pipeline,
+            input_spec,
+            buckets=_parse_buckets(args.buckets),
+            warmed_buckets=_parse_buckets(args.warmed_buckets),
+            probe_objects=True,
+            context=f"check:{args.pipeline}",
+        )
+        out["pipeline"] = report.to_json()
+        out["xla_compiles"] = compile_count()
+        human.append(report.render())
+        human.append(f"xla_compiles: {compile_count()}")
+        ok = ok and report.ok
+
+    out["ok"] = ok
+    if args.as_json:
+        print(json.dumps(out))
+    else:
+        print("\n".join(human))
+        print("check: OK" if ok else "check: FAILED")
+    return 0 if ok else 1

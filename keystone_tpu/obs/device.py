@@ -23,28 +23,36 @@ import os
 from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Iterator, Optional
 
+from ..envknobs import env_flag
 from . import names, spans
 
 _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
 
-_annotations_enabled = os.environ.get(
-    "KEYSTONE_DEVICE_ANNOTATIONS", ""
-).lower() in ("1", "true", "on")
+# Tri-state like fusion/streaming enablement: None → read the env at CALL
+# time. (This used to be a module-level env read, so flipping
+# KEYSTONE_DEVICE_ANNOTATIONS after import — or monkeypatching it in a
+# test — was silently ignored; keystone-lint KV501 now forbids
+# import-time environment reads, pinned by tests/lint/test_lint_rules.py.)
+_annotations_enabled: "bool | None" = None
 
 
-def set_device_annotations(enabled: bool) -> None:
+def set_device_annotations(enabled: "bool | None") -> None:
+    """Force annotations on/off process-wide; ``None`` restores the env
+    default."""
     global _annotations_enabled
-    _annotations_enabled = bool(enabled)
+    _annotations_enabled = enabled
 
 
 def annotations_enabled() -> bool:
-    return _annotations_enabled
+    if _annotations_enabled is not None:
+        return _annotations_enabled
+    return env_flag("KEYSTONE_DEVICE_ANNOTATIONS")
 
 
 def device_annotation(name: str):
     """Context manager: ``jax.profiler.TraceAnnotation(name)`` when
     enabled and jax is importable, else a no-op."""
-    if not _annotations_enabled:
+    if not annotations_enabled():
         return nullcontext()
     try:
         import jax.profiler

@@ -74,6 +74,13 @@ CHECKPOINT_HITS = "keystone_checkpoint_hits_total"
 CHECKPOINT_MISSES = "keystone_checkpoint_misses_total"
 CHECKPOINT_WRITES = "keystone_checkpoint_writes_total"
 
+# ---------------------------------------------------------------- verification
+VERIFY_RUNS = "keystone_verify_runs_total"
+VERIFY_DIAGNOSTICS = "keystone_verify_diagnostics_total"
+VERIFY_NODES = "keystone_verify_nodes_annotated_total"
+VERIFY_SECONDS = "keystone_verify_seconds"
+VERIFY_LINT_FINDINGS = "keystone_verify_lint_findings_total"
+
 # ----------------------------------------------------------------- compilation
 XLA_COMPILES = "keystone_xla_compiles_total"
 
@@ -147,6 +154,11 @@ SCHEMA: Dict[str, Tuple] = {
     CHECKPOINT_HITS: ("counter", "CheckpointStore lookups that restored a fit", ()),
     CHECKPOINT_MISSES: ("counter", "CheckpointStore lookups that missed", ()),
     CHECKPOINT_WRITES: ("counter", "CheckpointStore entries written", ()),
+    VERIFY_RUNS: ("counter", "Plan-time verification runs", ("context",)),
+    VERIFY_DIAGNOSTICS: ("counter", "Plan-time verification diagnostics emitted", ("code", "severity")),
+    VERIFY_NODES: ("counter", "Graph nodes annotated with propagated specs by the verifier", ()),
+    VERIFY_SECONDS: ("histogram", "Whole-graph verification passes", ()),
+    VERIFY_LINT_FINDINGS: ("counter", "keystone-lint findings", ("rule",)),
     XLA_COMPILES: ("counter", "Backend XLA compiles observed by jax.monitoring", ()),
     SERVING_REQUESTS: ("counter", "Requests served to completion", ()),
     SERVING_BATCHES: ("counter", "Micro-batches dispatched", ()),

@@ -115,7 +115,10 @@ def run_profile(
     from . import store as obs_store
 
     names.register_all()
-    annotations_before = device.annotations_enabled()
+    # Save the raw override (None = following the env), not the resolved
+    # bool: restoring a resolved False would PIN annotations off
+    # process-wide and re-introduce the stale-env bug device.py fixed.
+    annotations_before = device._annotations_enabled
     if annotations:
         device.set_device_annotations(True)
     os.makedirs(out_dir, exist_ok=True)

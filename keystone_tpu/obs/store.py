@@ -69,6 +69,7 @@ import os
 import threading
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from ..envknobs import env_disabled, env_int, env_str
 from . import names as _names
 
 logger = logging.getLogger(__name__)
@@ -189,8 +190,8 @@ class ProfileStore:
         fingerprint: Optional[Dict[str, str]] = None,
     ):
         self.path = path
-        self.max_entries = max_entries or int(
-            os.environ.get("KEYSTONE_PROFILE_STORE_MAX", _DEFAULT_MAX_ENTRIES)
+        self.max_entries = max_entries or env_int(
+            "KEYSTONE_PROFILE_STORE_MAX", _DEFAULT_MAX_ENTRIES
         )
         self._fingerprint = fingerprint
         self._lock = threading.Lock()
@@ -443,19 +444,17 @@ _store_lock = threading.Lock()
 
 
 def store_enabled() -> bool:
-    return os.environ.get("KEYSTONE_PROFILE_STORE", "").lower() not in (
-        "off", "0", "disabled",
-    )
+    return not env_disabled("KEYSTONE_PROFILE_STORE")
 
 
 def default_store_path() -> str:
     """The store file location: ``KEYSTONE_PROFILE_STORE`` when it names
     a path, else ``profile-store.jsonl`` under the same root as the XLA
     compilation cache (the two persistence layers travel together)."""
-    env = os.environ.get("KEYSTONE_PROFILE_STORE", "")
+    env = env_str("KEYSTONE_PROFILE_STORE")
     if env and env.lower() not in ("on", "1", "true"):
         return env
-    cache = os.environ.get("KEYSTONE_COMPILATION_CACHE", "")
+    cache = env_str("KEYSTONE_COMPILATION_CACHE")
     if cache and cache.lower() not in ("off", "0", "disabled"):
         root = os.path.dirname(cache.rstrip(os.sep)) or cache
     else:

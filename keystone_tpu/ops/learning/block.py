@@ -120,6 +120,11 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     def weight(self) -> int:
         return 3 * self.num_iter + 1
 
+    def out_spec(self, in_specs):
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
+
     def fit_stream(self, stream) -> BlockLinearMapper:
         """Row-chunked fit: accumulate (AᵀA, AᵀY, Σx, Σy) one fused
         dispatch per chunk, then run the SAME Gauss-Seidel block updates
@@ -365,6 +370,6 @@ def _host_streaming_threshold_bytes() -> int:
     instead of placed whole in HBM. Default 4 GB (the in-core path also
     materializes a centered copy, so real residency is ~2× + Gram
     workspace); override with KEYSTONE_STREAM_BYTES."""
-    import os
+    from ...envknobs import env_int
 
-    return int(float(os.environ.get("KEYSTONE_STREAM_BYTES", 4e9)))
+    return env_int("KEYSTONE_STREAM_BYTES", int(4e9))

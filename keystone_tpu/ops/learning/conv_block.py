@@ -321,6 +321,8 @@ def _conv_bcd_step_fn(
         ),
         out_specs=(P(), P(axes, None), P(), P()),
     )
+    # arg 3 is the loop-owned residual carry, rebuilt every call from
+    # this jit's own output.  # keystone: owns-donated
     return jax.jit(fn, donate_argnums=(3,))
 
 

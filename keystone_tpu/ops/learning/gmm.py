@@ -130,6 +130,13 @@ class GaussianMixtureModelEstimator(Estimator):
         self.initialization_method = initialization_method
         self.seed = seed
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): thresholded
+        posterior cluster assignments, (m, d) -> (m, k)."""
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label, out_width=self.k)
+
     def fit(self, data: Dataset) -> GaussianMixtureModel:
         ds = _as_array_dataset(data)
         x = np.asarray(jax.device_get(ds.data), dtype=np.float32)[: ds.num_examples]

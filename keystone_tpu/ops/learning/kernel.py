@@ -148,6 +148,14 @@ class KernelRidgeRegression(LabelEstimator):
         self.num_epochs = num_epochs
         self.block_permuter = block_permuter
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): the dual
+        model scores through the kernel against the training set,
+        (m, d) -> (m, k) with d pinned to the training width."""
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
+
     def fit(self, data: Dataset, labels: Dataset) -> "KernelBlockLinearMapper":
         from ...reliability import DegradationLadder, halving_rungs
 

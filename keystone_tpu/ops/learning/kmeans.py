@@ -52,6 +52,13 @@ class KMeansPlusPlusEstimator(Estimator):
         self.stop_tolerance = stop_tolerance
         self.seed = seed
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): one-hot
+        nearest-center assignments, (m, d) -> (m, num_means)."""
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label, out_width=self.num_means)
+
     def fit(self, data: Dataset) -> KMeansModel:
         ds = _as_array_dataset(data)
         x = np.asarray(jax.device_get(ds.data), dtype=np.float32)[: ds.num_examples]

@@ -118,6 +118,14 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         self.block_size = block_size
         self.block_iters = block_iters
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): whichever
+        concrete solver the cost model picks, the fitted map is
+        (m, d) -> (m, k)."""
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
+
     # default implementation when node-level optimization never ran
     def fit(self, data: Dataset, labels: Dataset) -> Transformer:
         from ...obs import solver as solver_obs

@@ -63,6 +63,13 @@ class LinearMapEstimator(LabelEstimator):
     def __init__(self, reg: Optional[float] = None):
         self.reg = reg
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): fitting (n, d)
+        features against (n, k) labels yields a (m, d) → (m, k) map."""
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
+
     def fit_stream(self, stream) -> LinearMapper:
         """Row-chunked exact fit: the same algebraic centering identity
         the fused in-core solve uses (Σ(a−μ)(a−μ)ᵀ = AᵀA − n·μμᵀ), fed
@@ -133,6 +140,11 @@ class LocalLeastSquaresEstimator(LabelEstimator):
 
     def __init__(self, reg: float = 0.0):
         self.reg = reg
+
+    def out_spec(self, in_specs):
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         features = _as_array_dataset(data)

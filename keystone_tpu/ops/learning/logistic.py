@@ -40,6 +40,13 @@ class LogisticRegressionEstimator(LabelEstimator):
         self.memory_size = memory_size
         self.tol = tol
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): int class-id
+        labels, scores out at the declared class count."""
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label, out_width=self.num_classes)
+
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)

@@ -37,6 +37,11 @@ class NaiveBayesEstimator(LabelEstimator):
         self.num_classes = num_classes
         self.smoothing = smoothing
 
+    def out_spec(self, in_specs):
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label, out_width=self.num_classes)
+
     def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)

@@ -104,6 +104,13 @@ class PCAEstimator(Estimator, CostModel):
     def __init__(self, dims: int):
         self.dims = dims
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): the fitted
+        projection replaces the descriptor axis with ``dims``."""
+        from ...workflow.verify import projection_fit_spec
+
+        return projection_fit_spec(in_specs, self.label, dims=self.dims)
+
     def fit(self, data: Dataset) -> PCATransformer:
         x = jnp.asarray(_as_array_dataset(data).data, dtype=jnp.float32)
         n = _as_array_dataset(data).num_examples
@@ -138,6 +145,11 @@ class DistributedPCAEstimator(Estimator, CostModel):
 
     def __init__(self, dims: int):
         self.dims = dims
+
+    def out_spec(self, in_specs):
+        from ...workflow.verify import projection_fit_spec
+
+        return projection_fit_spec(in_specs, self.label, dims=self.dims)
 
     def fit(self, data: Dataset) -> PCATransformer:
         ds = _as_array_dataset(data)
@@ -174,6 +186,11 @@ class ApproximatePCAEstimator(Estimator, CostModel):
         self.q = q
         self.p = p
         self.seed = seed
+
+    def out_spec(self, in_specs):
+        from ...workflow.verify import projection_fit_spec
+
+        return projection_fit_spec(in_specs, self.label, dims=self.dims)
 
     def fit(self, data: Dataset) -> PCATransformer:
         ds = _as_array_dataset(data)
@@ -227,6 +244,11 @@ class LocalColumnPCAEstimator(Estimator, CostModel):
         self.dims = dims
         self._inner = PCAEstimator(dims)
 
+    def out_spec(self, in_specs):
+        from ...workflow.verify import projection_fit_spec
+
+        return projection_fit_spec(in_specs, self.label, dims=self.dims)
+
     def fit(self, data: Dataset) -> BatchPCATransformer:
         flat = _columns_to_vectors(data)
         t = self._inner.fit(flat)
@@ -243,6 +265,11 @@ class DistributedColumnPCAEstimator(Estimator, CostModel):
     def __init__(self, dims: int):
         self.dims = dims
         self._inner = DistributedPCAEstimator(dims)
+
+    def out_spec(self, in_specs):
+        from ...workflow.verify import projection_fit_spec
+
+        return projection_fit_spec(in_specs, self.label, dims=self.dims)
 
     def fit(self, data: Dataset) -> BatchPCATransformer:
         flat = _columns_to_vectors(data)
@@ -265,6 +292,11 @@ class ColumnPCAEstimator(Estimator, Optimizable, CostModel):
         self.weights = weights
         self.local = LocalColumnPCAEstimator(dims)
         self.distributed = DistributedColumnPCAEstimator(dims)
+
+    def out_spec(self, in_specs):
+        from ...workflow.verify import projection_fit_spec
+
+        return projection_fit_spec(in_specs, self.label, dims=self.dims)
 
     def fit(self, data: Dataset):
         return self.distributed.fit(data)  # the reference's default

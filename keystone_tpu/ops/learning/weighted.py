@@ -92,6 +92,11 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     def weight(self) -> int:
         return 3 * self.num_iter + 1
 
+    def out_spec(self, in_specs):
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
+
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
@@ -337,6 +342,11 @@ class PerClassWeightedLeastSquaresEstimator(LabelEstimator):
         if not 0.0 <= mixture_weight <= 1.0:
             raise ValueError(f"mixture_weight must be in [0, 1], got {mixture_weight}")
         self.mixture_weight = mixture_weight
+
+    def out_spec(self, in_specs):
+        from ...workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
 
     @property
     def weight(self) -> int:

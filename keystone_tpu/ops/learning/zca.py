@@ -41,6 +41,13 @@ class ZCAWhitenerEstimator(Estimator):
     def __init__(self, eps: float = 0.1):
         self.eps = eps
 
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): whitening
+        preserves shape and dtype."""
+        from ...workflow.verify import elementwise_fit_spec
+
+        return elementwise_fit_spec(in_specs, self.label)
+
     def fit(self, data: Dataset) -> ZCAWhitener:
         if isinstance(data, ArrayDataset):
             mat = jnp.asarray(data.data, dtype=jnp.float32)[: data.num_examples]

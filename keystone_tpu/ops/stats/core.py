@@ -156,6 +156,11 @@ class StandardScaler(Estimator):
         self.normalize_std_dev = normalize_std_dev
         self.eps = eps
 
+    def out_spec(self, in_specs):
+        from ...workflow.verify import elementwise_fit_spec
+
+        return elementwise_fit_spec(in_specs, self.label)
+
     def fit(self, data: Dataset) -> StandardScalerModel:
         ds = _as_array_dataset(data)
         x = ds.data

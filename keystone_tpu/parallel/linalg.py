@@ -110,9 +110,9 @@ def solver_mode() -> str:
 
     Resolution order: explicit env var > measured override
     (:func:`set_solver_mode_override`) > the shipped "refine" default."""
-    import os
+    from ..envknobs import env_raw
 
-    env = os.environ.get("KEYSTONE_SOLVER_PRECISION")
+    env = env_raw("KEYSTONE_SOLVER_PRECISION")
     override = getattr(_mode_override_local, "mode", None)
     if env is not None:
         name = env.lower()
@@ -456,7 +456,7 @@ def _centered_solve_fused_fn(
     # computation for Gram/residual temporaries. The normal-equation
     # update passes (IR residual recomputation) still read x/y — XLA
     # keeps the storage live exactly as long as needed; only the caller's
-    # handle dies.
+    # handle dies.  # keystone: owns-donated
     return jax.jit(run, donate_argnums=(0, 1) if donate_xy else ())
 
 
@@ -682,6 +682,8 @@ def _bcd_fn(mesh: Mesh, num_epochs: int, block_size: int, donate_xy: bool = Fals
             in_specs=(P(axes, None), P(axes, None), P()),
             out_specs=P(),
         ),
+        # x/y donated only when the caller passes owned copies
+        # (donate_xy contract above).  # keystone: owns-donated
         donate_argnums=(0, 1) if donate_xy else (),
     )
 
@@ -806,6 +808,9 @@ def _bcd_stream_step_fn(mesh: Mesh):
             ),
             out_specs=(P(), P(axes, None)),
         ),
+        # panel + ping-pong carries are loop-owned (built by the stream
+        # driver, threaded only through this step; alias asserted by
+        # tests/ops/test_donation.py).  # keystone: owns-donated
         donate_argnums=(0, 4, 5),
     )
 

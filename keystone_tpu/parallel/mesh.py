@@ -127,13 +127,13 @@ def distributed_init(
     an init failure is a real error and propagates; with no cluster
     detected (plain single host) the failed auto-detection is expected
     and swallowed."""
-    import os
+    from ..envknobs import env_int, env_raw, env_set
 
-    coordinator_address = coordinator_address or os.environ.get("KEYSTONE_COORDINATOR")
-    if num_processes is None and os.environ.get("KEYSTONE_NUM_HOSTS"):
-        num_processes = int(os.environ["KEYSTONE_NUM_HOSTS"])
-    if process_id is None and os.environ.get("KEYSTONE_HOST_ID"):
-        process_id = int(os.environ["KEYSTONE_HOST_ID"])
+    coordinator_address = coordinator_address or env_raw("KEYSTONE_COORDINATOR")
+    if num_processes is None and env_set("KEYSTONE_NUM_HOSTS"):
+        num_processes = env_int("KEYSTONE_NUM_HOSTS", 0)
+    if process_id is None and env_set("KEYSTONE_HOST_ID"):
+        process_id = env_int("KEYSTONE_HOST_ID", 0)
     explicit = coordinator_address is not None
     given = {
         "KEYSTONE_COORDINATOR": coordinator_address,
@@ -159,7 +159,7 @@ def distributed_init(
         "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
         "SLURM_JOB_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
     )
-    in_cluster = explicit or any(v in os.environ for v in cluster_signals)
+    in_cluster = explicit or any(env_set(v) for v in cluster_signals)
     try:
         if jax.distributed.is_initialized():
             return

@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 FAULT_SPECS_ENV = "KEYSTONE_FAULT_SPECS"
 
+from ..envknobs import env_raw
 from .recovery import get_recovery_log
 
 
@@ -200,6 +201,26 @@ class FaultInjector:
 
 _current: Optional[FaultInjector] = None
 
+#: Every probe site the library exposes, by its exact label. The failure
+#: suite (scripts/run_failure_suite.sh) and chaos specs target sites by
+#: these names, so an unregistered ``probe("...")`` call is dead chaos
+#: surface nobody can aim at — ``keystone-tpu check --lint`` (rule KV504,
+#: docs/VERIFICATION.md) fails on any call whose label is missing here.
+#: Registering a site is a one-line diff reviewed next to the code that
+#: adds it.
+KNOWN_PROBE_SITES = frozenset(
+    {
+        "serving.apply",               # serving/server.py: per-batch apply
+        "serving.worker.request",      # serving/worker.py: request handling
+        "serving.worker.heartbeat",    # serving/worker.py: heartbeat wire
+        "streaming.chunk",             # workflow/streaming.py: per-chunk dispatch
+        "ingest.decode_batch",         # data/loaders/archive.py: decode pool
+        "BlockLeastSquaresEstimator.solve",
+        "LeastSquaresEstimator.solve",
+        "KernelRidgeRegression.solve",
+    }
+)
+
 
 def current() -> Optional[FaultInjector]:
     return _current
@@ -260,7 +281,7 @@ def install_from_env(env_var: str = FAULT_SPECS_ENV) -> Optional[FaultInjector]:
     or an injector is already active. Chaos-in-env is how the supervisor
     arms faults inside the worker it spawns."""
     global _current
-    raw = os.environ.get(env_var, "").strip()
+    raw = (env_raw(env_var) or "").strip()
     if not raw or _current is not None:
         return None
     injector = FaultInjector(*specs_from_env(raw))

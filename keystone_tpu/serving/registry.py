@@ -91,18 +91,67 @@ class ModelRegistry:
             self._current[name] = entry
             return entry
 
-    def load_fitted(self, name: str, path: str) -> ModelEntry:
+    def load_fitted(
+        self,
+        name: str,
+        path: str,
+        example: Any = None,
+        buckets: Optional[List[int]] = None,
+        warmed_buckets: Optional[List[int]] = None,
+    ) -> ModelEntry:
         """Publish a ``FittedPipeline.save`` artifact.
 
         The loaded graph is re-fused (workflow/fusion.py): artifacts
         saved before fusion existed — or with fusion disabled — still
         serve through single-dispatch fused chains, and warmup then
-        warms the fused executables."""
-        from ..workflow.pipeline import FittedPipeline
+        warms the fused executables.
 
-        return self.publish(
-            name, FittedPipeline.load(path).fused(), source=f"fitted:{path}"
+        Before publishing, the artifact goes through the plan-time
+        static verifier (workflow/verify.py): cycles and internal
+        shape/dtype inconsistencies are diagnosed from specs alone, plus
+        — when ``example`` (one request payload) is given — the whole
+        apply path, and — when ``buckets``/``warmed_buckets`` are given —
+        the serving-bucket/warm-set agreement (the steady-state-recompile
+        hazard, KV301). Warn-by-default; ``KEYSTONE_VERIFY=strict``
+        raises ``VerificationError`` instead of publishing a model that
+        cannot serve."""
+        from ..workflow.pipeline import FittedPipeline
+        from ..workflow.verify import verify_and_enforce
+
+        fitted = FittedPipeline.load(path).fused()
+        source_specs = None
+        if example is not None:
+            import jax
+            import numpy as np
+
+            def leaf_spec(a):
+                # Metadata first: np.asarray on a device leaf would force
+                # a host copy just to read the dtype. The fallback only
+                # runs for host-native payloads (JSON lists).
+                dtype = getattr(a, "dtype", None)
+                if dtype is None:
+                    dtype = np.asarray(a).dtype
+                return jax.ShapeDtypeStruct(
+                    (1,) + tuple(np.shape(a)), np.dtype(dtype)
+                )
+
+            try:
+                source_specs = {
+                    fitted.source: jax.tree_util.tree_map(leaf_spec, example)
+                }
+            except Exception:
+                # An unconvertible example must not block publication —
+                # verify the graph without a bound request spec instead
+                # (the warn contract: only verified findings interfere).
+                source_specs = None
+        verify_and_enforce(
+            fitted.graph,
+            context=f"load_fitted:{name}",
+            source_specs=source_specs,
+            buckets=buckets,
+            warmed_buckets=warmed_buckets,
         )
+        return self.publish(name, fitted, source=f"fitted:{path}")
 
     def load_checkpoint(self, name: str, store_path: str, digest: str) -> ModelEntry:
         """Publish a fitted value out of a reliability checkpoint store.

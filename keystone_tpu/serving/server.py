@@ -238,15 +238,27 @@ class PipelineServer:
         np.stack."""
         import jax
 
+        def leaf_signature(leaf):
+            # Read shape/dtype off the leaf's own metadata when it has
+            # any: np.asarray(device_array) here forced a full synchronous
+            # device→host copy per leaf per request just to LOOK at the
+            # shape — an unguarded host sync on the serving hot path
+            # (keystone-lint KV502; pinned by tests/lint/test_lint_rules.py).
+            # The asarray fallback only runs for host-native payloads
+            # (JSON lists).
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                import numpy as np
+
+                host = np.asarray(leaf)  # keystone: allow-sync — host-native leaf, no device copy
+                shape, dtype = host.shape, host.dtype
+            return (tuple(shape), str(dtype))
+
         def signature(req: Request):
             try:
                 leaves, treedef = jax.tree_util.tree_flatten(req.payload)
-                import numpy as np
-
-                shapes = tuple(
-                    (np.asarray(leaf).shape, str(np.asarray(leaf).dtype))
-                    for leaf in leaves
-                )
+                shapes = tuple(leaf_signature(leaf) for leaf in leaves)
                 return (req.model, str(treedef), shapes)
             except Exception:
                 return (req.model, "unstackable", id(req))
@@ -333,6 +345,9 @@ class PipelineServer:
         n = len(payloads)
         bucket = bucket_for(n, self._buckets)
         stacked = jax.tree_util.tree_map(
+            # Host→device marshal point: payloads are host-native client
+            # data (JSON/numpy), so asarray copies, it does not sync a
+            # device buffer.  # keystone: allow-sync
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *payloads
         )
 
@@ -484,6 +499,8 @@ def serve_from_args(args) -> int:
                 row = future.result()
                 emit({
                     "id": request_id,
+                    # Response egress: the result must land on the host
+                    # to be serialized anyway.  # keystone: allow-sync
                     "y": np.asarray(row).tolist(),
                     "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
                 })
@@ -509,6 +526,8 @@ def serve_from_args(args) -> int:
             emit({"id": obj.get("id"), "error": str(exc)})
             continue
         try:
+            # Request ingress: x is a decoded JSON list, host-native by
+            # construction.  # keystone: allow-sync
             payload = np.asarray(x, np.float32)
             if x is None or payload.ndim == 0:
                 raise ValueError(f"x must be an array, got {x!r}")

@@ -342,6 +342,9 @@ class WorkerSupervisor:
     # ------------------------------------------------------------------ spawn
     def _spawn(self, worker: _Worker) -> None:
         worker.incarnation += 1
+        # A child worker inherits the WHOLE parent environment (platform,
+        # cache, store knobs) — a structural pass-through, not a knob
+        # read, so it stays a raw access.  # keystone: allow-env
         env = dict(os.environ)
         env.update(self._env)
         chaos = env.pop(FAULT_SPECS_WORKER_ENV + worker.id, None)

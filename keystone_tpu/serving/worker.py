@@ -221,6 +221,8 @@ class ServerBackend:
 
         request_id = msg.get("id")
         try:
+            # Request ingress: x arrived as JSON over the control pipe,
+            # host-native by construction.  # keystone: allow-sync
             payload = np.asarray(msg.get("x"), np.float32)
             if payload.ndim == 0:
                 raise ValueError(f"x must be an array, got {msg.get('x')!r}")
@@ -262,6 +264,8 @@ class ServerBackend:
                     {
                         "kind": "response",
                         "id": request_id,
+                        # Response egress: serialized onto the pipe, so
+                        # it must be host-side.  # keystone: allow-sync
                         "y": np.asarray(row).tolist(),
                         "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
                     }

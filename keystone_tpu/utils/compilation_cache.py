@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import logging
 import os
+
+from ..envknobs import env_disabled, env_str
 from typing import Callable
 
 _DEFAULT_DIR = os.path.join(
@@ -32,8 +34,8 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     """Enable JAX's on-disk compilation cache; returns the dir (or None
     when disabled/unavailable). Safe to call more than once and before
     any backend is initialized (it only sets jax config values)."""
-    env = os.environ.get("KEYSTONE_COMPILATION_CACHE", "")
-    if env.lower() in ("off", "0", "disabled"):
+    env = env_str("KEYSTONE_COMPILATION_CACHE")
+    if env_disabled("KEYSTONE_COMPILATION_CACHE"):
         return None
     target = cache_dir or env or _DEFAULT_DIR
     try:

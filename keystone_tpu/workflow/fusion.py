@@ -41,11 +41,11 @@ guarantee. See docs/OPTIMIZER.md.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from contextlib import contextmanager
 from typing import List, Optional, Sequence, Tuple
 
+from ..envknobs import env_disabled
 from ..obs import names as _names
 from .graph import Graph, NodeId, SinkId
 from .operators import TransformerOperator
@@ -67,7 +67,7 @@ _enabled_lock = threading.Lock()
 def fusion_enabled() -> bool:
     if _enabled is not None:
         return _enabled
-    return os.environ.get("KEYSTONE_FUSION", "").lower() not in ("off", "0", "disabled")
+    return not env_disabled("KEYSTONE_FUSION")
 
 
 def set_fusion_enabled(value: Optional[bool]) -> None:

@@ -41,9 +41,9 @@ from __future__ import annotations
 
 import copy
 import logging
-import os
 from typing import Any, Dict, Optional, Tuple
 
+from ..envknobs import env_raw, env_set, env_str
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs import store as _store
@@ -57,7 +57,7 @@ logger = logging.getLogger(__name__)
 def knob_mode() -> str:
     """``KEYSTONE_MEASURED_KNOBS``: ``on`` (default — semantics-free
     overrides only), ``all`` (also precision/block size), ``off``."""
-    mode = os.environ.get("KEYSTONE_MEASURED_KNOBS", "on").lower()
+    mode = env_str("KEYSTONE_MEASURED_KNOBS", "on").lower()
     if mode in ("off", "0", "disabled"):
         return "off"
     return "all" if mode == "all" else "on"
@@ -156,7 +156,7 @@ class MeasuredKnobRule(Rule):
     def _tune_stream_chunks(self, graph, store, overrides, sp):
         from .streaming import StreamingFitOperator, chain_class
 
-        if os.environ.get("KEYSTONE_STREAM_CHUNK_ROWS"):
+        if env_set("KEYSTONE_STREAM_CHUNK_ROWS"):
             return graph  # explicit env knob always wins
         for node in sorted(graph.nodes):
             op = graph.operators.get(node)
@@ -195,7 +195,7 @@ class MeasuredKnobRule(Rule):
     def _tune_solver_block(self, graph, store, overrides, sp):
         from .streaming import StreamingFitOperator
 
-        if os.environ.get("KEYSTONE_SOLVER_BLOCK"):
+        if env_set("KEYSTONE_SOLVER_BLOCK"):
             return graph
         for node in sorted(graph.nodes):
             op = graph.operators.get(node)
@@ -247,7 +247,7 @@ class MeasuredKnobRule(Rule):
         from ..parallel import linalg
         from .streaming import StreamingFitOperator
 
-        if os.environ.get("KEYSTONE_SOLVER_PRECISION") is not None:
+        if env_raw("KEYSTONE_SOLVER_PRECISION") is not None:
             return graph  # explicit env knob always wins
         for node in sorted(graph.nodes):
             op = graph.operators.get(node)

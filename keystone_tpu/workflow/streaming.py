@@ -47,13 +47,13 @@ in tests/workflow/test_streaming.py).
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
 
+from ..envknobs import env_disabled, env_int
 from ..data.dataset import (
     ArrayDataset,
     Dataset,
@@ -83,9 +83,7 @@ _enabled_lock = threading.Lock()
 def streaming_enabled() -> bool:
     if _enabled is not None:
         return _enabled
-    return os.environ.get("KEYSTONE_STREAMING", "").lower() not in (
-        "off", "0", "disabled",
-    )
+    return not env_disabled("KEYSTONE_STREAMING")
 
 
 def set_streaming_enabled(value: Optional[bool]) -> None:
@@ -115,14 +113,14 @@ def stream_chunk_rows() -> int:
     """Rows per streamed chunk (``KEYSTONE_STREAM_CHUNK_ROWS``, default
     4096 — large enough to amortize dispatch, small enough that two host
     chunk buffers stay far below any realistic feature matrix)."""
-    return max(1, int(os.environ.get("KEYSTONE_STREAM_CHUNK_ROWS", 4096)))
+    return max(1, env_int("KEYSTONE_STREAM_CHUNK_ROWS", 4096))
 
 
 def stream_min_rows() -> int:
     """Plan-time eligibility floor for known-size datasets: below
     max(2·chunk, this) the materialized path wins (one dispatch, no
     pipeline overhead). ``KEYSTONE_STREAM_MIN_ROWS`` raises it."""
-    return int(os.environ.get("KEYSTONE_STREAM_MIN_ROWS", 0))
+    return env_int("KEYSTONE_STREAM_MIN_ROWS", 0)
 
 
 def stream_prefetch_depth() -> int:
@@ -130,7 +128,7 @@ def stream_prefetch_depth() -> int:
     1). The engine holds at most depth+1 host chunk buffers live — depth
     queued plus one in hand being uploaded — so the default keeps peak
     host residency at 2× chunk while still hiding decode behind compute."""
-    return max(1, int(os.environ.get("KEYSTONE_STREAM_PREFETCH", 1)))
+    return max(1, env_int("KEYSTONE_STREAM_PREFETCH", 1))
 
 
 def chain_class(members: Sequence[Any]) -> str:
@@ -347,6 +345,8 @@ def _shared_step_jit(members: tuple, step_fn):
         probe = leaf.ravel()[:1]  # tiny, NOT donated: safe to block on
         return new_carry, probe
 
+    # carry is owned by the fold loop: created by gram_stream_init and
+    # threaded only through this step.  # keystone: owns-donated
     jitted = jax.jit(fused, donate_argnums=(0,))
     with _step_cache_lock:
         _STEP_JIT_CACHE[key] = ((members, step_fn), jitted, traces)
@@ -377,6 +377,7 @@ def _labels_host(labels: Dataset):
         labels = labels.to_arrays()
     if not isinstance(labels, ArrayDataset):
         raise StreamingFallback(f"labels of type {type(labels).__name__}")
+    # One-time fit setup, before the chunk loop starts.  # keystone: allow-sync
     y = np.asarray(labels.data)[: labels.num_examples]
     if y.ndim == 1:
         y = y[:, None]
@@ -555,6 +556,9 @@ class ChunkStream:
             return probe_out
 
         def consume(probe_out, _chunk):
+            # The overlap engine's completion barrier for chunk i — a
+            # one-element un-donated probe leaf, waited on so chunk
+            # timings and backpressure are real.  # keystone: allow-sync
             probe_out.block_until_ready()
             report.compute_done_t.append(time.perf_counter() - t0)
 
@@ -626,6 +630,8 @@ def _chunk_spec(data: Dataset, chunk_rows: int):
             raise StreamingFallback("empty dataset")
         first = data.take(1)[0]
         return jax.tree_util.tree_map(
+            # Plan-time spec probe on ONE decoded host item, before any
+            # chunk flows.  # keystone: allow-sync
             lambda leaf: jax.ShapeDtypeStruct(
                 (chunk_rows,) + np.asarray(leaf).shape,
                 transfer_dtype(np.asarray(leaf).dtype),
@@ -640,6 +646,8 @@ def _pad_narrow(a, chunk_rows: int):
     chunk to the compiled chunk shape (one shape → one compile)."""
     import numpy as np
 
+    # Operates on the decoded HOST chunk buffer (pre-upload), never a
+    # device array.  # keystone: allow-sync
     a = np.asarray(a)
     narrow = transfer_dtype(a.dtype)
     if narrow != a.dtype:

@@ -1,0 +1,69 @@
+#!/usr/bin/env bash
+# Static-tier smoke (docs/VERIFICATION.md): the two CI contracts of
+# `keystone-tpu check`.
+#
+#   1. --lint over the shipped keystone_tpu/ tree is CLEAN (exit 0,
+#      zero findings) — a new finding means fix the code or annotate
+#      the reviewed exception.
+#   2. --pipeline catches a deliberately seeded shape mismatch (KV101)
+#      AND a seeded serving bucket mismatch (KV301) at plan time, exits
+#      nonzero, with ZERO XLA compiles (the compile counter stays 0 —
+#      pure spec propagation, no data touches a device) and the
+#      verification pass itself under 1s.
+#
+# A verifier that stops flagging the planted errors fails THIS smoke,
+# not a user's fit.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+# ---- 1. keystone-lint: shipped tree must be clean -----------------------
+timeout -k 10 120 python -m keystone_tpu check --lint keystone_tpu --json \
+  > /tmp/check_lint.json
+python - <<'EOF'
+import json
+
+payload = json.load(open("/tmp/check_lint.json"))
+assert payload["ok"] is True, payload
+assert payload["lint"]["findings"] == [], payload["lint"]["findings"]
+print("check_smoke lint OK: 0 findings over keystone_tpu/")
+EOF
+
+# ---- 2. seeded mismatches must be caught, with zero compiles ------------
+rc=0
+timeout -k 10 120 python -m keystone_tpu check --pipeline synthetic \
+  --seed-mismatch --buckets 8,32 --warmed-buckets 8 --json \
+  > /tmp/check_pipeline.json || rc=$?
+test "$rc" -eq 1 || { echo "seeded check exited $rc, want 1"; exit 1; }
+python - <<'EOF'
+import json
+
+payload = json.load(open("/tmp/check_pipeline.json"))
+report = payload["pipeline"]
+codes = [d["code"] for d in report["diagnostics"]]
+assert "KV101" in codes, f"seeded shape mismatch not flagged: {codes}"
+assert "KV301" in codes, f"seeded bucket mismatch not flagged: {codes}"
+assert payload["xla_compiles"] == 0, (
+    f"plan-time verification compiled {payload['xla_compiles']} programs, want 0"
+)
+assert report["seconds"] < 1.0, f"verification took {report['seconds']}s, want <1s"
+print(
+    "check_smoke pipeline OK: KV101+KV301 caught at plan time in "
+    f"{report['seconds'] * 1e3:.0f} ms, 0 XLA compiles"
+)
+EOF
+
+# ---- 3. the clean synthetic plan passes (no false positives) ------------
+timeout -k 10 120 python -m keystone_tpu check --pipeline synthetic \
+  --buckets 8,32 --warmed-buckets 8,32 --json > /tmp/check_clean.json
+python - <<'EOF'
+import json
+
+payload = json.load(open("/tmp/check_clean.json"))
+assert payload["ok"] is True, payload["pipeline"]["diagnostics"]
+assert payload["xla_compiles"] == 0
+print("check_smoke clean OK: healthy plan verifies with 0 errors, 0 compiles")
+EOF
+
+echo "check_smoke OK"

@@ -1,0 +1,80 @@
+"""``keystone-tpu check`` end-to-end: the static tier's CLI contract
+(exit codes, JSON shape, zero-compile guarantee) that
+scripts/check_smoke.sh builds on."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_check(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "keystone_tpu", "check", *args],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+
+
+def test_check_help_is_jax_free():
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.modules['jax'] = None; "
+         "from keystone_tpu.cli import main; main(['check', '--help'])"],
+        capture_output=True, text=True, cwd=REPO, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "--pipeline" in proc.stdout and "--lint" in proc.stdout
+
+
+@pytest.mark.slow
+def test_check_lint_shipped_tree_clean():
+    proc = run_check("--lint", "keystone_tpu", "--json")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["ok"] is True
+    assert payload["lint"]["findings"] == []
+
+
+@pytest.mark.slow
+def test_check_pipeline_seeded_mismatch_zero_compiles():
+    proc = run_check(
+        "--pipeline", "synthetic", "--seed-mismatch",
+        "--buckets", "8,32", "--warmed-buckets", "8", "--json",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    codes = [d["code"] for d in payload["pipeline"]["diagnostics"]]
+    assert "KV101" in codes and "KV301" in codes
+    assert payload["xla_compiles"] == 0
+    assert payload["pipeline"]["seconds"] < 1.0
+
+
+@pytest.mark.slow
+def test_check_pipeline_clean_synthetic_passes():
+    proc = run_check(
+        "--pipeline", "synthetic",
+        "--buckets", "8,32", "--warmed-buckets", "8,32", "--json",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["pipeline"]["ok"] is True
+    assert payload["xla_compiles"] == 0
+
+
+def test_check_without_flags_is_usage_error():
+    from argparse import Namespace
+
+    from keystone_tpu.lint.check import check_from_args
+
+    args = Namespace(
+        lint=None, pipeline=None, input_spec=None, buckets=None,
+        warmed_buckets=None, seed_mismatch=False, as_json=False,
+    )
+    assert check_from_args(args) == 2

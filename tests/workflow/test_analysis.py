@@ -1,0 +1,130 @@
+"""Graph analyses (workflow/analysis.py): cycle detection, iterative
+linearization, multi-consumer and sink-only edge cases."""
+
+import sys
+
+import pytest
+
+from keystone_tpu.workflow.analysis import (
+    GraphCycleError,
+    find_cycle,
+    get_ancestors,
+    linearize,
+    linearize_whole,
+)
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import Operator
+
+
+class Op(Operator):
+    def __init__(self, name):
+        self.name = name
+
+    @property
+    def label(self):
+        return self.name
+
+    def execute(self, deps):  # pragma: no cover - analyses never execute
+        raise AssertionError("analysis must not execute")
+
+
+def _chain_graph(n):
+    graph = Graph()
+    graph, src = graph.add_source()
+    prev = src
+    nodes = []
+    for i in range(n):
+        graph, node = graph.add_node(Op(f"op{i}"), [prev])
+        nodes.append(node)
+        prev = node
+    graph, sink = graph.add_sink(prev)
+    return graph, src, nodes, sink
+
+
+def test_acyclic_graph_has_no_cycle():
+    graph, _src, _nodes, _sink = _chain_graph(5)
+    assert find_cycle(graph) is None
+    order = linearize_whole(graph)
+    pos = {v: i for i, v in enumerate(order)}
+    for node in graph.nodes:
+        for dep in graph.get_dependencies(node):
+            assert pos[dep] < pos[node]
+
+
+def test_cycle_detected_with_exact_path():
+    graph, _src, nodes, _sink = _chain_graph(4)
+    cyclic = graph.set_dependencies(nodes[1], [nodes[3]])  # 1 ← 3: closes 1→2→3→1
+    cycle = find_cycle(cyclic)
+    assert cycle is not None
+    assert cycle[0] == cycle[-1]  # closed path
+    assert {nodes[1], nodes[2], nodes[3]} == set(cycle)
+    with pytest.raises(GraphCycleError) as err:
+        linearize_whole(cyclic)
+    assert "dependency cycle" in str(err.value)
+    assert err.value.cycle[0] == err.value.cycle[-1]
+
+
+def test_self_loop_detected():
+    graph, _src, nodes, _sink = _chain_graph(2)
+    cyclic = graph.set_dependencies(nodes[0], [nodes[0]])
+    cycle = find_cycle(cyclic)
+    assert cycle is not None and len(cycle) == 2
+    with pytest.raises(GraphCycleError):
+        linearize(cyclic, nodes[1])
+
+
+def test_cycle_unreachable_from_sinks_still_found():
+    """A cyclic island with no sink: sink-driven walks never see it, the
+    whole-graph walk must."""
+    graph, _src, _nodes, _sink = _chain_graph(2)
+    graph, a = graph.add_node(Op("a"), [])
+    graph, b = graph.add_node(Op("b"), [a])
+    cyclic = graph.set_dependencies(a, [b])
+    assert find_cycle(cyclic) is not None
+    with pytest.raises(GraphCycleError):
+        linearize_whole(cyclic)
+
+
+def test_multi_consumer_diamond_linearizes_once():
+    graph = Graph()
+    graph, src = graph.add_source()
+    graph, head = graph.add_node(Op("head"), [src])
+    graph, left = graph.add_node(Op("left"), [head])
+    graph, right = graph.add_node(Op("right"), [head])
+    graph, join = graph.add_node(Op("join"), [left, right])
+    graph, sink = graph.add_sink(join)
+    order = linearize_whole(graph)
+    assert len(order) == len(set(order))  # each vertex exactly once
+    pos = {v: i for i, v in enumerate(order)}
+    assert pos[head] < pos[left] and pos[head] < pos[right]
+    assert pos[left] < pos[join] and pos[right] < pos[join]
+    assert find_cycle(graph) is None
+
+
+def test_sink_only_graph_linearizes():
+    """A sink hanging directly off a source — no nodes at all."""
+    graph = Graph()
+    graph, src = graph.add_source()
+    graph, sink = graph.add_sink(src)
+    order = linearize_whole(graph)
+    assert order == [src, sink]
+    assert find_cycle(graph) is None
+
+
+def test_ancestors_of_multi_consumer_interior():
+    graph = Graph()
+    graph, src = graph.add_source()
+    graph, head = graph.add_node(Op("head"), [src])
+    graph, left = graph.add_node(Op("left"), [head])
+    graph, right = graph.add_node(Op("right"), [head])
+    assert get_ancestors(graph, left) == {src, head}
+    assert get_ancestors(graph, right) == {src, head}
+
+
+def test_deep_chain_beyond_recursion_limit():
+    """The old recursive linearize overflowed on deep chains; the
+    iterative DFS must not."""
+    depth = sys.getrecursionlimit() + 200
+    graph, _src, _nodes, _sink = _chain_graph(depth)
+    order = linearize_whole(graph)
+    assert len(order) == depth + 2  # source + nodes + sink

@@ -1,0 +1,592 @@
+"""Plan-time static verification (workflow/verify.py): diagnostics,
+spec propagation, enforcement modes, and the zero-compile guarantee."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs import names as _names
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.ops.learning.logistic import LogisticRegressionEstimator
+from keystone_tpu.utils.compilation_cache import install_compile_counter
+from keystone_tpu.workflow import BatchTransformer, Pipeline
+from keystone_tpu.workflow.analysis import GraphCycleError, linearize_whole
+from keystone_tpu.workflow.operators import EstimatorOperator
+from keystone_tpu.workflow.pipeline import Estimator
+from keystone_tpu.workflow.verify import (
+    CODES,
+    ERROR,
+    INFO,
+    WARNING,
+    SpecMismatch,
+    TransformerSpec,
+    VerificationError,
+    dense_fit_spec,
+    elementwise_fit_spec,
+    projection_fit_spec,
+    verification_mode,
+    verify_and_enforce,
+    verify_graph,
+    verify_pipeline,
+)
+
+
+class Scale(BatchTransformer):
+    def __init__(self, c=2.0):
+        self.c = float(c)
+
+    @property
+    def label(self):
+        return f"Scale[{self.c}]"
+
+    def apply_arrays(self, x):
+        return x * self.c
+
+
+class ScaleWithSpec(Scale):
+    """Same op, explicit out_spec — for fallback-parity assertions."""
+
+    def out_spec(self, in_specs):
+        spec = in_specs[0]
+        leaves = jax.tree_util.tree_leaves(spec)
+        if not leaves or not hasattr(leaves[0], "shape"):
+            from keystone_tpu.workflow.verify import UNKNOWN
+
+            return UNKNOWN
+        return spec
+
+
+class WidenToF64(BatchTransformer):
+    """Declares (via out_spec) that it emits float64 — the silent
+    widening hazard KV102 exists for."""
+
+    @property
+    def label(self):
+        return "WidenToF64"
+
+    def apply_arrays(self, x):  # pragma: no cover - never executed here
+        return x
+
+    def out_spec(self, in_specs):
+        leaf = jax.tree_util.tree_leaves(in_specs[0])[0]
+        return jax.ShapeDtypeStruct(tuple(leaf.shape), np.float64)
+
+
+class CustomBatch(BatchTransformer):
+    """Bespoke apply_batch → fusion-ineligible (KV201)."""
+
+    @property
+    def label(self):
+        return "CustomBatch"
+
+    def apply_arrays(self, x):
+        return x
+
+    def apply_batch(self, dataset):
+        return dataset
+
+
+class NoSpecEstimator(Estimator):
+    """An estimator family that has not adopted the out_spec protocol."""
+
+    @property
+    def label(self):
+        return "NoSpecEstimator"
+
+    def fit(self, data):  # pragma: no cover - never executed here
+        raise AssertionError("verification must not fit")
+
+
+def _xy(n=64, d=8, k=3, rows_y=None):
+    x = ArrayDataset(np.zeros((n, d), dtype=np.float32))
+    y = ArrayDataset(np.zeros((rows_y or n, k), dtype=np.float32))
+    return x, y
+
+
+def _codes(report):
+    return [d.code for d in report.diagnostics]
+
+
+# ------------------------------------------------------------- diagnostics
+
+
+def test_row_mismatch_is_kv101_error():
+    x, y = _xy(n=64, rows_y=32)
+    report = verify_pipeline(LinearMapEstimator().with_data(x, y))
+    kv101 = report.by_code("KV101")
+    assert len(kv101) == 1 and kv101[0].severity == ERROR
+    assert "64 rows" in kv101[0].message and "32 rows" in kv101[0].message
+    assert not report.ok
+
+
+def test_clean_pipeline_verifies_ok():
+    x, y = _xy()
+    report = verify_pipeline(LinearMapEstimator().with_data(x, y))
+    assert report.ok
+    assert not report.by_code("KV101")
+    # The fitted-transformer edge got a real spec, not UNKNOWN.
+    assert any("TransformerSpec" in a.spec for a in report.annotations)
+
+
+def test_eval_shape_fallback_catches_bad_width():
+    """A fusable apply_arrays chain with no out_spec still verifies via
+    jax.eval_shape — the planted-width CLI scenario."""
+    from keystone_tpu.serving.synthetic import synthetic_chain_pipeline
+
+    pipeline = synthetic_chain_pipeline(num_nodes=3, d=64)
+    bad = jax.ShapeDtypeStruct((16, 63), np.dtype("float32"))
+    report = verify_pipeline(pipeline, bad)
+    assert [d.code for d in report.errors()] == ["KV101"]
+    good = jax.ShapeDtypeStruct((16, 64), np.dtype("float32"))
+    assert verify_pipeline(pipeline, good).ok
+
+
+def test_eval_shape_fallback_matches_explicit_out_spec():
+    """Parity: the same op with and without out_spec annotates the same
+    propagated spec."""
+    spec = jax.ShapeDtypeStruct((32, 4), np.dtype("float32"))
+
+    def annotations(op):
+        pipe = op.to_pipeline()
+        report = verify_pipeline(pipe, spec)
+        assert report.ok
+        return [a.spec for a in report.annotations]
+
+    assert annotations(Scale(3.0)) == annotations(ScaleWithSpec(3.0))
+
+
+def test_float64_widening_is_kv102_warning():
+    pipe = Scale(1.0).to_pipeline().then(WidenToF64())
+    spec = jax.ShapeDtypeStruct((8, 4), np.dtype("float32"))
+    report = verify_pipeline(pipe, spec)
+    kv102 = report.by_code("KV102")
+    assert len(kv102) == 1 and kv102[0].severity == WARNING
+    assert report.ok  # warning, not error
+
+
+def test_no_widening_diag_when_input_already_f64():
+    pipe = WidenToF64().to_pipeline()
+    spec = jax.ShapeDtypeStruct((8, 4), np.dtype("float64"))
+    report = verify_pipeline(pipe, spec)
+    assert not report.by_code("KV102")
+
+
+def test_no_widening_diag_on_float64_source_data():
+    """A dataset that simply IS float64 widened nothing — KV102 must not
+    fire on zero-input source nodes (it used to)."""
+    x = ArrayDataset(np.zeros((16, 4), dtype=np.float64))
+    y = ArrayDataset(np.zeros((16, 2), dtype=np.float64))
+    report = verify_pipeline(LinearMapEstimator().with_data(x, y))
+    assert not report.by_code("KV102")
+
+
+def test_dense_fit_spec_carries_training_float64():
+    """An estimator fitted on float64 produces a float64 map even for
+    float32 apply inputs — the captured training dtype must participate
+    (a bare np.dtype used to be silently dropped)."""
+    f32, f64 = np.dtype("float32"), np.dtype("float64")
+    ts = dense_fit_spec(
+        [jax.ShapeDtypeStruct((10, 4), f64), jax.ShapeDtypeStruct((10, 2), f64)],
+        "T",
+    )
+    out = ts.apply_spec(jax.ShapeDtypeStruct((3, 4), f32))
+    assert out.dtype == f64
+
+
+def test_fusion_ineligibility_reasons():
+    from keystone_tpu.ops.util.misc import CacherOperator
+
+    pipe = Scale(2.0).to_pipeline().then(CustomBatch())
+    graph = pipe.graph
+    graph, cacher = graph.add_node(
+        CacherOperator(), [graph.get_sink_dependency(pipe.sink)]
+    )
+    graph = graph.set_sink_dependency(pipe.sink, cacher)
+    report = verify_graph(graph)
+    reasons = {d.details.get("reason") for d in report.by_code("KV201")}
+    assert "bespoke-apply" in reasons
+    assert "cacher-boundary" in reasons
+    assert all(d.severity == INFO for d in report.by_code("KV201"))
+
+
+def test_multi_consumer_interior_reported():
+    op = Scale(2.0)
+    pipe = op.to_pipeline()
+    graph = pipe.graph
+    head = graph.get_sink_dependency(pipe.sink)
+    graph, n2 = graph.add_node(Scale(3.0), [head])
+    graph, n3 = graph.add_node(Scale(4.0), [head])
+    graph, _s2 = graph.add_sink(n2)
+    graph, _s3 = graph.add_sink(n3)
+    report = verify_graph(graph)
+    reasons = {d.details.get("reason") for d in report.by_code("KV201")}
+    assert "multi-consumer" in reasons
+
+
+def test_streaming_ineligibility_reasons():
+    x, y = _xy(n=64)
+    report = verify_pipeline(LinearMapEstimator().with_data(x, y))
+    kv202 = report.by_code("KV202")
+    assert len(kv202) == 1
+    assert kv202[0].details["reason"] == "below-row-floor"
+
+    xl = ArrayDataset(np.zeros((64, 8), dtype=np.float32))
+    yl = ArrayDataset(np.zeros((64,), dtype=np.int32))
+    report = verify_pipeline(
+        LogisticRegressionEstimator(num_classes=3).with_data(xl, yl)
+    )
+    kv202 = report.by_code("KV202")
+    assert len(kv202) == 1
+    assert kv202[0].details["reason"] == "no-fit-stream"
+
+
+def test_bucket_mismatch_is_kv301_error():
+    from keystone_tpu.serving.synthetic import synthetic_chain_pipeline
+
+    pipeline = synthetic_chain_pipeline(num_nodes=2, d=64)
+    report = verify_pipeline(
+        pipeline, buckets=[8, 32], warmed_buckets=[8]
+    )
+    kv301 = report.by_code("KV301")
+    assert len(kv301) == 1 and kv301[0].severity == ERROR
+    assert kv301[0].details == {"missing": [32], "warmed": [8]}
+    assert verify_pipeline(
+        pipeline, buckets=[8, 32], warmed_buckets=[8, 32, 64]
+    ).ok
+
+
+def test_peak_memory_budget_is_kv302_warning():
+    x, y = _xy(n=4096, d=64)
+    report = verify_pipeline(
+        LinearMapEstimator().with_data(x, y), device_memory_bytes=10_000
+    )
+    kv302 = report.by_code("KV302")
+    assert len(kv302) == 1 and kv302[0].severity == WARNING
+    assert kv302[0].details["peak_bytes"] > 10_000
+    assert verify_pipeline(
+        LinearMapEstimator().with_data(x, y), device_memory_bytes=None
+    ).by_code("KV302") == []
+
+
+def test_gram_infeasibility_is_kv303():
+    from keystone_tpu.workflow.streaming import StreamingFitOperator
+
+    d = 4096
+    x = ArrayDataset(np.zeros((8, d), dtype=np.float32))
+    y = ArrayDataset(np.zeros((8, 4), dtype=np.float32))
+    pipe = LinearMapEstimator().with_data(x, y)
+    graph = pipe.graph
+    est_node = next(
+        n
+        for n in graph.nodes
+        if isinstance(graph.get_operator(n), EstimatorOperator)
+        and not hasattr(graph.get_operator(n), "dataset")
+    )
+    graph = graph.set_operator(
+        est_node,
+        StreamingFitOperator(graph.get_operator(est_node), members=()),
+    )
+    # gram state ~2*4*(d² + d·k) ≈ 134 MB >> 1 MB budget
+    report = verify_graph(graph, device_memory_bytes=1_000_000)
+    kv303 = report.by_code("KV303")
+    assert len(kv303) == 1
+    assert kv303[0].details["d"] == d
+    assert verify_graph(graph, device_memory_bytes=None).by_code("KV303") == []
+
+
+def test_cycle_is_kv401_and_linearize_raises():
+    pipe = Scale(2.0).to_pipeline().then(Scale(3.0)).then(Scale(4.0))
+    graph = pipe.graph
+    nodes = sorted(graph.nodes)
+    cyclic = graph.set_dependencies(nodes[0], [nodes[2]])
+    with pytest.raises(GraphCycleError) as err:
+        linearize_whole(cyclic)
+    assert len(err.value.cycle) >= 3  # closed path, first == last
+    assert err.value.cycle[0] == err.value.cycle[-1]
+    report = verify_graph(cyclic)
+    assert [d.code for d in report.errors()] == ["KV401"]
+    assert report.annotations == []  # propagation never ran
+
+
+def test_estimator_without_out_spec_is_kv402():
+    x = ArrayDataset(np.zeros((16, 4), dtype=np.float32))
+    report = verify_pipeline(NoSpecEstimator().with_data(x))
+    kv402 = [
+        d for d in report.by_code("KV402") if "NoSpecEstimator" in d.message
+    ]
+    assert len(kv402) == 1 and kv402[0].severity == INFO
+    assert report.ok
+
+
+def test_broken_out_spec_never_kills_planning():
+    class Broken(Scale):
+        def out_spec(self, in_specs):
+            raise RuntimeError("boom")
+
+    spec = jax.ShapeDtypeStruct((4, 4), np.dtype("float32"))
+    report = verify_pipeline(Broken(1.0).to_pipeline(), spec)
+    assert report.ok
+    assert any("out_spec failed" in d.message for d in report.by_code("KV402"))
+
+
+# -------------------------------------------------------- zero device work
+
+
+def test_verification_compiles_and_executes_nothing():
+    from keystone_tpu.serving.synthetic import synthetic_chain_pipeline
+
+    counter = install_compile_counter()
+    before = counter()
+    pipeline = synthetic_chain_pipeline(num_nodes=4, d=64)
+    x, y = _xy(n=128, rows_y=64)
+    bad_fit = LinearMapEstimator().with_data(x, y)
+    r1 = verify_pipeline(
+        pipeline, jax.ShapeDtypeStruct((16, 63), np.dtype("float32"))
+    )
+    r2 = verify_pipeline(bad_fit)
+    assert not r1.ok and not r2.ok
+    assert counter() - before == 0
+    assert r1.seconds < 1.0 and r2.seconds < 1.0
+
+
+# ------------------------------------------------------------- out_spec lib
+
+
+def test_dense_fit_spec_contract():
+    f32 = np.dtype("float32")
+    x = jax.ShapeDtypeStruct((100, 8), f32)
+    y = jax.ShapeDtypeStruct((100, 3), f32)
+    ts = dense_fit_spec([x, y], "T")
+    out = ts.apply_spec(jax.ShapeDtypeStruct((7, 8), f32))
+    assert tuple(out.shape) == (7, 3) and out.dtype == f32
+    with pytest.raises(SpecMismatch):
+        ts.apply_spec(jax.ShapeDtypeStruct((7, 9), f32))
+    with pytest.raises(SpecMismatch):
+        dense_fit_spec([jax.ShapeDtypeStruct((100,), f32), y], "T")
+    with pytest.raises(SpecMismatch):
+        dense_fit_spec([x, jax.ShapeDtypeStruct((99, 3), f32)], "T")
+
+
+def test_projection_and_elementwise_fit_specs():
+    f32 = np.dtype("float32")
+    stack = jax.ShapeDtypeStruct((10, 21, 128), f32)
+    ts = projection_fit_spec([stack], "PCA", dims=64)
+    out = ts.apply_spec(jax.ShapeDtypeStruct((5, 33, 128), f32))
+    assert tuple(out.shape) == (5, 33, 64)
+    with pytest.raises(SpecMismatch):
+        ts.apply_spec(jax.ShapeDtypeStruct((5, 127), f32))
+
+    flat = jax.ShapeDtypeStruct((10, 16), f32)
+    ts = elementwise_fit_spec([flat], "Scaler")
+    same = ts.apply_spec(jax.ShapeDtypeStruct((3, 16), f32))
+    assert tuple(same.shape) == (3, 16)
+    with pytest.raises(SpecMismatch):
+        ts.apply_spec(jax.ShapeDtypeStruct((3, 17), f32))
+
+
+def test_operator_family_out_specs():
+    """The protocol across the op families: each estimator's declared
+    fitted-transformer spec maps apply inputs correctly."""
+    from keystone_tpu.ops.learning.gmm import GaussianMixtureModelEstimator
+    from keystone_tpu.ops.learning.kmeans import KMeansPlusPlusEstimator
+    from keystone_tpu.ops.learning.pca import PCAEstimator
+    from keystone_tpu.ops.stats.core import StandardScaler
+
+    f32 = np.dtype("float32")
+    x = jax.ShapeDtypeStruct((100, 8), f32)
+    data = jax.ShapeDtypeStruct((7, 8), f32)
+
+    out = PCAEstimator(dims=3).out_spec([x]).apply_spec(data)
+    assert tuple(out.shape) == (7, 3)
+    out = (
+        KMeansPlusPlusEstimator(num_means=5, max_iterations=3)
+        .out_spec([x])
+        .apply_spec(data)
+    )
+    assert tuple(out.shape) == (7, 5)
+    out = GaussianMixtureModelEstimator(k=4).out_spec([x]).apply_spec(data)
+    assert tuple(out.shape) == (7, 4)
+    out = StandardScaler().out_spec([x]).apply_spec(data)
+    assert tuple(out.shape) == (7, 8)
+    with pytest.raises(SpecMismatch):
+        PCAEstimator(dims=3).out_spec([x]).apply_spec(
+            jax.ShapeDtypeStruct((7, 9), f32)
+        )
+
+
+def test_transformer_spec_unknown_fn_propagates_unknown():
+    from keystone_tpu.workflow.verify import UNKNOWN
+
+    assert TransformerSpec(None).apply_spec(object()) is UNKNOWN
+
+
+# ------------------------------------------------------------- enforcement
+
+
+def test_mode_parsing(monkeypatch):
+    for raw, want in [
+        ("", "warn"), ("warn", "warn"), ("strict", "strict"),
+        ("off", "off"), ("0", "off"), ("STRICT", "strict"),
+    ]:
+        monkeypatch.setenv("KEYSTONE_VERIFY", raw)
+        assert verification_mode() == want
+
+
+def test_enforce_warn_vs_strict_vs_off(monkeypatch):
+    x, y = _xy(n=64, rows_y=32)
+    graph = LinearMapEstimator().with_data(x, y).graph
+
+    monkeypatch.setenv("KEYSTONE_VERIFY", "warn")
+    report = verify_and_enforce(graph, context="t")
+    assert report is not None and not report.ok  # logged, not raised
+
+    monkeypatch.setenv("KEYSTONE_VERIFY", "strict")
+    with pytest.raises(VerificationError) as err:
+        verify_and_enforce(graph, context="t")
+    assert "KV101" in str(err.value)
+
+    monkeypatch.setenv("KEYSTONE_VERIFY", "off")
+    assert verify_and_enforce(graph, context="t") is None
+
+
+def test_strict_mode_raises_at_fit(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_VERIFY", "strict")
+    x, y = _xy(n=64, rows_y=32)
+    with pytest.raises(VerificationError):
+        LinearMapEstimator().with_data(x, y).fit()
+
+
+def test_fit_proceeds_under_warn(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_VERIFY", "warn")
+    rng = np.random.default_rng(0)
+    x = ArrayDataset(rng.standard_normal((64, 4)).astype(np.float32))
+    y = ArrayDataset(rng.standard_normal((64, 2)).astype(np.float32))
+    fitted = LinearMapEstimator().with_data(x, y).fit()
+    out = fitted.apply(np.zeros((5, 4), dtype=np.float32))
+    assert np.asarray(out).shape == (5, 2)
+
+
+def test_internal_verifier_failure_is_swallowed(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_VERIFY", "strict")
+    import keystone_tpu.workflow.verify as verify_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("verifier bug")
+
+    monkeypatch.setattr(verify_mod, "verify_graph", boom)
+    x, y = _xy(n=16, d=4, k=2)
+    graph = LinearMapEstimator().with_data(x, y).graph
+    assert verify_mod.verify_and_enforce(graph, context="t") is None
+
+
+def test_strict_load_fitted_raises_on_bucket_mismatch(tmp_path, monkeypatch):
+    from keystone_tpu.serving.registry import ModelRegistry
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    path = str(tmp_path / "model")
+    synthetic_fitted_pipeline(d=16, depth=1).save(path)
+
+    registry = ModelRegistry()
+    monkeypatch.setenv("KEYSTONE_VERIFY", "strict")
+    with pytest.raises(VerificationError):
+        registry.load_fitted(
+            "m", path, buckets=[8, 32], warmed_buckets=[8]
+        )
+    # Same artifact with a warmed set that covers the plan publishes.
+    entry = registry.load_fitted(
+        "m", path, buckets=[8, 32], warmed_buckets=[8, 32]
+    )
+    assert entry is not None
+
+
+def test_load_fitted_unconvertible_example_still_publishes(tmp_path, monkeypatch):
+    """Spec-building from a weird example must degrade to an unbound
+    verify, never crash publication (the warn contract)."""
+    from keystone_tpu.serving.registry import ModelRegistry
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    path = str(tmp_path / "model")
+    synthetic_fitted_pipeline(d=16, depth=1).save(path)
+    monkeypatch.setenv("KEYSTONE_VERIFY", "warn")
+
+    class Unconvertible:
+        def __array__(self, *a, **k):
+            raise ValueError("no dice")
+
+    entry = ModelRegistry().load_fitted("m", path, example=Unconvertible())
+    assert entry is not None
+
+
+def test_load_fitted_example_reads_dtype_from_metadata(tmp_path, monkeypatch):
+    """A device-like example leaf must never be materialized host-side
+    just to read its dtype."""
+    from keystone_tpu.serving.registry import ModelRegistry
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    path = str(tmp_path / "model")
+    synthetic_fitted_pipeline(d=16, depth=1).save(path)
+    monkeypatch.setenv("KEYSTONE_VERIFY", "warn")
+
+    class DeviceLeaf:
+        shape = (16,)
+        dtype = np.dtype("float32")
+
+        def __array__(self, *a, **k):  # pragma: no cover - must not run
+            raise AssertionError("host copy just to read metadata")
+
+    entry = ModelRegistry().load_fitted("m", path, example=DeviceLeaf())
+    assert entry is not None
+
+
+# ------------------------------------------------------------------ metrics
+
+
+def test_verify_publishes_metrics():
+    runs = _names.metric(_names.VERIFY_RUNS)
+    diags = _names.metric(_names.VERIFY_DIAGNOSTICS)
+    before_runs = runs.value(context="metrics-test")
+    before_diag = diags.value(code="KV101", severity=ERROR)
+    x, y = _xy(n=64, rows_y=32)
+    verify_pipeline(
+        LinearMapEstimator().with_data(x, y), context="metrics-test"
+    )
+    assert runs.value(context="metrics-test") == before_runs + 1
+    assert diags.value(code="KV101", severity=ERROR) == before_diag + 1
+
+
+def test_every_code_has_severity_and_title():
+    for code, (severity, title) in CODES.items():
+        assert severity in (ERROR, WARNING, INFO)
+        assert title
+        assert code.startswith("KV") and code[2:].isdigit()
+
+
+def test_docs_codes_sync():
+    """Every diagnostic code — verifier KV1xx-4xx and lint KV5xx — is
+    documented in docs/VERIFICATION.md, or this fails."""
+    import os
+
+    from keystone_tpu.lint import LINT_CODES
+
+    doc = open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "VERIFICATION.md"
+        )
+    ).read()
+    missing = [
+        code
+        for code in list(CODES) + list(LINT_CODES) + ["KV500"]
+        if f"`{code}`" not in doc
+    ]
+    assert not missing, f"codes undocumented in docs/VERIFICATION.md: {missing}"
+
+
+def test_report_json_roundtrip():
+    x, y = _xy(n=64, rows_y=32)
+    report = verify_pipeline(LinearMapEstimator().with_data(x, y))
+    payload = report.to_json()
+    assert payload["ok"] is False
+    assert any(d["code"] == "KV101" for d in payload["diagnostics"])
+    assert all({"node", "label", "spec"} <= set(n) for n in payload["nodes"])
