@@ -256,7 +256,8 @@ def main(argv: Optional[list] = None) -> int:
 
     check_parser = sub.add_parser(
         "check",
-        help="static checks: --lint the codebase, --pipeline verify a plan graph",
+        help="static checks: --lint the codebase, --concurrency the lock "
+        "discipline, --pipeline verify a plan graph",
     )
     add_check_arguments(check_parser)
 
@@ -272,7 +273,10 @@ def main(argv: Optional[list] = None) -> int:
         print(f"{'serve':28s} online serving front-end (micro-batched, stdin/JSON)")
         print(f"{'profile':28s} instrumented run → Chrome trace + Prometheus snapshot")
         print(f"{'bench-diff':28s} compare two BENCH json artifacts, fail on regression")
-        print(f"{'check':28s} static tier: keystone-lint + plan-time graph verification")
+        print(
+            f"{'check':28s} static tier: keystone-lint + concurrency "
+            "analysis + plan-time graph verification"
+        )
         return 0
 
     # Multi-host launch (bin/launch-pod.sh sets KEYSTONE_DISTRIBUTED=1;

@@ -4,10 +4,27 @@ The repo half of the static tier (the graph half is
 workflow/verify.py): stdlib-``ast`` rules encoding the invariants our
 runtime layers depend on — call-time env reads, sync-free hot paths,
 declared metric names, registered probe sites, annotated buffer
-donation. ``keystone-tpu check --lint`` runs them; tier-1 CI keeps the
-tree clean. See docs/VERIFICATION.md.
+donation (KV5xx, :mod:`.rules`) — plus the concurrency tier (KV6xx,
+:mod:`.concurrency` over the :mod:`.lockmodel` lock model): inferred
+lock discipline, deadlock-order cycles, blocking-under-lock, and
+thread/future hygiene, cross-checked at test time by the instrumented
+lock witness (:mod:`.lockwitness`). ``keystone-tpu check --lint
+--concurrency`` runs them; tier-1 CI keeps the tree clean. See
+docs/VERIFICATION.md.
 """
 
+from .concurrency import (
+    ALLOW_BLOCK_UNDER_LOCK,
+    ALLOW_LOCK_ORDER,
+    ALLOW_SETTLE,
+    ALLOW_UNGUARDED,
+    ALLOW_UNJOINED,
+    CONCURRENCY_CODES,
+    analyze_model,
+    analyze_paths,
+    analyze_sources,
+)
+from .lockmodel import LockModel, build_model, build_model_from_sources
 from .rules import (
     ALLOW_ENV,
     ALLOW_SYNC,
@@ -21,13 +38,25 @@ from .rules import (
 )
 
 __all__ = [
+    "ALLOW_BLOCK_UNDER_LOCK",
     "ALLOW_ENV",
+    "ALLOW_LOCK_ORDER",
+    "ALLOW_SETTLE",
     "ALLOW_SYNC",
+    "ALLOW_UNGUARDED",
+    "ALLOW_UNJOINED",
+    "CONCURRENCY_CODES",
     "LINT_CODES",
+    "LockModel",
     "OWNS_DONATED",
     "Finding",
     "LintContext",
+    "analyze_model",
+    "analyze_paths",
+    "analyze_sources",
     "build_context",
+    "build_model",
+    "build_model_from_sources",
     "lint_paths",
     "lint_source",
 ]

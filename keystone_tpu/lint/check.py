@@ -1,12 +1,22 @@
 """``keystone-tpu check`` — the static-tier CLI.
 
-Two halves, composable in one invocation (docs/VERIFICATION.md):
+Three halves, composable in one invocation (docs/VERIFICATION.md):
 
 ``--lint [PATH ...]``
     Run keystone-lint (lint/rules.py, stdlib ``ast``) over source trees
     (default: the installed ``keystone_tpu`` package). Any finding fails
     the run; tier-1 CI keeps the shipped tree clean
     (scripts/check_smoke.sh).
+
+``--concurrency [PATH ...]``
+    Run the concurrency tier (lint/concurrency.py over the
+    lint/lockmodel.py lock model): KV6xx findings — unlocked
+    majority-guarded writes, lock-order cycles, blocking under a lock,
+    thread/future hygiene — plus the full acquired-while-holding lock
+    graph in ``--json`` output (the lock-witness baseline is generated
+    from it). Stdlib-only and jax-free like ``--lint``; the JSON carries
+    ``jax_free`` so CI can assert no backend was paid for a pure static
+    pass.
 
 ``--pipeline PATH|synthetic``
     Plan-time graph verification (workflow/verify.py) of a saved
@@ -38,6 +48,14 @@ def add_check_arguments(parser: argparse.ArgumentParser) -> None:
         metavar="PATH",
         default=None,
         help="lint source trees (no PATH: the keystone_tpu package)",
+    )
+    parser.add_argument(
+        "--concurrency",
+        nargs="*",
+        metavar="PATH",
+        default=None,
+        help="concurrency analysis: KV6xx lock-discipline/deadlock-order "
+        "findings + the lock-order graph (no PATH: the keystone_tpu package)",
     )
     parser.add_argument(
         "--pipeline",
@@ -96,8 +114,11 @@ def check_from_args(args: argparse.Namespace) -> int:
     human: List[str] = []
     ok = True
 
-    if args.lint is None and args.pipeline is None:
-        print("keystone-tpu check: nothing to do (pass --lint and/or --pipeline)")
+    if args.lint is None and args.pipeline is None and args.concurrency is None:
+        print(
+            "keystone-tpu check: nothing to do "
+            "(pass --lint, --concurrency, and/or --pipeline)"
+        )
         return 2
 
     if args.lint is not None:
@@ -114,6 +135,39 @@ def check_from_args(args: argparse.Namespace) -> int:
         }
         human.append(
             f"lint[{', '.join(paths)}]: {len(findings)} findings"
+        )
+        human += ["  " + f.render() for f in findings]
+        ok = ok and not findings
+
+    if args.concurrency is not None:
+        import os
+        import sys
+        import time
+
+        import keystone_tpu
+
+        from .concurrency import analyze_paths as analyze_concurrency
+
+        paths = list(args.concurrency) or [
+            os.path.dirname(keystone_tpu.__file__)
+        ]
+        t0 = time.perf_counter()
+        findings, model = analyze_concurrency(paths)
+        seconds = time.perf_counter() - t0
+        out["concurrency"] = {
+            "paths": paths,
+            "findings": [f.to_json() for f in findings],
+            "lock_graph": model.to_json(),
+            "seconds": round(seconds, 4),
+            # Pure static pass: CI asserts no jax backend was imported
+            # (the concurrency analog of --pipeline's xla_compiles == 0).
+            "jax_free": "jax" not in sys.modules,
+            "ok": not findings,
+        }
+        human.append(
+            f"concurrency[{', '.join(paths)}]: {len(findings)} findings, "
+            f"{len(model.locks)} locks, {len(model.edges)} order edges, "
+            f"{seconds * 1e3:.0f} ms"
         )
         human += ["  " + f.render() for f in findings]
         ok = ok and not findings

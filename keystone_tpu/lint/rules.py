@@ -46,6 +46,8 @@ import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set
 
+from ..diagnostics import ERROR, Diagnostic
+
 ALLOW_ENV = "keystone: allow-env"
 ALLOW_SYNC = "keystone: allow-sync"
 OWNS_DONATED = "keystone: owns-donated"
@@ -84,23 +86,45 @@ LINT_CODES: Dict[str, str] = {
 }
 
 
-@dataclass
-class Finding:
-    rule: str
-    path: str
-    line: int
-    message: str
+class Finding(Diagnostic):
+    """One lint/concurrency finding — the source-located face of the
+    shared :class:`keystone_tpu.diagnostics.Diagnostic` (one reporting
+    path for verify, lint, and concurrency). Keeps the legacy
+    ``Finding(rule, path, line, message)`` signature and JSON shape the
+    check CLI/CI contracts were built on; ``rule`` aliases ``code``."""
 
-    def render(self) -> str:
-        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+    def __init__(
+        self,
+        rule: str,
+        path: str,
+        line: int,
+        message: str,
+        severity: str = ERROR,
+        details: Optional[Dict[str, object]] = None,
+    ):
+        super().__init__(
+            code=rule,
+            severity=severity,
+            message=message,
+            path=path,
+            line=line,
+            details=dict(details or {}),
+        )
+
+    @property
+    def rule(self) -> str:
+        return self.code
 
     def to_json(self) -> Dict[str, object]:
-        return {
-            "rule": self.rule,
+        out: Dict[str, object] = {
+            "rule": self.code,
             "path": self.path,
             "line": self.line,
             "message": self.message,
         }
+        if self.details:
+            out["details"] = self.details
+        return out
 
 
 @dataclass

@@ -315,7 +315,11 @@ class ProfileStore:
             with self._flock():
                 with open(self.path, "a") as f:
                     f.write(line + "\n")
-            self.writes += 1
+            with self._lock:
+                # Stat counters share the state lock: record()/lookup()
+                # run from serving and streaming threads concurrently,
+                # and an unlocked += drops counts (KV601 discipline).
+                self.writes += 1
             _counter(_names.PROFILE_STORE_WRITES).inc()
             _names.metric(_names.PROFILE_STORE_ENTRIES).set(len(self._entries))
             if need_compact:
@@ -379,21 +383,34 @@ class ProfileStore:
         or None. Entries whose environment fingerprint no longer matches
         are invalidated (counted), never returned."""
         backend = backend or self.fingerprint()["backend"]
+        fingerprint = self.fingerprint()
+        # One critical section covers the fetch AND its stat counter:
+        # record()/lookup() run from serving and streaming threads
+        # concurrently, and an unlocked += drops counts (KV601
+        # discipline); splitting fetch from count would let a stats()
+        # snapshot see them inconsistent.
         with self._lock:
             rec = self._entries.get((key, shape, backend))
-        if rec is None:
-            self.misses += 1
+            if rec is None:
+                self.misses += 1
+                outcome = "miss"
+            elif rec.get("fp") != fingerprint:
+                self.invalidations += 1
+                self.misses += 1
+                outcome = "invalidated"
+            else:
+                self.hits += 1
+                outcome = "hit"
+                measurements = dict(rec.get("m", {}))
+        if outcome == "miss":
             _counter(_names.PROFILE_STORE_MISSES).inc()
             return None
-        if rec.get("fp") != self.fingerprint():
-            self.invalidations += 1
+        if outcome == "invalidated":
             _counter(_names.PROFILE_STORE_INVALIDATIONS).inc()
-            self.misses += 1
             _counter(_names.PROFILE_STORE_MISSES).inc()
             return None
-        self.hits += 1
         _counter(_names.PROFILE_STORE_HITS).inc()
-        return dict(rec.get("m", {}))
+        return measurements
 
     def entries(
         self,
@@ -426,14 +443,15 @@ class ProfileStore:
             return len(self._entries)
 
     def stats(self) -> Dict[str, Any]:
-        return {
-            "path": self.path,
-            "entries": len(self),
-            "hits": self.hits,
-            "misses": self.misses,
-            "writes": self.writes,
-            "invalidations": self.invalidations,
-        }
+        with self._lock:
+            return {
+                "path": self.path,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "writes": self.writes,
+                "invalidations": self.invalidations,
+            }
 
 
 # ---------------------------------------------------------- process singleton

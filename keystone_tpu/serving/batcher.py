@@ -25,7 +25,11 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
-from .config import Request, RequestTimeout
+from .config import (
+    Request,
+    RequestTimeout,
+    settle_exception as _settle_exception,
+)
 
 
 class MicroBatcher:
@@ -74,12 +78,12 @@ class MicroBatcher:
             req = self._items.popleft()
             if req.expired():
                 self.expired += 1
-                try:  # tolerate futures already settled by shutdown races
-                    req.future.set_exception(
-                        RequestTimeout(f"expired in queue (request {req.request_id})")
-                    )
-                except Exception:
-                    pass
+                # settle-once helper: tolerate futures already settled by
+                # shutdown races (keystone-lint KV605).
+                _settle_exception(
+                    req.future,
+                    RequestTimeout(f"expired in queue (request {req.request_id})"),
+                )
                 if self._on_expired is not None:
                     self._on_expired(req)
             else:
@@ -144,8 +148,5 @@ class MicroBatcher:
         with self._not_empty:
             n = len(self._items)
             while self._items:
-                try:  # tolerate futures already settled by shutdown races
-                    self._items.popleft().future.set_exception(exc)
-                except Exception:
-                    pass
+                _settle_exception(self._items.popleft().future, exc)
         return n

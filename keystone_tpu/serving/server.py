@@ -195,7 +195,7 @@ class PipelineServer:
                 futures.append(self.submit(payload, deadline_s=deadline_s, model=model))
             except (RequestShed, ServerClosed) as exc:
                 f: Future = Future()
-                f.set_exception(exc)
+                _settle_exception(f, exc)
                 futures.append(f)
         return futures
 

@@ -591,7 +591,7 @@ class WorkerSupervisor:
                 )
             except (RequestShed, ServerClosed) as exc:
                 f: Future = Future()
-                f.set_exception(exc)
+                _settle_exception(f, exc)
                 futures.append(f)
         return futures
 

@@ -28,8 +28,10 @@ KV402     info      node not statically analyzable (no ``out_spec``,
                     not eval_shape-able) — propagation continues unknown
 ========  ========  ====================================================
 
-(Lint-rule codes KV501-KV505 live in ``keystone_tpu/lint/rules.py``;
-docs/VERIFICATION.md documents the whole table.)
+(Lint-rule codes KV501-KV505 live in ``keystone_tpu/lint/rules.py``,
+concurrency codes KV601-KV605 in ``keystone_tpu/lint/concurrency.py``;
+all three tiers emit the shared :class:`keystone_tpu.diagnostics.
+Diagnostic`, and docs/VERIFICATION.md documents the whole table.)
 
 The ``out_spec`` protocol
 -------------------------
@@ -63,6 +65,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..diagnostics import ERROR, INFO, WARNING, Diagnostic
 from ..envknobs import env_str
 from ..obs import names as _names
 from .analysis import GraphCycleError, linearize_whole
@@ -78,10 +81,6 @@ from .operators import (
 )
 
 logger = logging.getLogger(__name__)
-
-ERROR = "error"
-WARNING = "warning"
-INFO = "info"
 
 #: code → (default severity, short title). docs/VERIFICATION.md documents
 #: every row; tests/workflow/test_verify.py enforces the sync.
@@ -112,31 +111,6 @@ class SpecMismatch(Exception):
     """Raised by ``out_spec``/``apply_spec`` when an input spec is one
     the operator can never accept (wrong rank, wrong width, row-count
     disagreement). Becomes a KV101 error diagnostic."""
-
-
-@dataclass
-class Diagnostic:
-    code: str
-    severity: str
-    message: str
-    node: Optional[str] = None
-    details: Dict[str, Any] = field(default_factory=dict)
-
-    def to_json(self) -> Dict[str, Any]:
-        out = {
-            "code": self.code,
-            "severity": self.severity,
-            "message": self.message,
-        }
-        if self.node is not None:
-            out["node"] = self.node
-        if self.details:
-            out["details"] = self.details
-        return out
-
-    def render(self) -> str:
-        where = f" [{self.node}]" if self.node else ""
-        return f"{self.code} {self.severity}{where}: {self.message}"
 
 
 @dataclass

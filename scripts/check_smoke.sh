@@ -1,15 +1,19 @@
 #!/usr/bin/env bash
-# Static-tier smoke (docs/VERIFICATION.md): the two CI contracts of
+# Static-tier smoke (docs/VERIFICATION.md): the CI contracts of
 # `keystone-tpu check`.
 #
-#   1. --lint over the shipped keystone_tpu/ tree is CLEAN (exit 0,
-#      zero findings) — a new finding means fix the code or annotate
-#      the reviewed exception.
+#   1. --lint AND --concurrency over the shipped keystone_tpu/ tree are
+#      CLEAN in one invocation (exit 0, zero KV5xx findings, zero KV6xx
+#      findings in the same --json payload) — a new finding means fix
+#      the code or annotate the reviewed exception.
 #   2. --pipeline catches a deliberately seeded shape mismatch (KV101)
 #      AND a seeded serving bucket mismatch (KV301) at plan time, exits
 #      nonzero, with ZERO XLA compiles (the compile counter stays 0 —
 #      pure spec propagation, no data touches a device) and the
 #      verification pass itself under 1s.
+#   3. --concurrency catches the seeded lock-order cycle + unlocked
+#      guarded write fixture (tests/fixtures/concurrency_seeded.py):
+#      exit nonzero with KV601+KV602, under 1s, jax-free.
 #
 # A verifier that stops flagging the planted errors fails THIS smoke,
 # not a user's fit.
@@ -18,16 +22,45 @@ cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 
-# ---- 1. keystone-lint: shipped tree must be clean -----------------------
-timeout -k 10 120 python -m keystone_tpu check --lint keystone_tpu --json \
-  > /tmp/check_lint.json
+# ---- 1. keystone-lint + concurrency: shipped tree must be clean ---------
+timeout -k 10 120 python -m keystone_tpu check --lint keystone_tpu \
+  --concurrency keystone_tpu --json > /tmp/check_lint.json
 python - <<'EOF'
 import json
 
 payload = json.load(open("/tmp/check_lint.json"))
 assert payload["ok"] is True, payload
 assert payload["lint"]["findings"] == [], payload["lint"]["findings"]
-print("check_smoke lint OK: 0 findings over keystone_tpu/")
+conc = payload["concurrency"]
+assert conc["findings"] == [], conc["findings"]
+assert conc["lock_graph"]["locks"], "lock model saw no locks — model broken"
+print(
+    "check_smoke lint+concurrency OK: 0 findings over keystone_tpu/ "
+    f"({len(conc['lock_graph']['locks'])} locks, "
+    f"{len(conc['lock_graph']['edges'])} order edges)"
+)
+EOF
+
+# ---- 1b. seeded concurrency fixture must be caught, jax-free ------------
+rc=0
+timeout -k 10 120 python -m keystone_tpu check \
+  --concurrency tests/fixtures/concurrency_seeded.py --json \
+  > /tmp/check_concurrency_seeded.json || rc=$?
+test "$rc" -eq 1 || { echo "seeded concurrency check exited $rc, want 1"; exit 1; }
+python - <<'EOF'
+import json
+
+payload = json.load(open("/tmp/check_concurrency_seeded.json"))
+conc = payload["concurrency"]
+codes = {f["rule"] for f in conc["findings"]}
+assert "KV601" in codes, f"seeded unlocked guarded write not flagged: {codes}"
+assert "KV602" in codes, f"seeded lock-order cycle not flagged: {codes}"
+assert conc["jax_free"] is True, "concurrency analysis imported jax"
+assert conc["seconds"] < 1.0, f"analysis took {conc['seconds']}s, want <1s"
+print(
+    "check_smoke concurrency OK: KV601+KV602 caught in "
+    f"{conc['seconds'] * 1e3:.0f} ms, jax-free"
+)
 EOF
 
 # ---- 2. seeded mismatches must be caught, with zero compiles ------------

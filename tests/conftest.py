@@ -41,3 +41,63 @@ def _reset_pipeline_env():
     PipelineEnv.reset()
     yield
     PipelineEnv.reset()
+
+
+# --------------------------------------------------------------- lock witness
+#
+# KEYSTONE_LOCK_WITNESS=1 wraps every test in the instrumented-lock
+# witness (keystone_tpu/lint/lockwitness.py): locks the test constructs
+# record their acquisition orders, and an observed edge between two
+# model-known locks that is ABSENT from the static lock-order graph
+# fails the test — the static model and the runtime cannot drift.
+# KEYSTONE_LOCK_WITNESS=record only records (used to regenerate
+# lint/lockorder_baseline.json); KEYSTONE_LOCK_WITNESS_OUT appends each
+# test's observed edges as JSON lines for the baseline merge.
+
+_witness_model = None
+
+
+def _witness_static():
+    global _witness_model
+    if _witness_model is None:
+        import keystone_tpu
+        from keystone_tpu.lint.lockmodel import build_model
+
+        _witness_model = build_model([os.path.dirname(keystone_tpu.__file__)])
+    return _witness_model
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness_fixture(request):
+    from keystone_tpu.lint.lockwitness import witness_enabled
+
+    if not witness_enabled():
+        yield
+        return
+    import json
+
+    from keystone_tpu.lint.lockwitness import lock_witness, witness_mode
+
+    model = _witness_static()
+    with lock_witness(site_names=model.alloc_sites()) as witness:
+        yield
+    observed = witness.observed_edges()
+    out_path = os.environ.get("KEYSTONE_LOCK_WITNESS_OUT")
+    if out_path and observed:
+        with open(out_path, "a") as fh:
+            fh.write(
+                json.dumps(
+                    {
+                        "test": request.node.nodeid,
+                        "edges": sorted(list(e) for e in observed),
+                    }
+                )
+                + "\n"
+            )
+    if witness_mode() == "check":
+        unknown = witness.unknown_edges(model.edge_pairs())
+        assert not unknown, (
+            "lock witness observed acquisition edges missing from the "
+            f"static lock-order graph: {unknown} — extend the model "
+            "(lint/lockmodel.py) or fix the locking"
+        )

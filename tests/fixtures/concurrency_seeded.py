@@ -1,0 +1,64 @@
+"""Seeded concurrency bugs — the CI negative control for KV6xx.
+
+``scripts/check_smoke.sh`` runs ``keystone-tpu check --concurrency``
+over this file and REQUIRES it to fail with KV601 (the unlocked guarded
+write in ``Telemetry._loop``) and KV602 (the ``Gate``/``Ledger``
+lock-order cycle). An analyzer that stops flagging these planted bugs
+fails the smoke, not a user. Never "fix" this file.
+"""
+
+import threading
+
+
+class Telemetry:
+    """KV601 seed: ``_served`` is lock-guarded everywhere except the
+    mutation on the worker thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._served = 0
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self._served += 1  # planted: majority-guarded, mutated unlocked
+
+    def record(self):
+        with self._lock:
+            self._served += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self._served
+
+
+class Gate:
+    """KV602 seed, half one: holds its lock while poking the ledger."""
+
+    def __init__(self, ledger: "Ledger"):
+        self._lock = threading.Lock()
+        self._ledger = ledger
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def admit(self):
+        with self._lock:
+            self._ledger.poke()  # planted: Gate._lock held -> Ledger._lock
+
+
+class Ledger:
+    """KV602 seed, half two: the opposite order."""
+
+    def __init__(self, gate: Gate):
+        self._lock = threading.Lock()
+        self._gate = gate
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def record(self):
+        with self._lock:
+            self._gate.poke()  # planted: Ledger._lock held -> Gate._lock

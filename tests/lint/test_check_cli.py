@@ -31,6 +31,7 @@ def test_check_help_is_jax_free():
     )
     assert proc.returncode == 0, proc.stderr
     assert "--pipeline" in proc.stdout and "--lint" in proc.stdout
+    assert "--concurrency" in proc.stdout
 
 
 @pytest.mark.slow
@@ -68,13 +69,47 @@ def test_check_pipeline_clean_synthetic_passes():
     assert payload["xla_compiles"] == 0
 
 
+def test_check_concurrency_seeded_fixture_jax_free():
+    """The smoke's concurrency contract end-to-end: the seeded fixture
+    (lock-order cycle + unlocked guarded write) exits 1 with KV601+KV602,
+    fast, without importing jax."""
+    proc = run_check(
+        "--concurrency",
+        os.path.join("tests", "fixtures", "concurrency_seeded.py"),
+        "--json",
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    conc = payload["concurrency"]
+    codes = {f["rule"] for f in conc["findings"]}
+    assert {"KV601", "KV602"} <= codes
+    assert conc["jax_free"] is True
+    assert conc["seconds"] < 1.0
+
+
+@pytest.mark.slow
+def test_check_lint_and_concurrency_shipped_tree_one_payload():
+    """KV5xx and KV6xx findings ride one --json payload (both clean on
+    the shipped tree), and the lock graph is exported for the witness."""
+    proc = run_check(
+        "--lint", "keystone_tpu", "--concurrency", "keystone_tpu", "--json"
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert payload["lint"]["findings"] == []
+    assert payload["concurrency"]["findings"] == []
+    graph = payload["concurrency"]["lock_graph"]
+    assert len(graph["locks"]) >= 25
+    assert graph["edges"]
+
+
 def test_check_without_flags_is_usage_error():
     from argparse import Namespace
 
     from keystone_tpu.lint.check import check_from_args
 
     args = Namespace(
-        lint=None, pipeline=None, input_spec=None, buckets=None,
-        warmed_buckets=None, seed_mismatch=False, as_json=False,
+        lint=None, concurrency=None, pipeline=None, input_spec=None,
+        buckets=None, warmed_buckets=None, seed_mismatch=False, as_json=False,
     )
     assert check_from_args(args) == 2

@@ -1,0 +1,702 @@
+"""Concurrency tier (lint/lockmodel.py + lint/concurrency.py): KV6xx
+rules on fixture snippets, the model's inference machinery, and the
+shipped-tree cleanliness gate CI relies on."""
+
+import os
+import textwrap
+
+import pytest
+
+from keystone_tpu.lint import (
+    CONCURRENCY_CODES,
+    analyze_paths,
+    analyze_sources,
+    build_model,
+)
+from keystone_tpu.lint.lockmodel import CALLBACK
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SEEDED = os.path.join(REPO, "tests", "fixtures", "concurrency_seeded.py")
+
+
+def codes(sources):
+    if isinstance(sources, str):
+        sources = {"mod.py": textwrap.dedent(sources)}
+    findings, _model = analyze_sources(
+        {k: textwrap.dedent(v) for k, v in sources.items()}
+    )
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------- KV601
+
+GUARDED = """
+    import threading
+
+    class Telemetry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._served = 0
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def _loop(self):
+            while True:
+                self._served += 1{pragma}
+
+        def record(self):
+            with self._lock:
+                self._served += 1
+
+        def snapshot(self):
+            with self._lock:
+                return self._served
+"""
+
+
+def test_unlocked_guarded_write_flagged():
+    findings, _ = analyze_sources(
+        {"mod.py": textwrap.dedent(GUARDED.format(pragma=""))}
+    )
+    assert [f.rule for f in findings] == ["KV601"]
+    f = findings[0]
+    assert f.details["guard"].endswith("Telemetry._lock")
+    assert f.details["thread_reachable"] is True
+
+
+def test_unlocked_guarded_write_pragma():
+    assert codes(
+        GUARDED.format(pragma="  # reviewed  # keystone: allow-unguarded(benign)")
+    ) == []
+
+
+def test_unguarded_attr_not_flagged():
+    # No majority guard inferred -> no KV601 (unlocked everywhere is a
+    # different bug class the rule deliberately does not guess at).
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                self._n += 1
+
+            def read(self):
+                return self._n
+        """
+    ) == []
+
+
+def test_reads_outside_lock_are_snapshot_idiom():
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+
+            def other(self):
+                with self._lock:
+                    self._n = 0
+
+            def read_racy_snapshot(self):
+                return self._n
+        """
+    ) == []
+
+
+def test_locked_suffix_methods_inherit_callers_held_set():
+    # The house convention: *_locked helpers run with the caller's lock.
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def _drain_locked(self):
+                self._items.clear()
+
+            def use(self):
+                with self._lock:
+                    self._items.append(1)
+                    self._drain_locked()
+
+            def use2(self):
+                with self._lock:
+                    self._drain_locked()
+        """
+    ) == []
+
+
+def test_condition_counts_as_its_wrapped_lock():
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._items = []
+
+            def put(self):
+                with self._cond:
+                    self._items.append(1)
+
+            def also(self):
+                with self._lock:
+                    self._items.append(2)
+
+            def peek(self):
+                with self._cond:
+                    return len(self._items)
+        """
+    ) == []
+
+
+def test_init_writes_never_flagged():
+    src = GUARDED.format(pragma="")
+    # __init__ writes self._served = 0 unlocked; only _loop is flagged.
+    findings, _ = analyze_sources({"mod.py": textwrap.dedent(src)})
+    assert all("__init__" not in f.details["func"] for f in findings)
+
+
+# ------------------------------------------------------------------- KV602
+
+CYCLE = """
+    import threading
+
+    class A:
+        def __init__(self, b: "B"):
+            self._lock = threading.Lock()
+            self._b = b
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def cross(self):
+            with self._lock:
+                self._b.poke(){pragma}
+
+    class B:
+        def __init__(self, a: A):
+            self._lock = threading.Lock()
+            self._a = a
+
+        def poke(self):
+            with self._lock:
+                pass
+
+        def cross(self):
+            with self._lock:
+                self._a.poke()
+"""
+
+
+def test_lock_order_cycle_flagged_with_path():
+    findings, model = analyze_sources(
+        {"mod.py": textwrap.dedent(CYCLE.format(pragma=""))}
+    )
+    assert [f.rule for f in findings] == ["KV602"]
+    cycle = findings[0].details["cycle"]
+    assert cycle[0] == cycle[-1] and len(cycle) == 3  # A -> B -> A
+    assert ("mod.A._lock", "mod.B._lock") in model.edge_pairs()
+    assert ("mod.B._lock", "mod.A._lock") in model.edge_pairs()
+
+
+def test_lock_order_pragma_drops_edge_from_cycles_not_graph():
+    findings, model = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                CYCLE.format(pragma="  # keystone: allow-lock-order(disjoint)")
+            )
+        }
+    )
+    assert [f.rule for f in findings] == []
+    # The edge stays in the graph (the witness still compares against it).
+    assert ("mod.A._lock", "mod.B._lock") in model.edge_pairs()
+
+
+def test_lock_order_pragma_is_per_site_not_per_pair():
+    """One annotated site must not hide an UNREVIEWED site elsewhere
+    producing the same (holder, acquired) pair."""
+    findings, _ = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                CYCLE.format(pragma="  # keystone: allow-lock-order(disjoint)")
+                + """
+
+                class A2:
+                    def __init__(self, b: "B"):
+                        self._lock_extra = threading.Lock()
+
+                def second_site(a: A, b: "B"):
+                    with a._lock:
+                        b.poke()
+                """
+            )
+        }
+    )
+    # The pragmaed site is excused, but second_site re-creates the
+    # A._lock -> B._lock edge without review: the cycle must come back.
+    assert [f.rule for f in findings] == ["KV602"]
+
+
+def test_closure_bodies_are_analyzed():
+    """A guarded-write bug written as a closure spawned on a thread is
+    the same bug as a method — the model walks nested defs with their
+    own (fresh) held set."""
+    findings, model = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._served = 0
+
+                    def start(self):
+                        def loop():
+                            while True:
+                                self._served += 1
+                        threading.Thread(target=loop, daemon=True).start()
+
+                    def record(self):
+                        with self._lock:
+                            self._served += 1
+
+                    def snapshot(self):
+                        with self._lock:
+                            return self._served
+                """
+            )
+        }
+    )
+    assert [f.rule for f in findings] == ["KV601"]
+    assert "<local loop>" in findings[0].details["func"]
+    assert findings[0].details["thread_reachable"] is True
+
+
+def test_self_deadlock_on_plain_lock_flagged():
+    findings, _ = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                """
+                import threading
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def outer(self):
+                        with self._lock:
+                            self.inner()
+
+                    def inner(self):
+                        with self._lock:
+                            pass
+                """
+            )
+        }
+    )
+    assert [f.rule for f in findings] == ["KV602"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_rlock_reentry_not_flagged():
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+        """
+    ) == []
+
+
+def test_cross_module_transitive_edge():
+    findings, model = analyze_sources(
+        {
+            "a.py": textwrap.dedent(
+                """
+                import threading
+
+                class Ledger:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def record(self):
+                        with self._lock:
+                            pass
+                """
+            ),
+            "b.py": textwrap.dedent(
+                """
+                import threading
+                from a import Ledger
+
+                class Gate:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+                        self._ledger = Ledger()
+
+                    def admit(self):
+                        with self._lock:
+                            self._ledger.record()
+                """
+            ),
+        }
+    )
+    assert ("b.Gate._lock", "a.Ledger._lock") in model.edge_pairs()
+    assert findings == []
+
+
+# ------------------------------------------------------------------- KV603
+
+
+def test_blocking_under_lock_flagged():
+    findings, _ = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                """
+                import threading, time
+
+                class C:
+                    def __init__(self):
+                        self._lock = threading.Lock()
+
+                    def bad(self, future):
+                        with self._lock:
+                            time.sleep(1.0)
+                            y = future.result(timeout=2)
+                        return y
+                """
+            )
+        }
+    )
+    assert [f.rule for f in findings] == ["KV603", "KV603"]
+    kinds = {f.details["kind"] for f in findings}
+    assert kinds == {"sleep", "result"}
+
+
+def test_blocking_outside_lock_not_flagged():
+    assert codes(
+        """
+        import threading, time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ok(self, future):
+                y = future.result()
+                time.sleep(0.1)
+                with self._lock:
+                    pass
+                return y
+        """
+    ) == []
+
+
+def test_condition_wait_on_held_lock_is_the_idiom():
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+
+            def consume(self):
+                with self._cond:
+                    self._cond.wait(0.05)
+        """
+    ) == []
+
+
+def test_string_join_not_flagged():
+    assert codes(
+        """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def render(self, parts, sep):
+                with self._lock:
+                    return ",".join(parts) + sep.join(parts)
+        """
+    ) == []
+
+
+def test_thread_join_under_lock_flagged_and_pragma():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._monitor_thread = threading.Thread(target=self.run, daemon=True)
+
+            def run(self):
+                pass
+
+            def stop(self):
+                with self._lock:
+                    self._monitor_thread.join(1.0){pragma}
+    """
+    assert codes(src.format(pragma="")) == ["KV603"]
+    assert codes(
+        src.format(pragma="  # keystone: allow-block-under-lock(shutdown only)")
+    ) == []
+
+
+# ------------------------------------------------------------------- KV604
+
+
+def test_thread_hygiene():
+    findings, _ = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                """
+                import threading
+
+                def anonymous():
+                    threading.Thread(target=work).start()
+
+                def local_unjoined():
+                    t = threading.Thread(target=work)
+                    t.start()
+
+                def daemonized():
+                    t = threading.Thread(target=work, daemon=True)
+                    t.start()
+
+                def joined():
+                    t = threading.Thread(target=work)
+                    t.start()
+                    t.join()
+
+                def work():
+                    pass
+                """
+            )
+        }
+    )
+    assert [f.rule for f in findings] == ["KV604", "KV604"]
+    # Another function's local `t.join()` must not excuse this one's `t`.
+    assert {f.details["bound_to"] for f in findings} == {None, "t"}
+
+
+def test_thread_hygiene_pragma():
+    assert codes(
+        """
+        import threading
+
+        def fire_and_forget():
+            # process-lifetime watcher  # keystone: allow-unjoined(watcher)
+            threading.Thread(target=work).start()
+
+        def work():
+            pass
+        """
+    ) == []
+
+
+# ------------------------------------------------------------------- KV605
+
+
+def test_raw_settle_flagged_and_pragma():
+    src = """
+        from concurrent.futures import Future
+
+        def settle(f: Future):
+            f.set_result(1){pragma}
+    """
+    assert codes(src.format(pragma="")) == ["KV605"]
+    assert codes(
+        src.format(pragma="  # keystone: allow-settle(single owner)")
+    ) == []
+
+
+def test_settle_module_exempt():
+    findings, _ = analyze_sources(
+        {
+            os.path.join("serving", "config.py"): textwrap.dedent(
+                """
+                def settle_result(future, value):
+                    try:
+                        future.set_result(value)
+                    except Exception:
+                        pass
+                """
+            )
+        }
+    )
+    assert findings == []
+
+
+# ------------------------------------------------------------- model facts
+
+
+def test_callback_under_lock_marks_holder_open_world():
+    _, model = analyze_sources(
+        {
+            "mod.py": textwrap.dedent(
+                """
+                import threading
+
+                class Expressionish:
+                    def __init__(self, thunk):
+                        self._lock = threading.Lock()
+                        self._thunk = thunk
+
+                    def get(self):
+                        with self._lock:
+                            return self._thunk()
+                """
+            )
+        }
+    )
+    assert ("mod.Expressionish._lock", CALLBACK) in model.edge_pairs()
+
+
+def test_alloc_sites_cover_every_lock():
+    model = build_model([os.path.join(REPO, "keystone_tpu")])
+    sites = model.alloc_sites()
+    assert set(sites.values()) == set(model.locks)
+    # The witness keys on (relpath, line): every site must be unique.
+    assert len(sites) == len(model.locks)
+
+
+def test_concurrency_codes_table():
+    assert set(CONCURRENCY_CODES) == {
+        "KV601", "KV602", "KV603", "KV604", "KV605",
+    }
+
+
+# -------------------------------------------------------------- tree gates
+
+
+def test_shipped_tree_is_clean():
+    """The CI gate: the concurrency tier over the shipped package finds
+    nothing. A new finding means fix the locking or annotate the
+    reviewed exception — never ignore."""
+    import keystone_tpu
+
+    root = os.path.dirname(keystone_tpu.__file__)
+    findings, model = analyze_paths([root])
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # The model actually engaged: the runtime's lock population is known.
+    assert len(model.locks) >= 25
+    assert len(model.edges) >= 10
+
+
+def test_seeded_fixture_fires_kv601_and_kv602():
+    """The smoke's negative control: the committed seeded fixture must
+    keep tripping the analyzer."""
+    findings, _ = analyze_paths([SEEDED])
+    found = {f.rule for f in findings}
+    assert "KV601" in found and "KV602" in found
+
+
+# --------------------------------------------- pinned true-positive fixes
+
+
+def test_batcher_settles_through_shared_helpers():
+    """KV605 true positives fixed: the batcher settled futures raw; a
+    future already settled by a shutdown race must be tolerated by the
+    settle-once helpers, not by scattered try/except."""
+    from keystone_tpu.reliability.retry import Deadline
+    from keystone_tpu.serving.batcher import MicroBatcher
+    from keystone_tpu.serving.config import Request, ServerClosed
+
+    mb = MicroBatcher(8)
+    req = Request(payload=[1.0], model="m", deadline=Deadline(0.0))
+    req.future.set_result("already-won")  # the race, pre-settled
+    live = Request(payload=[2.0], model="m")
+    assert mb.offer(req)
+    assert mb.offer(live)
+    batch = mb.next_batch(4, 0.001)
+    assert batch == [live]  # expired path consumed req without raising
+    assert req.future.result() == "already-won"  # settle-once preserved
+
+    req2 = Request(payload=[2.0], model="m")
+    req2.future.set_result("kept")
+    assert mb.offer(req2)
+    assert mb.fail_all(ServerClosed()) == 1  # no raise on settled future
+    assert req2.future.result() == "kept"
+
+
+def test_supervisor_submit_many_settles_through_shared_helpers():
+    """KV605 true positive fixed: shed/closed futures out of submit_many
+    go through settle_exception."""
+    from keystone_tpu.serving.config import ServerClosed
+    from keystone_tpu.serving.supervisor import SupervisorConfig, WorkerSupervisor
+
+    sup = WorkerSupervisor({"stub": {}}, SupervisorConfig(workers=1))
+    sup._closed = True  # never started; submit must refuse
+    futures = sup.submit_many([[1.0], [2.0]])
+    assert len(futures) == 2
+    for f in futures:
+        with pytest.raises(ServerClosed):
+            f.result(timeout=0)
+
+
+def test_profile_store_counters_are_lock_guarded():
+    """KV601-class hardening pinned: hits/misses/writes are mutated
+    under the state lock, so concurrent lookup/record cannot drop
+    counts."""
+    import tempfile
+    import threading
+
+    from keystone_tpu.obs.store import ProfileStore
+
+    fp = {"jax": "x", "backend": "cpu", "device_kind": "cpu"}
+    store = ProfileStore(
+        os.path.join(tempfile.mkdtemp(), "s.jsonl"), fingerprint=fp
+    )
+    n_threads, n_iter = 4, 50
+
+    def hammer(i):
+        for j in range(n_iter):
+            store.record(f"k{i}", "n2^4|8|float32", backend="cpu", wall_s=j)
+            assert store.lookup(f"k{i}", "n2^4|8|float32", backend="cpu")
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stats = store.stats()
+    assert stats["writes"] == n_threads * n_iter
+    assert stats["hits"] == n_threads * n_iter
+    assert stats["misses"] == 0

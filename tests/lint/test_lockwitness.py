@@ -1,0 +1,240 @@
+"""Lock witness (lint/lockwitness.py): wrapper mechanics, the
+model-vs-runtime cross-check over real threaded components, and the
+committed lock-order baseline's subset invariant."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from keystone_tpu.lint.lockmodel import CALLBACK, build_model
+from keystone_tpu.lint.lockwitness import LockWitness, lock_witness
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE = os.path.join(REPO, "keystone_tpu")
+BASELINE = os.path.join(PACKAGE, "lint", "lockorder_baseline.json")
+
+_model_cache = {}
+
+
+def model():
+    if "m" not in _model_cache:
+        _model_cache["m"] = build_model([PACKAGE])
+    return _model_cache["m"]
+
+
+# ----------------------------------------------------------------- wrapper
+
+
+def test_nested_acquisition_records_one_edge():
+    with lock_witness(site_names={}) as w:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with a:  # repeat: same edge, higher count
+            with b:
+                pass
+    edges = w.observed_edges()
+    assert len(edges) == 1
+    ((edge, count),) = edges.items()
+    assert count == 2
+
+
+def test_reentrant_rlock_records_no_self_edge():
+    with lock_witness(site_names={}) as w:
+        r = threading.RLock()
+        with r:
+            with r:
+                pass
+    assert w.observed_edges() == {}
+
+
+def test_release_unwinds_held_stack():
+    with lock_witness(site_names={}) as w:
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            pass
+        with b:  # a released first: no a->b edge
+            pass
+    assert w.observed_edges() == {}
+
+
+def test_condition_over_witnessed_lock_works():
+    with lock_witness(site_names={}) as w:
+        lk = threading.Lock()
+        cond = threading.Condition(lk)
+        hits = []
+
+        def waiter():
+            with cond:
+                cond.wait(1.0)
+                hits.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        deadline = 50
+        while not lk.locked() and deadline:
+            deadline -= 1
+            import time
+
+            time.sleep(0.01)
+        with cond:
+            cond.notify_all()
+        t.join(2.0)
+        assert hits == [1]
+
+
+def test_uninstall_restores_factories():
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    with lock_witness(site_names={}):
+        assert threading.Lock is not orig_lock
+    assert threading.Lock is orig_lock
+    assert threading.RLock is orig_rlock
+
+
+def test_site_naming_against_static_table():
+    w = LockWitness(site_names={(os.path.join("serving", "batcher.py"), 46): "X"})
+    name, known = w._name_for("/somewhere/keystone_tpu/serving/batcher.py", 46)
+    assert (name, known) == ("X", True)
+    name, known = w._name_for("/somewhere/else/other.py", 3)
+    assert known is False and name.endswith("other.py:3")
+
+
+def test_unknown_edges_respects_open_world_holders():
+    w = LockWitness(site_names={("a.py", 1): "A", ("a.py", 2): "B", ("a.py", 3): "C"})
+    w._edges[("A", "B")] = 1  # anticipated via A -> <callback>
+    w._edges[("B", "C")] = 1  # genuine drift
+    w._edges[("B", "zz.py:9")] = 1  # foreign endpoint: recorded, not drift
+    static = {("A", CALLBACK)}
+    assert w.unknown_edges(static) == [("B", "C")]
+
+
+# --------------------------------------------- runtime vs static cross-check
+
+
+def test_threaded_components_take_no_edge_missing_from_model():
+    """The acceptance invariant, in-process: drive the threaded serving/
+    ingest components under the witness; every acquisition edge between
+    model-known locks must be in the static graph (or covered by an
+    open-world holder)."""
+    m = model()
+    with lock_witness(site_names=m.alloc_sites()) as w:
+        from keystone_tpu.serving.batcher import MicroBatcher
+        from keystone_tpu.serving.config import Request
+
+        mb = MicroBatcher(64)
+        stop = threading.Event()
+
+        def producer():
+            for i in range(100):
+                mb.offer(Request(payload=[float(i)], model="m"))
+
+        def consumer():
+            while not stop.is_set() or mb.depth():
+                mb.next_batch(8, 0.001, stop=stop)
+
+        cons = threading.Thread(target=consumer)
+        cons.start()
+        producers = [threading.Thread(target=producer) for _ in range(2)]
+        for t in producers:
+            t.start()
+        for t in producers:
+            t.join()
+        stop.set()
+        cons.join(5.0)
+
+        from keystone_tpu.data.ingest import PrefetchQueue
+
+        with PrefetchQueue(
+            range(40), prepare=lambda x: x * 2, depth=2, workers=2
+        ) as pq:
+            assert len(list(pq)) == 40
+
+        from keystone_tpu.serving.registry import ModelRegistry
+
+        registry = ModelRegistry()
+
+        class M:
+            def apply_batch(self, ds):
+                return ds
+
+        def swapper():
+            for _ in range(50):
+                registry.publish("m", M())
+
+        def resolver():
+            for _ in range(50):
+                registry.resolve("m")
+                registry.describe()
+
+        registry.publish("m", M())
+        ts = [threading.Thread(target=f) for f in (swapper, resolver)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+        from keystone_tpu.serving.admission import AdmissionController
+        from keystone_tpu.serving.telemetry import ServingTelemetry
+
+        telemetry = ServingTelemetry()
+        admission = AdmissionController(16)
+
+        def hammer():
+            for i in range(100):
+                telemetry.record_request(0.001, 0.0005)
+                telemetry.record_batch(4, 4, 8)
+                try:
+                    admission.admit(i % 20)
+                except Exception:
+                    pass
+            telemetry.snapshot()
+            admission.stats()
+
+        ts = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    assert w.observed_edges(), "witness saw no edges — instrumentation broken"
+    unknown = w.unknown_edges(m.edge_pairs())
+    assert unknown == [], (
+        f"runtime acquisition edges missing from the static graph: {unknown}"
+    )
+
+
+# ------------------------------------------------------------------ baseline
+
+
+def test_baseline_observed_edges_subset_of_static_graph():
+    """The committed baseline (edges the threaded tier-1 suites actually
+    took) must stay inside the CURRENT static graph: a model change that
+    loses an edge the runtime takes fails here, not silently."""
+    with open(BASELINE) as fh:
+        baseline = json.load(fh)
+    m = model()
+    static = m.edge_pairs()
+    open_world = {a for (a, b) in static if b == CALLBACK}
+    missing = [
+        (a, b)
+        for a, b in (tuple(e) for e in baseline["observed_edges"])
+        if (a, b) not in static and a not in open_world
+    ]
+    assert missing == [], (
+        f"baseline edges no longer in the static lock-order graph: {missing} "
+        "— regenerate lint/lockorder_baseline.json or fix the model"
+    )
+
+
+def test_baseline_locks_still_exist():
+    with open(BASELINE) as fh:
+        baseline = json.load(fh)
+    names = set(model().locks) | {CALLBACK}
+    for a, b in baseline["static_edges"]:
+        assert a in names, f"baseline references unknown lock {a!r}"
+        assert b in names, f"baseline references unknown lock {b!r}"

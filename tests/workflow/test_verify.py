@@ -564,11 +564,12 @@ def test_every_code_has_severity_and_title():
 
 
 def test_docs_codes_sync():
-    """Every diagnostic code — verifier KV1xx-4xx and lint KV5xx — is
-    documented in docs/VERIFICATION.md, or this fails."""
+    """Every diagnostic code — verifier KV1xx-4xx, lint KV5xx, and
+    concurrency KV6xx — is documented in docs/VERIFICATION.md, or this
+    fails. New codes cannot land undocumented."""
     import os
 
-    from keystone_tpu.lint import LINT_CODES
+    from keystone_tpu.lint import CONCURRENCY_CODES, LINT_CODES
 
     doc = open(
         os.path.join(
@@ -577,7 +578,9 @@ def test_docs_codes_sync():
     ).read()
     missing = [
         code
-        for code in list(CODES) + list(LINT_CODES) + ["KV500"]
+        for code in (
+            list(CODES) + list(LINT_CODES) + list(CONCURRENCY_CODES) + ["KV500"]
+        )
         if f"`{code}`" not in doc
     ]
     assert not missing, f"codes undocumented in docs/VERIFICATION.md: {missing}"
