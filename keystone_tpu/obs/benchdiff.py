@@ -58,6 +58,9 @@ _EXACT_SUBSTRINGS = (
     "dispatches", "compiles_first_chunk", "compiles_steady_state",
     "bytes_transferred",  # deterministic for a pinned dataset + dtype plan
     "dropped",  # serving chaos invariant: a dropped request is never OK
+    # Partitioner invariants (docs/PARTITIONING.md): shard counts and the
+    # finish-reduce payload are pure functions of the pinned plan.
+    "collective_bytes", "shards_chosen",
 )
 _SKIP_SUBSTRINGS = (
     # Environment-dependent measurements no two runs share: compile

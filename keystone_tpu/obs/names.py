@@ -42,6 +42,13 @@ STREAM_STALL_SECONDS = "keystone_stream_stall_seconds_total"
 STREAM_PREFETCH_DEPTH = "keystone_stream_prefetch_depth"
 STREAM_HOST_BUFFER_PEAK = "keystone_stream_host_buffer_peak_bytes"
 
+# ---------------------------------------------------------------- partitioning
+PARTITION_DECISIONS = "keystone_partition_decisions_total"
+PARTITION_SHARDS = "keystone_partition_shards"
+PARTITION_FALLBACKS = "keystone_partition_fallbacks_total"
+PARTITION_COLLECTIVE_BYTES = "keystone_partition_collective_bytes_total"
+PARTITION_IMBALANCE = "keystone_partition_imbalance"
+
 # ------------------------------------------------------------------- autocache
 AUTOCACHE_CACHED_NODES = "keystone_autocache_cached_nodes_total"
 AUTOCACHE_HITS = "keystone_autocache_hits_total"
@@ -132,6 +139,11 @@ SCHEMA: Dict[str, Tuple] = {
     STREAM_STALL_SECONDS: ("counter", "Seconds the streaming dispatch loop spent waiting on the host prefetch pipeline", ()),
     STREAM_PREFETCH_DEPTH: ("gauge", "Chunks currently buffered in the host prefetch queue", ()),
     STREAM_HOST_BUFFER_PEAK: ("gauge", "Peak bytes of host chunk buffers concurrently live in the last streaming fit", ()),
+    PARTITION_DECISIONS: ("counter", "Partitioner decisions recorded into plans, split by kind and eligibility", ("kind", "eligible")),
+    PARTITION_SHARDS: ("gauge", "Row shards chosen by the last eligible partition decision, per kind", ("kind",)),
+    PARTITION_FALLBACKS: ("counter", "Partition decisions that fell back to single-device, by reason key", ("reason",)),
+    PARTITION_COLLECTIVE_BYTES: ("counter", "Payload bytes entering partitioner-managed cross-device reductions (reduced payload × (shards−1))", ()),
+    PARTITION_IMBALANCE: ("gauge", "Fraction of sharded rows that are padding in the last partitioned dispatch, per kind", ("kind",)),
     AUTOCACHE_CACHED_NODES: ("counter", "Cacher nodes inserted by the auto-cache planner", ()),
     AUTOCACHE_HITS: ("counter", "Re-reads of a cached (Cacher) node's memoized result", ()),
     AUTOCACHE_MISSES: ("counter", "First executions of a Cacher node", ()),

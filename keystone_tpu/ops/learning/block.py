@@ -25,6 +25,7 @@ from ...data.dataset import ArrayDataset, Dataset
 from ...obs import solver as solver_obs
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
+from ...parallel.partitioner import fit_mesh
 from ...reliability import DegradationLadder, halving_rungs, probe
 from ...workflow.pipeline import BatchTransformer, LabelEstimator
 from ..stats.core import _as_array_dataset
@@ -174,7 +175,7 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
 
         raw = features.data
         stream = self.host_streaming

@@ -38,6 +38,7 @@ from ...data.dataset import ArrayDataset, Dataset
 from ...parallel import linalg
 from ...parallel.collectives import shard_map
 from ...parallel.mesh import get_mesh, row_axes, row_shard_count
+from ...parallel.partitioner import fit_mesh
 from ...workflow.pipeline import BatchTransformer, LabelEstimator
 from ..images.core import FusedConvFeaturizer
 from ..stats.core import _as_array_dataset
@@ -170,7 +171,7 @@ class ConvBlockLeastSquaresEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset) -> ConvBlockModel:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
         fz = self.featurizer
         conv = fz.conv
 

@@ -47,6 +47,7 @@ from ...data.dataset import ArrayDataset, Dataset
 from ...parallel import linalg
 from ...parallel.collectives import shard_map
 from ...parallel.mesh import DATA_AXIS, REPLICA_AXIS, get_mesh, row_axes, row_shard_count
+from ...parallel.partitioner import fit_mesh
 from ...workflow.pipeline import BatchTransformer, Estimator, LabelEstimator, Transformer
 from ..stats.core import _as_array_dataset
 
@@ -123,7 +124,7 @@ class GaussianKernelGenerator(Estimator):
 
     def fit(self, data: Dataset) -> KernelTransformer:
         ds = _as_array_dataset(data)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
         x = linalg.prepare_row_sharded(jnp.asarray(ds.data, jnp.float32), mesh)
         return KernelTransformer(x, self.gamma, ds.num_examples)
 
@@ -191,7 +192,7 @@ class KernelRidgeRegression(LabelEstimator):
         from ...reliability import probe
 
         probe("KernelRidgeRegression.solve")
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
         n = features.num_examples
         gamma = self.kernel_generator.gamma
 

@@ -36,6 +36,7 @@ import optax
 from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
+from ...parallel.partitioner import fit_mesh
 from ...workflow.pipeline import LabelEstimator
 from ..stats.core import _as_array_dataset
 from .linear import LinearMapper, SparseLinearMapper
@@ -65,7 +66,7 @@ class DenseLBFGSEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
         x = linalg.prepare_row_sharded(jnp.asarray(features.data, jnp.float32), mesh)
         y = linalg.prepare_row_sharded(jnp.asarray(targets.data, jnp.float32), mesh)
         n = features.num_examples

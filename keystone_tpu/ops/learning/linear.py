@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from ...data.dataset import ArrayDataset, Dataset
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
+from ...parallel.partitioner import fit_mesh
 from ...workflow.pipeline import BatchTransformer, LabelEstimator
 from ..stats.core import _as_array_dataset
 
@@ -93,7 +94,7 @@ class LinearMapEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
 
         x = linalg.prepare_row_sharded(
             jnp.asarray(features.data, dtype=jnp.float32), mesh

@@ -23,6 +23,7 @@ import optax
 from ...data.dataset import Dataset
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
+from ...parallel.partitioner import fit_mesh
 from ...workflow.pipeline import LabelEstimator
 from ..stats.core import _as_array_dataset
 from .linear import LinearMapper
@@ -50,7 +51,7 @@ class LogisticRegressionEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         features = _as_array_dataset(data)
         targets = _as_array_dataset(labels)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
         x = linalg.prepare_row_sharded(jnp.asarray(features.data, jnp.float32), mesh)
         y = jnp.asarray(targets.data).astype(jnp.int32).ravel()
         y = linalg.prepare_row_sharded(y, mesh)

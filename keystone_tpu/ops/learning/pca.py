@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from ...data.dataset import ArrayDataset, Dataset, ObjectDataset
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh, num_devices
+from ...parallel.partitioner import fit_mesh
 from ...workflow.optimize import DataStats, Optimizable
 from ...workflow.pipeline import BatchTransformer, Estimator, Transformer
 from .cost import DEFAULT_COST_WEIGHTS, CostModel
@@ -153,7 +154,7 @@ class DistributedPCAEstimator(Estimator, CostModel):
 
     def fit(self, data: Dataset) -> PCATransformer:
         ds = _as_array_dataset(data)
-        mesh = get_mesh()
+        mesh = fit_mesh(self)
         x = linalg.prepare_row_sharded(jnp.asarray(ds.data, dtype=jnp.float32), mesh)
         n = ds.num_examples
         r = linalg.tsqr_r(x, mesh=mesh)

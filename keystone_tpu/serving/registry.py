@@ -151,6 +151,14 @@ class ModelRegistry:
             buckets=buckets,
             warmed_buckets=warmed_buckets,
         )
+        if buckets:
+            # Decide (and record) the serving row-sharding now, at the
+            # same door the bucket contract enters — warmup re-attaches
+            # the identical decision, so published layout and warmed
+            # layout cannot drift (parallel/partitioner.py).
+            from ..parallel.partitioner import attach_serving_partition
+
+            attach_serving_partition(fitted, buckets, name=name)
         return self.publish(name, fitted, source=f"fitted:{path}")
 
     def load_checkpoint(self, name: str, store_path: str, digest: str) -> ModelEntry:

@@ -122,8 +122,12 @@ class PipelineServer:
     def warmup(self, example: Any, models: Optional[Sequence[str]] = None) -> Dict[str, Any]:
         """AOT-drive every shape bucket through each model's apply path so
         no request size compiles at serve time. ``example`` is one request
-        payload (array or pytree). Returns per-model per-bucket seconds
-        and stamps the compile-counter baseline for ``stats()``."""
+        payload (array or pytree). Returns per-model per-bucket seconds,
+        plus one sibling ``"partition_decisions"`` entry mapping each
+        model to its serving partition decision (docs/PARTITIONING.md) —
+        the per-model dicts stay pure ``bucket_N_s`` timing floats. Also
+        stamps the compile-counter baseline for ``stats()``."""
+        from ..parallel.partitioner import attach_serving_partition
         from ..utils.aot import warm_buckets
         from ..utils.compilation_cache import compile_count, install_compile_counter
 
@@ -131,7 +135,16 @@ class PipelineServer:
         out: Dict[str, Any] = {}
         for model_name in models or self.registry.names():
             entry = self.registry.resolve(model_name)
+            # Decide row-sharding BEFORE warming: the warmed executables
+            # then carry the exact layouts steady state replays (each
+            # bucket either always shards across the mesh or never does
+            # — docs/PARTITIONING.md).
+            decision = attach_serving_partition(
+                entry.model, self._buckets, name=model_name
+            )
             out[model_name] = warm_buckets(entry.batch_apply, example, self._buckets)
+            if decision is not None:
+                out.setdefault("partition_decisions", {})[model_name] = decision.to_json()
         for bucket in self._buckets:
             self.telemetry.mark_bucket_warm(bucket)
         self._compile_baseline = compile_count()

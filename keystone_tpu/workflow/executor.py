@@ -103,17 +103,38 @@ class GraphExecutor:
         self._prefixes: Dict[NodeId, Prefix] = {}
         self._memo: Dict[GraphId, Expression] = {}
         self._counters = None  # resolved lazily, once per executor
+        #: Partition decisions the planner recorded for THIS plan
+        #: (parallel/partitioner.py), captured at optimize time — a
+        #: stable per-executor snapshot for programmatic consumers that
+        #: outlive later optimizer runs (the global
+        #: ``last_partition_report()`` describes only the LAST plan).
+        #: Pinned by tests/workflow/test_partition.py.
+        self.partition_decisions: list = []
 
     @property
     def graph(self) -> Graph:
         """The optimized graph (optimizes on first access)."""
         if self._optimized is None:
             if self._optimize:
+                from ..parallel.partitioner import (
+                    last_partition_report,
+                    partition_report_generation,
+                )
+
                 env = PipelineEnv.get_or_create()
+                generation = partition_report_generation()
                 with _spans.span("optimize"):
                     self._optimized, self._prefixes = env.optimizer.execute(
                         self._raw_graph
                     )
+                # Only adopt the report if THIS optimize ran a partition
+                # batch (the reset bumps the generation) — a custom
+                # stack without one must not inherit a previous plan's
+                # decisions. (Optimizer runs are process-serial in
+                # practice; concurrent optimizes would interleave the
+                # global report either way.)
+                if partition_report_generation() != generation:
+                    self.partition_decisions = last_partition_report()
             else:
                 self._optimized = self._raw_graph
         return self._optimized

@@ -17,6 +17,7 @@ through the DAG.
 
 from __future__ import annotations
 
+import copy
 import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -26,6 +27,7 @@ from .graph import Graph, NodeId, SinkId, SourceId
 from .operators import (
     DatasetOperator,
     DatumOperator,
+    EstimatorOperator,
     Expression,
     Operator,
     wrap_expression,
@@ -135,6 +137,100 @@ class _SampleInterpreter:
                 if info is not None:
                     return info
         return None
+
+
+class PartitionPlanRule(Rule):
+    """Consult the :class:`~keystone_tpu.parallel.partitioner.Partitioner`
+    for every fit in the plan — the LAST optimizer batch (after
+    measured-knobs, so a measured ``chunk_rows`` override is what gets
+    rounded to the shard count, docs/PARTITIONING.md).
+
+    Eligible nodes get the decision PINNED onto a copy of their operator
+    (``op.partition`` — the same pin-on-copy idiom as MeasuredKnobRule):
+
+    - ``StreamingFitOperator`` — the chunk plan shards data-parallel
+      (chunk_rows rounded up to a shard multiple so the one compiled
+      chunk shape divides evenly across devices);
+    - other estimators — the in-core fit shards rows over the decided
+      mesh (``partitioner.fit_mesh``), Gram partials psummed across it.
+
+    Ineligible nodes are still DECIDED — the fallback reason lands in the
+    partition report so ``check --pipeline`` and BENCH json can explain
+    why a plan runs single-device. The rule never errors a plan.
+    """
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+
+    def apply(self, graph: Graph, prefixes: PrefixMap) -> Tuple[Graph, PrefixMap]:
+        from ..parallel.partitioner import (
+            Partitioner,
+            partition_enabled,
+            reset_partition_report,
+        )
+        from .streaming import StreamingFitOperator, stream_chunk_rows
+
+        reset_partition_report()
+        if not partition_enabled():
+            # Disabled = the LEGACY (pre-partitioner) behavior: nothing
+            # pinned, in-core fits keep the ambient mesh, and the empty
+            # report plus the env knob is the explanation
+            # (docs/PARTITIONING.md).
+            return graph, prefixes
+        part = Partitioner(mesh=self.mesh)
+        for node in sorted(graph.nodes):
+            op = graph.get_operator(node)
+            if not isinstance(op, EstimatorOperator):
+                continue
+            label = str(getattr(op, "label", type(op).__name__))
+            streaming = isinstance(op, StreamingFitOperator)
+            # The opt-out lives on the estimator the user wrote — for a
+            # streamed fit that is the WRAPPED estimator, not the
+            # planner-built StreamingFitOperator around it.
+            target = op.estimator if streaming else op
+            opt_out = getattr(target, "partitionable", True) is False
+            rows = _upstream_rows(graph, node)
+            if streaming:
+                decision = part.decide_stream(
+                    label, op.chunk_rows or stream_chunk_rows(), rows=rows,
+                    opt_out=opt_out,
+                )
+            else:
+                decision = part.decide_fit(label, rows, opt_out=opt_out)
+            # Pin only ELIGIBLE decisions, and always onto a COPY: the
+            # user still holds the original estimator, and a fit that is
+            # not partition-managed must run the user's own object on
+            # the legacy ambient-mesh path (a fallback is recorded in
+            # the report, not pinned — fit_mesh's docstring spells out
+            # the semantics).
+            if decision.eligible:
+                pinned = copy.copy(op)
+                pinned.partition = decision
+                if streaming:
+                    pinned.chunk_rows = decision.chunk_rows
+                graph = graph.set_operator(node, pinned)
+        return graph, prefixes
+
+
+def _upstream_rows(graph: Graph, node: NodeId) -> Optional[int]:
+    """Row count feeding a fit: walk the first-dependency ancestry to a
+    bound dataset (transformers are row-preserving by the framework
+    contract, so the head's length IS the fit's row count). ``None``
+    when the head is unbound/unsized (a Cacher, a source)."""
+    seen = set()
+    cur = graph.get_dependencies(node)
+    cur = cur[0] if cur else None
+    while isinstance(cur, NodeId) and cur not in seen:
+        seen.add(cur)
+        op = graph.get_operator(cur)
+        if isinstance(op, DatasetOperator):
+            try:
+                return len(op.dataset)
+            except Exception:
+                return None
+        deps = graph.get_dependencies(cur)
+        cur = deps[0] if deps else None
+    return None
 
 
 def _subsample(dataset: Dataset, n: int) -> Dataset:

@@ -576,6 +576,15 @@ class CompiledApply:
     physical shapes cycle through a small bucket set means the jitted
     transformer bodies underneath hit XLA's executable cache instead of
     recompiling — see serving/batcher.py and utils/aot.warm_buckets.
+
+    Multi-device serving: an eligible ``partition`` decision
+    (parallel/partitioner.py, installed by ``attach_serving_partition``
+    at warmup/load) places each batch's rows ``NamedSharding``-sharded
+    over the mesh before binding, so the warmed executables run
+    data-parallel. Placement is a pure function of the batch's physical
+    rows (a bucket either always shards or never does), so the warmed
+    layout set is exactly the steady-state layout set — zero
+    steady-state compiles preserved.
     """
 
     def __init__(self, fitted: FittedPipeline):
@@ -584,10 +593,34 @@ class CompiledApply:
         self._graph: Optional[Graph] = None
         self._lock = threading.Lock()
         self.calls = 0
+        #: PartitionDecision or None (parallel/partitioner.py).
+        self.partition = None
+        self._imbalance_gauge = None
 
     def __call__(self, dataset: Union[Dataset, Any]) -> Dataset:
         if not isinstance(dataset, Dataset):
             dataset = as_dataset(dataset)
+        # One read: the attach path may swap the decision concurrently,
+        # and placement + accounting must see the same one.
+        partition = self.partition
+        if partition is not None and isinstance(dataset, ArrayDataset):
+            from ..parallel.partitioner import shard_rows
+
+            physical = dataset.physical_rows
+            dataset = ArrayDataset(
+                shard_rows(partition, dataset.data),
+                num_examples=dataset.num_examples,
+            )
+            if physical and physical % partition.shards == 0:
+                if self._imbalance_gauge is None:
+                    from ..obs import names as _names
+
+                    self._imbalance_gauge = _names.metric(
+                        _names.PARTITION_IMBALANCE
+                    )
+                self._imbalance_gauge.set(
+                    1.0 - dataset.num_examples / physical, kind="serve"
+                )
         fitted = self._fitted
         with self._lock:
             if self._graph is None:

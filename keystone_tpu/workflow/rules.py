@@ -199,11 +199,13 @@ def default_optimizer() -> RuleExecutor:
     docs/STREAMING.md). Fusion runs late so every structural decision
     upstream sees real node boundaries; streaming runs second-to-last so
     it can absorb already-fused chains into chunked fit plans; the
-    measured-knob pass runs LAST so the StreamingFitOperator nodes it
-    tunes from profile-store history already exist."""
+    measured-knob pass runs next-to-last so the StreamingFitOperator
+    nodes it tunes from profile-store history already exist; the
+    partition pass runs LAST so the mesh/sharding decision sees the
+    final operators and knobs (docs/PARTITIONING.md)."""
     from .fusion import NodeFusionRule
     from .knobs import MeasuredKnobRule
-    from .optimize import NodeOptimizationRule
+    from .optimize import NodeOptimizationRule, PartitionPlanRule
     from .streaming import StreamingPlanRule
 
     return RuleExecutor(
@@ -217,6 +219,7 @@ def default_optimizer() -> RuleExecutor:
             Batch("fusion", [NodeFusionRule()]),
             Batch("streaming", [StreamingPlanRule()]),
             Batch("measured-knobs", [MeasuredKnobRule()]),
+            Batch("partition", [PartitionPlanRule()]),
         ]
     )
 
@@ -233,7 +236,7 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
     from .autocache import AutoCacheRule
     from .fusion import NodeFusionRule
     from .knobs import MeasuredKnobRule
-    from .optimize import NodeOptimizationRule
+    from .optimize import NodeOptimizationRule, PartitionPlanRule
     from .streaming import StreamingPlanRule
 
     return RuleExecutor(
@@ -248,5 +251,6 @@ def auto_caching_optimizer(budget_bytes: Optional[int] = None, strategy: str = "
             Batch("fusion", [NodeFusionRule()]),
             Batch("streaming", [StreamingPlanRule()]),
             Batch("measured-knobs", [MeasuredKnobRule()]),
+            Batch("partition", [PartitionPlanRule()]),
         ]
     )

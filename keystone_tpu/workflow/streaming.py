@@ -220,6 +220,12 @@ class StreamReport:
     stall_s: float = 0.0
     compiles_first_chunk: int = 0
     compiles_steady_state: int = 0
+    #: Partitioned (multi-device) chunk plan: shards the chunk rows split
+    #: across, the mesh shape, and the payload bytes of the finish-time
+    #: statistics allreduce (docs/PARTITIONING.md; 1/()/0 = single-device).
+    shards: int = 1
+    mesh_shape: Tuple[int, ...] = ()
+    collective_bytes: int = 0
     #: perf_counter at fold start — the event lists below are offsets
     #: from this, so exporters can place chunk slices on a session
     #: timeline (obs/export.py Perfetto view).
@@ -316,15 +322,25 @@ def _apply_chain(members, x, mask):
     return jax.tree_util.tree_map(zero_pad, x)
 
 
-def _shared_step_jit(members: tuple, step_fn):
+def _shared_step_jit(members: tuple, step_fn, partition=None):
     """jit of (carry, x_raw, y, mask) → (carry', probe), cached on
-    (member ids, step_fn id). Returns (callable, trace_counter_list) —
-    the counter appends at trace time only, making 'exactly one compile
-    per chunk shape' directly observable."""
+    (member ids, step_fn id, partition mesh). Returns
+    (callable, trace_counter_list) — the counter appends at trace time
+    only, making 'exactly one compile per chunk shape' directly
+    observable.
+
+    With an eligible ``partition`` decision the fused step runs inside
+    ``shard_map`` over the decision's mesh: each device featurizes its
+    row slice of the chunk and accumulates into its OWN carry block (the
+    carry grows a leading ``(shards,)`` axis sharded over the row axes),
+    so no collective runs per chunk — the partial statistics are summed
+    across shards once, at fold finish (docs/PARTITIONING.md)."""
     global _STEP_JIT_CACHE
     import jax
 
     key = tuple(id(m) for m in members) + (id(step_fn),)
+    if partition is not None:
+        key += ("sharded", id(partition.mesh), partition.shards)
     with _step_cache_lock:
         if _STEP_JIT_CACHE is None:
             from collections import OrderedDict
@@ -337,19 +353,50 @@ def _shared_step_jit(members: tuple, step_fn):
 
     traces: List[tuple] = []
 
-    def fused(carry, x_raw, y, mask):
-        traces.append(())  # trace-time side effect: once per new shape
-        x = _apply_chain(members, x_raw, mask)
-        new_carry = step_fn(carry, x, y)
-        leaf = jax.tree_util.tree_leaves(new_carry)[0]
-        probe = leaf.ravel()[:1]  # tiny, NOT donated: safe to block on
-        return new_carry, probe
+    if partition is None:
+
+        def fused(carry, x_raw, y, mask):
+            traces.append(())  # trace-time side effect: once per new shape
+            x = _apply_chain(members, x_raw, mask)
+            new_carry = step_fn(carry, x, y)
+            leaf = jax.tree_util.tree_leaves(new_carry)[0]
+            probe = leaf.ravel()[:1]  # tiny, NOT donated: safe to block on
+            return new_carry, probe
+
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.collectives import shard_map as _smap
+
+        mesh = partition.mesh
+        spec = P(tuple(partition.mesh_axes))
+
+        def fused(carry, x_raw, y, mask):
+            traces.append(())
+
+            def local(c, x, yb, m):
+                # One device's view: carry block (1, …) squeezed, the
+                # chunk's row slice featurized and accumulated locally —
+                # apply_arrays is row-independent (the BatchTransformer
+                # contract), so per-shard application is exact.
+                c0 = jax.tree_util.tree_map(lambda a: a[0], c)
+                feats = _apply_chain(members, x, m)
+                c1 = step_fn(c0, feats, yb)
+                return jax.tree_util.tree_map(lambda a: a[None], c1)
+
+            new_carry = _smap(
+                local, mesh=mesh,
+                in_specs=(spec, spec, spec, spec), out_specs=spec,
+            )(carry, x_raw, y, mask)
+            leaf = jax.tree_util.tree_leaves(new_carry)[0]
+            probe = leaf.ravel()[:1]
+            return new_carry, probe
 
     # carry is owned by the fold loop: created by gram_stream_init and
     # threaded only through this step.  # keystone: owns-donated
     jitted = jax.jit(fused, donate_argnums=(0,))
     with _step_cache_lock:
-        _STEP_JIT_CACHE[key] = ((members, step_fn), jitted, traces)
+        _STEP_JIT_CACHE[key] = ((members, step_fn, partition), jitted, traces)
         _STEP_JIT_CACHE.move_to_end(key)
         while len(_STEP_JIT_CACHE) > _STEP_JIT_MAX:
             _STEP_JIT_CACHE.popitem(last=False)
@@ -412,6 +459,7 @@ class ChunkStream:
         chunk_rows: Optional[int] = None,
         prefetch: Optional[int] = None,
         workers: Optional[int] = None,
+        partition=None,
     ):
         self.data = data
         self.labels = labels
@@ -421,6 +469,17 @@ class ChunkStream:
         self.workers = workers or min(default_ingest_workers(), 4)
         self.num_examples = len(data)
         self._feat_aval = None
+        # An eligible PartitionDecision (parallel/partitioner.py) runs the
+        # sharded chunk plan; the compiled chunk shape must divide evenly
+        # across the shards, so round chunk_rows up to a shard multiple.
+        self.partition = (
+            partition
+            if partition is not None and getattr(partition, "eligible", False)
+            else None
+        )
+        if self.partition is not None:
+            s = self.partition.shards
+            self.chunk_rows = -(-self.chunk_rows // s) * s
 
     def feature_aval(self):
         """Shape/dtype of one FEATURIZED chunk (shape-only trace of the
@@ -468,8 +527,28 @@ class ChunkStream:
         y_spec = jax.ShapeDtypeStruct((chunk_rows, y_host.shape[1]), y_host.dtype)
         carry = init_fn(feat_aval, y_spec)
 
+        part = self.partition
+        sharding = None
+        if part is not None:
+            import jax.numpy as jnp
+
+            from ..parallel.partitioner import NamedShardingCache
+
+            sharding = NamedShardingCache.get(part.mesh, part.mesh_axes)
+
+            # Per-device carry blocks: a leading (shards,) axis sharded
+            # over the row axes. Shard 0 seeds the estimator's initial
+            # carry, the rest start zero — exact for the additive
+            # accumulation the fit_stream protocol is (final carry =
+            # init + Σ partials, summed once at finish).
+            def stack(a):
+                z = jnp.zeros((part.shards,) + tuple(a.shape), a.dtype)
+                return jax.device_put(z.at[0].set(a), sharding)
+
+            carry = jax.tree_util.tree_map(stack, carry)
+
         _quiet_unused_donation_warnings()  # carries are donated each step
-        step, traces = _shared_step_jit(self.members, step_fn)
+        step, traces = _shared_step_jit(self.members, step_fn, part)
 
         if not hasattr(type(data), "fetch_rows") or (
             type(data).fetch_rows is Dataset.fetch_rows
@@ -501,6 +580,8 @@ class ChunkStream:
             chunk_rows=chunk_rows,
             num_examples=n,
             prefetch_depth=self.prefetch,
+            shards=part.shards if part is not None else 1,
+            mesh_shape=tuple(part.mesh_shape) if part is not None else (),
         )
         data_shape = _store.dataset_shape_class(data)
         chunks_c = _names.metric(_names.STREAM_CHUNKS)
@@ -532,11 +613,17 @@ class ChunkStream:
             in_hand_peak = max(in_hand_peak, nbytes)
             report.upload_issued_t.append(time.perf_counter() - t0)
             # Async uploads at transfer (narrow) width; cast happens on
-            # device inside the fused step.
+            # device inside the fused step. Under a partition decision
+            # every leaf lands row-sharded over the mesh — each device
+            # receives only its slice of the chunk.
+            put = (
+                jax.device_put if sharding is None
+                else (lambda a: jax.device_put(a, sharding))
+            )
             dev = (
-                jax.tree_util.tree_map(jax.device_put, x),
-                jax.device_put(y),
-                jax.device_put(mask),
+                jax.tree_util.tree_map(put, x),
+                put(y),
+                put(mask),
                 rows,
             )
             report.bytes_transferred += nbytes
@@ -564,12 +651,38 @@ class ChunkStream:
 
         try:
             with _spans.span(
-                "stream:fold", chunks=len(windows), chunk_rows=chunk_rows
+                "stream:fold", chunks=len(windows), chunk_rows=chunk_rows,
+                shards=report.shards,
             ):
                 stream_pipelined(
                     queue, stage=stage, compute=compute, consume=consume,
                     prefetch=1,
                 )
+                if part is not None:
+                    # THE cross-shard collective of the whole fit: sum
+                    # the per-device partial statistics once, at finish
+                    # — O(d²) payload independent of how many chunks
+                    # streamed (docs/PARTITIONING.md). Unconditional on
+                    # chunk count: the stacked carry must ALWAYS come
+                    # back to the estimator's single-device shape (a
+                    # zero-chunk fold reduces to the seeded init carry).
+                    import jax.numpy as jnp
+
+                    from ..parallel.partitioner import (
+                        record_collective_bytes,
+                        record_imbalance,
+                    )
+
+                    carry = jax.tree_util.tree_map(
+                        lambda a: jnp.sum(a, axis=0), carry
+                    )
+                    if report.chunks:
+                        reduced = _tree_nbytes(carry)
+                        report.collective_bytes = reduced * (part.shards - 1)
+                        record_collective_bytes(report.collective_bytes)
+                        record_imbalance(
+                            "fit_stream", n, len(windows) * chunk_rows
+                        )
         finally:
             queue.close()
             report.stall_s = queue.stall_s
@@ -676,6 +789,11 @@ class StreamingFitOperator(EstimatorOperator):
     stream can never change results.
     """
 
+    #: PartitionDecision pinned by workflow/optimize.py::PartitionPlanRule
+    #: (None = single-device chunk plan; the class default keeps copies
+    #: built by MeasuredKnobRule before the partition batch unpinned).
+    partition = None
+
     def __init__(
         self,
         estimator: EstimatorOperator,
@@ -727,6 +845,7 @@ class StreamingFitOperator(EstimatorOperator):
                         self.members,
                         chunk_rows=chunk_rows,
                         prefetch=self.prefetch,
+                        partition=self.partition,
                     )
                     return self.estimator.fit_stream(stream)
                 except StreamingFallback as e:

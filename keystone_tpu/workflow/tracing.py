@@ -152,9 +152,18 @@ def timed_execute(op, deps):
     sync = tr is not None or getattr(session, "sync_timings", True)
     label = str(getattr(op, "label", type(op).__name__))
     members = getattr(op, "member_labels", None)
+    partition = getattr(op, "partition", None)
     with _spans.span(f"node:{label}", op=type(op).__name__) as sp:
         if members is not None:
             sp.set_attribute("fused_members", ",".join(members))
+        if partition is not None and getattr(partition, "eligible", False):
+            # The partitioner's pinned decision, on the node's own span:
+            # a sharded fit is identifiable in any trace without
+            # cross-referencing the plan report (docs/PARTITIONING.md).
+            sp.set_attribute(
+                "mesh_shape", "x".join(str(s) for s in partition.mesh_shape)
+            )
+            sp.set_attribute("partition_spec", partition.spec)
         with device_annotation(f"keystone/node/{label}"):
             start = time.perf_counter()
             value = expression.get()

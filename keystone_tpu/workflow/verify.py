@@ -89,9 +89,11 @@ CODES: Dict[str, Tuple[str, str]] = {
     "KV102": (WARNING, "silent float64 widening"),
     "KV201": (INFO, "fusion-ineligible node"),
     "KV202": (INFO, "streaming-ineligible fit"),
+    "KV203": (INFO, "sharding-ineligible fit"),
     "KV301": (ERROR, "serving bucket not warmed"),
     "KV302": (WARNING, "estimated peak memory exceeds budget"),
     "KV303": (WARNING, "streamed-fit Gram state exceeds memory budget"),
+    "KV304": (ERROR, "sharded per-device residency exceeds memory budget"),
     "KV401": (ERROR, "dependency cycle"),
     "KV402": (INFO, "node not statically analyzable"),
 }
@@ -138,6 +140,10 @@ class VerifyReport:
     annotations: List[NodeAnnotation] = field(default_factory=list)
     seconds: float = 0.0
     context: str = ""
+    #: Per-fit partition decisions the verifier derived (mesh shape, row
+    #: PartitionSpec, eligibility/fallback reason) — the explainable
+    #: sharding plan ``keystone-tpu check --pipeline --json`` surfaces.
+    partition: List[Dict[str, Any]] = field(default_factory=list)
 
     def errors(self) -> List[Diagnostic]:
         return [d for d in self.diagnostics if d.severity == ERROR]
@@ -153,13 +159,16 @@ class VerifyReport:
         return [d for d in self.diagnostics if d.code == code]
 
     def to_json(self) -> Dict[str, Any]:
-        return {
+        out = {
             "context": self.context,
             "ok": self.ok,
             "seconds": round(self.seconds, 4),
             "diagnostics": [d.to_json() for d in self.diagnostics],
             "nodes": [a.to_json() for a in self.annotations],
         }
+        if self.partition:
+            out["partition"] = self.partition
+        return out
 
     def render(self) -> str:
         lines = [
@@ -761,6 +770,104 @@ def _streaming_diagnostics(
         )
 
 
+def _partition_diagnostics(
+    graph: Graph,
+    interp: _Interpreter,
+    memory_limit: Optional[int],
+    report: VerifyReport,
+) -> None:
+    """The partitioner's own view of every fit in the plan, re-derived
+    (never re-recorded — the last plan's report and metrics stay
+    untouched): KV203 explains a single-device fallback with the
+    partitioner's reason key; KV304 errors when an ELIGIBLE sharded plan
+    still cannot fit its per-device slice next to the replicated O(d²)
+    statistics — sharding divides the rows, not the Gram."""
+    from ..parallel.partitioner import Partitioner
+    from .streaming import StreamingFitOperator, stream_chunk_rows
+
+    part = Partitioner()
+    for node in sorted(graph.nodes):
+        op = graph.get_operator(node)
+        if not isinstance(op, EstimatorOperator):
+            continue
+        label = str(getattr(op, "label", type(op).__name__))
+        deps = graph.get_dependencies(node)
+        in_spec = interp.specs.get(deps[0], UNKNOWN) if deps else UNKNOWN
+        rows = _rows(in_spec)
+        streaming = isinstance(op, StreamingFitOperator)
+        pinned = getattr(op, "partition", None)
+        if pinned is not None:
+            # Post-optimizer graphs carry the plan's own decision both
+            # ways (eligible or recorded fallback) — report THAT, never
+            # a re-derivation that could disagree with the runtime.
+            decision = pinned
+        else:
+            target = op.estimator if streaming else op
+            opt_out = getattr(target, "partitionable", True) is False
+            if streaming:
+                decision = part.decide_stream(
+                    label, op.chunk_rows or stream_chunk_rows(), rows=rows,
+                    record=False, opt_out=opt_out,
+                )
+            else:
+                decision = part.decide_fit(
+                    label, rows, record=False, opt_out=opt_out
+                )
+        report.partition.append(decision.to_json())
+        if not decision.eligible:
+            interp.diag(
+                "KV203",
+                f"{label}: fit is not partition-managed "
+                f"({decision.reason}"
+                + (f": {decision.detail}" if decision.detail else "")
+                + ") — streamed/serve fallbacks run single-device, "
+                "in-core fits keep the legacy ambient-mesh path",
+                node=node,
+                reason=decision.reason,
+            )
+            continue
+
+        if memory_limit is None:
+            continue
+        # Per-device residency of the SHARDED plan: the row slice (2× for
+        # the centered/featurized working copy) plus the un-sharded
+        # statistics every device carries in full.
+        in_bytes = spec_bytes(in_spec)
+        if streaming:
+            feat = interp.specs.get(("feat", node))
+            d = _width(feat) if feat is not None else None
+            chunk = decision.chunk_rows or stream_chunk_rows()
+            row_bytes = (
+                (in_bytes // max(rows, 1)) if (in_bytes and rows) else None
+            )
+            slice_bytes = (
+                2 * chunk * row_bytes // decision.shards if row_bytes else 0
+            )
+        else:
+            d = _width(in_spec)
+            slice_bytes = 2 * in_bytes // decision.shards if in_bytes else 0
+        k = 1
+        if len(deps) > 1:
+            k = _width(interp.specs.get(deps[1])) or 1
+        stat_bytes = 2 * 4 * (d * d + d * k + d + k) if d else 0
+        per_device = slice_bytes + stat_bytes
+        if per_device > memory_limit:
+            interp.diag(
+                "KV304",
+                f"{label}: sharded over {decision.shards} devices the "
+                f"per-device residency is still ~{per_device / 1e9:.2f} GB "
+                f"(row slice {slice_bytes / 1e9:.2f} GB + replicated "
+                f"statistics {stat_bytes / 1e9:.2f} GB) against a "
+                f"{memory_limit / 1e9:.2f} GB budget — sharding divides "
+                "rows, not the O(d²) state; use the sketched tier or a "
+                "model-axis layout",
+                node=node,
+                shards=decision.shards,
+                per_device_bytes=per_device,
+                memory_limit=memory_limit,
+            )
+
+
 def _gram_feasibility(
     graph: Graph,
     interp: _Interpreter,
@@ -924,8 +1031,22 @@ def verify_graph(
 
     _fusion_diagnostics(graph, interp)
     _streaming_diagnostics(graph, interp, memory_limit)
+    _partition_diagnostics(graph, interp, memory_limit, report)
 
     if buckets:
+        # The serving-path partition decision rides the report too, so
+        # `check --pipeline --buckets` explains the sharded (or not)
+        # serve placement next to the warm-set check below.
+        try:
+            from ..parallel.partitioner import Partitioner
+
+            report.partition.append(
+                Partitioner()
+                .decide_serve("serving", buckets, record=False)
+                .to_json()
+            )
+        except Exception:  # pragma: no cover - decision is advisory
+            pass
         warmed = set(int(b) for b in (warmed_buckets or ()))
         missing = sorted(set(int(b) for b in buckets) - warmed)
         if missing:

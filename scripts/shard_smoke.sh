@@ -1,0 +1,152 @@
+#!/usr/bin/env bash
+# Shard smoke test: the three invariants behind first-class multi-device
+# partitioning (docs/PARTITIONING.md), on 8 virtual CPU devices:
+#   1. PARITY — the SAME pipeline code fit on the 8-device mesh (in-core
+#      Gram fit, sharded streamed fit, sharded bucketed serving) matches
+#      the 1-device reference to rel_err <= 1e-5;
+#   2. COMPILES — sharded serving performs ZERO steady-state XLA
+#      compiles after warmup (warmed layouts == steady-state layouts);
+#   3. FALLBACK — a seeded ineligible plan (chunk narrower than the
+#      shard count) falls back to the single-device path cleanly, with
+#      the partitioner's reason key recorded in the plan report.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+export KEYSTONE_STREAM_CHUNK_ROWS=64
+
+timeout -k 10 360 python - <<'EOF'
+import numpy as np
+from concurrent.futures import wait
+
+import jax
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+from keystone_tpu.parallel.partitioner import (
+    last_partition_report, partition_disabled,
+)
+from keystone_tpu.serving.config import ServingConfig
+from keystone_tpu.serving.server import PipelineServer
+from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.pipeline import BatchTransformer
+from keystone_tpu.workflow.streaming import last_stream_report
+
+assert len(jax.devices()) == 8, jax.devices()
+CHUNK, N, D, K = 64, 8 * 64, 16, 3
+rng = np.random.default_rng(0)
+x = rng.normal(size=(N, D)).astype(np.float32)
+w = rng.normal(size=(D, K)).astype(np.float32)
+y = (x @ w + 0.01 * rng.normal(size=(N, K))).astype(np.float32)
+
+
+class Scale(BatchTransformer):
+    def __init__(self, c):
+        self.c = float(c)
+
+    def apply_arrays(self, a):
+        return a * self.c
+
+
+def rel_err(a, b):
+    return float(np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-30))
+
+
+def build(est=None):
+    est = est or BlockLeastSquaresEstimator(8, num_iter=1, reg=1e-3)
+    return Scale(2.0).to_pipeline().then_label_estimator(
+        est, ArrayDataset(x), ArrayDataset(y)
+    )
+
+
+# ---- 1a. sharded streamed fit vs 1-device reference --------------------
+PipelineEnv.reset()
+fitted8 = build().fit()
+rep = last_stream_report()
+assert rep.shards == 8, f"streamed fit ran {rep.shards} shards"
+assert rep.compiles_steady_state == 0, rep.compiles_steady_state
+assert rep.collective_bytes > 0
+preds8 = np.asarray(fitted8.apply_batch(ArrayDataset(x[:32])).data)
+
+PipelineEnv.reset()
+with partition_disabled():
+    preds1 = np.asarray(
+        build().fit().apply_batch(ArrayDataset(x[:32])).data
+    )
+r = rel_err(preds8, preds1)
+assert r <= 1e-5, f"fit_stream parity {r}"
+print(f"PASS fit_stream: shards=8 parity={r:.2e} "
+      f"collective_bytes={rep.collective_bytes} steady_compiles=0")
+
+# ---- 1b. in-core Gram fit (below streaming floor) ----------------------
+import os
+os.environ["KEYSTONE_STREAM_MIN_ROWS"] = str(10 * N)  # force in-core
+PipelineEnv.reset()
+fitted8c = build().fit()
+decisions = [d for d in last_partition_report() if d.eligible]
+assert decisions and decisions[0].kind == "fit", [
+    d.to_json() for d in last_partition_report()
+]
+predsc8 = np.asarray(fitted8c.apply_batch(ArrayDataset(x[:32])).data)
+with use_mesh(make_mesh(devices=jax.devices()[:1])):
+    PipelineEnv.reset()
+    predsc1 = np.asarray(
+        build().fit().apply_batch(ArrayDataset(x[:32])).data
+    )
+r = rel_err(predsc8, predsc1)
+assert r <= 1e-5, f"in-core fit parity {r}"
+print(f"PASS fit: mesh={'x'.join(map(str, decisions[0].mesh_shape))} "
+      f"spec={decisions[0].spec} parity={r:.2e}")
+del os.environ["KEYSTONE_STREAM_MIN_ROWS"]
+
+# ---- 2. sharded serving: parity + zero steady-state compiles ----------
+payloads = [rng.normal(size=(24,)).astype(np.float32) for _ in range(64)]
+
+
+def serve(shard):
+    srv = PipelineServer(
+        model=synthetic_fitted_pipeline(d=24),
+        config=ServingConfig(max_batch=8, max_wait_ms=1.0, queue_depth=256),
+    )
+    if shard:
+        warm = srv.warmup(payloads[0])
+    else:
+        with partition_disabled():
+            warm = srv.warmup(payloads[0])
+    srv.start()
+    futs = srv.submit_many(payloads)
+    wait(futs, timeout=60)
+    rows = np.stack([f.result() for f in futs])
+    stats = srv.stats()
+    srv.stop()
+    return warm, rows, stats
+
+
+warm, rows8, stats = serve(True)
+decision = warm["partition_decisions"]["default"]
+assert decision["eligible"] and decision["shards"] == 8, decision
+assert stats["xla_compiles_since_warmup"] == 0, stats
+_, rows1, _ = serve(False)
+r = rel_err(rows8, rows1)
+assert r <= 1e-5, f"serving parity {r}"
+print(f"PASS serve: shards=8 parity={r:.2e} steady_compiles=0")
+
+# ---- 3. seeded ineligible plan falls back cleanly ---------------------
+os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = "4"  # < 8 shards
+os.environ["KEYSTONE_STREAM_MIN_ROWS"] = "1"
+PipelineEnv.reset()
+fitted_fb = build().fit()
+rep_fb = last_stream_report()
+assert rep_fb.shards == 1, rep_fb.shards
+reasons = {d.reason for d in last_partition_report()}
+assert "chunk-below-shard-count" in reasons, reasons
+preds_fb = np.asarray(fitted_fb.apply_batch(ArrayDataset(x[:16])).data)
+assert np.isfinite(preds_fb).all()
+print(f"PASS fallback: reason=chunk-below-shard-count shards=1 finite=True")
+print("SHARD_SMOKE_OK")
+EOF

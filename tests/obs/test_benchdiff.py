@@ -279,6 +279,30 @@ def test_dropped_request_counts_compared_exactly():
     assert not diff(mw_leg(), mw_leg(compiles_steady_state=2))["ok"]
 
 
+def test_partition_counters_compared_exactly():
+    """The sharded leg's invariants (docs/PARTITIONING.md): shard counts
+    and the finish-reduce collective payload are pure functions of the
+    pinned plan — any drift is a plan change, not noise."""
+    def sharded_leg(**kw):
+        base = {
+            "stream": {"shards_chosen": 8, "collective_bytes": 271392},
+        }
+        base["stream"].update(kw)
+        return base
+
+    for key, bad_value in (
+        ("shards_chosen", 4),
+        ("collective_bytes", 271392 * 2),
+    ):
+        v = diff(sharded_leg(), sharded_leg(**{key: bad_value}))
+        assert not v["ok"], key
+        bad = [c for c in v["legs"]["timit"]["checks"]
+               if c["verdict"] == "regression"]
+        assert bad and bad[0]["key"] == f"stream.{key}"
+        assert bad[0]["kind"] == "exact"
+    assert diff(sharded_leg(), sharded_leg())["ok"]
+
+
 def test_exact_key_degrading_to_none_is_a_regression_not_a_skip():
     """compiles_steady_state=None happens precisely when the measured
     path is broken (no worker stats flowed) — the exact gate must fire,

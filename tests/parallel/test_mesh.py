@@ -1,0 +1,104 @@
+"""Direct unit coverage for parallel/mesh.py helpers (row_axes,
+row_shard_count, hybrid replica meshes, the ambient-mesh machinery) —
+the conventions every partitioner decision and sharded solver relies on.
+Runs on the 8-virtual-device CPU mesh from tests/conftest.py."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    REPLICA_AXIS,
+    data_axis_size,
+    get_mesh,
+    make_hybrid_mesh,
+    make_mesh,
+    row_axes,
+    row_shard_count,
+    set_mesh,
+    use_mesh,
+)
+
+
+def test_default_mesh_covers_every_device_on_data_axis():
+    mesh = make_mesh()
+    assert mesh.shape[DATA_AXIS] == len(jax.devices())
+    assert row_axes(mesh) == (DATA_AXIS,)
+    assert row_shard_count(mesh) == len(jax.devices())
+
+
+def test_make_mesh_shape_must_cover_devices():
+    with pytest.raises(ValueError, match="does not cover"):
+        make_mesh((3,), devices=jax.devices()[:8])
+
+
+def test_make_mesh_2d_data_model_axes():
+    mesh = make_mesh((4, 2), (DATA_AXIS, MODEL_AXIS), devices=jax.devices()[:8])
+    assert mesh.shape[DATA_AXIS] == 4
+    assert mesh.shape[MODEL_AXIS] == 2
+    # the model axis is NOT a row axis: rows shard over data only
+    assert row_axes(mesh) == (DATA_AXIS,)
+    assert row_shard_count(mesh) == 4
+
+
+def test_hybrid_mesh_rows_span_replica_and_data():
+    hmesh = make_hybrid_mesh(num_replicas=2, devices=jax.devices()[:8])
+    assert hmesh.shape[REPLICA_AXIS] == 2
+    assert hmesh.shape[DATA_AXIS] == 4
+    assert row_axes(hmesh) == (REPLICA_AXIS, DATA_AXIS)
+    assert row_shard_count(hmesh) == 8
+
+
+def test_hybrid_mesh_rejects_indivisible_replica_count():
+    with pytest.raises(ValueError, match="do not divide"):
+        make_hybrid_mesh(num_replicas=3, devices=jax.devices()[:8])
+
+
+def test_hybrid_mesh_defaults_to_process_count_on_cpu():
+    # single-process CPU: slice_index is absent, so replicas default to
+    # max(1, process_count) == 1 — every device on the data axis.
+    hmesh = make_hybrid_mesh(devices=jax.devices()[:4])
+    assert hmesh.shape[REPLICA_AXIS] == 1
+    assert hmesh.shape[DATA_AXIS] == 4
+
+
+def test_use_mesh_scopes_and_restores_ambient_mesh():
+    outer = get_mesh()
+    sub = make_mesh(devices=jax.devices()[:2])
+    with use_mesh(sub) as m:
+        assert m is sub
+        assert get_mesh() is sub
+        assert data_axis_size() == 2
+    assert get_mesh() is outer
+
+
+def test_set_mesh_none_rebuilds_default():
+    set_mesh(None)
+    mesh = get_mesh()
+    assert row_shard_count(mesh) == len(jax.devices())
+
+
+def test_row_sharded_gram_parity_1_vs_8_devices_under_psum():
+    """The collective identity the sharded solvers stand on: a row-sharded
+    AᵀA psummed over the row axes equals the single-device product."""
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.parallel.collectives import allreduce_sum, shard_map
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(16, 6)).astype(np.float32)
+
+    mesh8 = make_mesh(devices=jax.devices()[:8])
+    gram8 = jax.jit(
+        shard_map(
+            lambda x: allreduce_sum(x.T @ x),
+            mesh=mesh8,
+            in_specs=P(DATA_AXIS, None),
+            out_specs=P(None, None),
+        )
+    )(a)
+    want = a.T @ a
+    np.testing.assert_allclose(np.asarray(gram8), want, rtol=1e-5, atol=1e-5)

@@ -1,0 +1,188 @@
+"""Partitioner unit suite: eligibility decisions and fallback reasons,
+row-sharding placement, the plan report, and 1-vs-8-virtual-device
+parity of the gram_stream_init/step/finish protocol when chunks are
+split across the mesh with per-shard partial carries reduced at finish
+(the sharded chunk plan's algebra, docs/PARTITIONING.md)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.parallel import linalg
+from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+from keystone_tpu.parallel.partitioner import (
+    PartitionDecision,
+    Partitioner,
+    R_BELOW_FLOOR,
+    R_BUCKETS_INDIVISIBLE,
+    R_CHUNK_TOO_NARROW,
+    R_DISABLED,
+    R_SINGLE_SHARD,
+    R_UNKNOWN_ROWS,
+    SHARDED,
+    last_partition_report,
+    partition_disabled,
+    reset_partition_report,
+    shard_rows,
+)
+
+
+@pytest.fixture
+def mesh8():
+    mesh = make_mesh(devices=jax.devices()[:8])
+    with use_mesh(mesh):
+        yield mesh
+
+
+@pytest.fixture
+def mesh1():
+    mesh = make_mesh(devices=jax.devices()[:1])
+    with use_mesh(mesh):
+        yield mesh
+
+
+# ------------------------------------------------------------------ decisions
+
+
+def test_fit_decision_eligible_records_mesh_and_spec(mesh8):
+    reset_partition_report()
+    d = Partitioner().decide_fit("est", 4096)
+    assert d.eligible and d.reason == SHARDED
+    assert d.shards == 8
+    assert d.mesh is mesh8
+    assert d.mesh_shape == (8,)
+    assert "data" in d.spec
+    assert [r.to_json() for r in last_partition_report()] == [d.to_json()]
+
+
+@pytest.mark.parametrize(
+    "rows,reason",
+    [(None, R_UNKNOWN_ROWS), (-1, R_UNKNOWN_ROWS), (7, R_BELOW_FLOOR)],
+)
+def test_fit_fallback_reasons(mesh8, rows, reason):
+    d = Partitioner().decide_fit("est", rows)
+    assert not d.eligible
+    assert d.reason == reason
+    assert d.shards == 1 and d.mesh is None
+
+
+def test_single_device_mesh_falls_back(mesh1):
+    d = Partitioner().decide_fit("est", 4096)
+    assert not d.eligible and d.reason == R_SINGLE_SHARD
+
+
+def test_disabled_falls_back(mesh8):
+    with partition_disabled():
+        d = Partitioner().decide_fit("est", 4096)
+    assert not d.eligible and d.reason == R_DISABLED
+
+
+def test_stream_decision_rounds_chunk_to_shard_multiple(mesh8):
+    d = Partitioner().decide_stream("sf", 100)
+    assert d.eligible and d.chunk_rows == 104  # next multiple of 8
+    narrow = Partitioner().decide_stream("sf", 4)
+    assert not narrow.eligible and narrow.reason == R_CHUNK_TOO_NARROW
+
+
+def test_serve_decision_needs_a_divisible_bucket(mesh8):
+    ok = Partitioner().decide_serve("m", [1, 2, 4, 8])
+    assert ok.eligible and "8" in ok.detail
+    bad = Partitioner().decide_serve("m", [1, 2, 4])
+    assert not bad.eligible and bad.reason == R_BUCKETS_INDIVISIBLE
+
+
+def test_record_false_keeps_report_untouched(mesh8):
+    reset_partition_report()
+    Partitioner().decide_fit("est", 4096, record=False)
+    assert last_partition_report() == []
+
+
+def test_min_rows_env_knob(mesh8, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_PARTITION_MIN_ROWS", "100")
+    d = Partitioner().decide_fit("est", 128)  # < 8 shards × 100
+    assert not d.eligible and d.reason == R_BELOW_FLOOR
+
+
+# ------------------------------------------------------------------ placement
+
+
+def test_shard_rows_places_divisible_leaves_only(mesh8):
+    d = Partitioner().decide_fit("est", 4096)
+    tree = {
+        "a": np.zeros((16, 3), np.float32),  # 16 % 8 == 0 → sharded
+        "b": np.zeros((6, 3), np.float32),  # 6 < 8 shards → untouched
+    }
+    placed = shard_rows(d, tree)
+    a_sharding = placed["a"].sharding
+    assert {dev.id for dev in a_sharding.device_set} == {
+        dev.id for dev in mesh8.devices.flat
+    }
+    assert isinstance(placed["b"], np.ndarray)
+
+
+def test_shard_rows_noop_for_ineligible_decision(mesh8):
+    d = PartitionDecision(kind="fit", node="x", eligible=False, reason="r")
+    tree = np.zeros((16, 3), np.float32)
+    assert shard_rows(d, tree) is tree or isinstance(
+        shard_rows(d, tree), np.ndarray
+    )
+
+
+# ------------------------------------------- gram stream parity 1 vs 8 devices
+
+
+def _sequential_gram(x, y, chunk):
+    carry = linalg.gram_stream_init(x.shape[1], y.shape[1])
+    for s in range(0, x.shape[0], chunk):
+        carry = linalg.gram_stream_step(
+            carry, jnp.asarray(x[s : s + chunk]), jnp.asarray(y[s : s + chunk])
+        )
+    return linalg.gram_stream_finish(carry, x.shape[0])
+
+
+def test_gram_stream_sharded_partials_match_single_device(mesh8):
+    """Per-shard partial carries + one finish-time reduction == the
+    sequential single-device accumulation (the identity behind the
+    sharded fit_stream plan), to streaming-parity tolerance."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.parallel.collectives import shard_map
+
+    rng = np.random.default_rng(3)
+    n, d, k, chunk, shards = 64, 8, 3, 16, 8
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n, k)).astype(np.float32)
+
+    spec = P(("data",))
+    sharding = NamedSharding(mesh8, spec)
+    carry = jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            jnp.zeros((shards,) + a.shape, a.dtype), sharding
+        ),
+        linalg.gram_stream_init(d, k),
+    )
+
+    def local(c, xb, yb):
+        c0 = jax.tree_util.tree_map(lambda a: a[0], c)
+        c1 = linalg.gram_stream_step(c0, xb, yb)
+        return jax.tree_util.tree_map(lambda a: a[None], c1)
+
+    step = jax.jit(
+        shard_map(
+            local, mesh=mesh8, in_specs=(spec, spec, spec), out_specs=spec
+        )
+    )
+    for s in range(0, n, chunk):
+        xb = jax.device_put(x[s : s + chunk], sharding)
+        yb = jax.device_put(y[s : s + chunk], sharding)
+        carry = step(carry, xb, yb)
+
+    reduced = jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), carry)
+    got = linalg.gram_stream_finish(reduced, n)
+    want = _sequential_gram(x, y, chunk)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-5
+        )

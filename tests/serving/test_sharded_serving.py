@@ -1,0 +1,127 @@
+"""Sharded bucketed serving: warmup decides the row sharding once
+(attach_serving_partition), every divisible bucket's batch rows land
+NamedSharding-sharded on the warmed executables, steady state compiles
+nothing, and results match the single-device server exactly."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from concurrent.futures import wait
+
+from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+from keystone_tpu.parallel.partitioner import (
+    attach_serving_partition,
+    partition_disabled,
+)
+from keystone_tpu.serving.config import ServingConfig
+from keystone_tpu.serving.server import PipelineServer
+from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+D = 12
+
+
+def _serve(payloads, shard: bool):
+    model = synthetic_fitted_pipeline(d=D)
+    srv = PipelineServer(
+        model=model,
+        config=ServingConfig(max_batch=8, max_wait_ms=1.0, queue_depth=256),
+    )
+    if shard:
+        warm = srv.warmup(payloads[0])
+    else:
+        with partition_disabled():
+            warm = srv.warmup(payloads[0])
+    srv.start()
+    futures = srv.submit_many(payloads)
+    wait(futures, timeout=60)
+    rows = np.stack([np.asarray(f.result()) for f in futures])
+    stats = srv.stats()
+    srv.stop()
+    return warm, rows, stats
+
+
+def test_warmup_attaches_eligible_decision_and_zero_steady_compiles():
+    rng = np.random.default_rng(1)
+    payloads = [rng.normal(size=(D,)).astype(np.float32) for _ in range(48)]
+
+    warm, rows, stats = _serve(payloads, shard=True)
+    decision = warm["partition_decisions"]["default"]
+    assert decision["eligible"] and decision["kind"] == "serve"
+    assert decision["shards"] == len(jax.devices())
+    # zero steady-state XLA compiles WITH row sharding on
+    assert stats["xla_compiles_since_warmup"] == 0
+
+    _, rows_ref, stats_ref = _serve(payloads, shard=False)
+    assert stats_ref["xla_compiles_since_warmup"] == 0
+    rel = np.linalg.norm(rows - rows_ref) / max(
+        np.linalg.norm(rows_ref), 1e-30
+    )
+    assert rel <= 1e-5, rel
+
+
+def test_compiled_apply_places_divisible_batches_sharded():
+    from keystone_tpu.data.dataset import ArrayDataset
+
+    model = synthetic_fitted_pipeline(d=D)
+    decision = attach_serving_partition(model, [1, 2, 4, 8])
+    assert decision.eligible
+    handle = model.compiled_apply()
+    assert handle.partition is decision
+
+    shards = len(jax.devices())
+    batch = np.zeros((shards, D), np.float32)
+    out = handle(ArrayDataset(batch, num_examples=shards))
+    assert np.isfinite(np.asarray(out.data)).all()
+
+
+def test_indivisible_buckets_serve_on_default_placement():
+    model = synthetic_fitted_pipeline(d=D)
+    decision = attach_serving_partition(model, [1, 2])  # no bucket ≥ 8 shards
+    assert not decision.eligible
+    assert decision.reason == "buckets-indivisible"
+    assert model.compiled_apply().partition is None
+
+
+def test_conflicting_reattach_keeps_first_installed_decision():
+    """The CompiledApply handle is shared by every server over a
+    pipeline; its installed (warmed) layout must win over a later,
+    conflicting attach — re-deciding would hand steady-state batches
+    layouts nobody warmed."""
+    model = synthetic_fitted_pipeline(d=D)
+    first = attach_serving_partition(model, [1, 2, 4, 8])
+    assert first.eligible
+    handle = model.compiled_apply()
+    assert handle.partition is first
+
+    # a second consumer with an indivisible bucket set must not strip
+    # (or re-shape) the layout the first warmup compiled
+    second = attach_serving_partition(model, [1, 2])
+    assert second is first
+    assert handle.partition is first
+
+    # re-attaching the SAME contract is idempotent
+    again = attach_serving_partition(model, [1, 2, 4, 8])
+    assert handle.partition is not None
+    assert handle.partition.shards == first.shards
+
+
+def test_serving_attach_does_not_pollute_plan_report():
+    from keystone_tpu.parallel.partitioner import (
+        last_partition_report,
+        reset_partition_report,
+    )
+
+    reset_partition_report()
+    model = synthetic_fitted_pipeline(d=D)
+    attach_serving_partition(model, [1, 2, 4, 8])
+    assert last_partition_report() == []
+
+
+def test_single_device_mesh_serves_unsharded():
+    with use_mesh(make_mesh(devices=jax.devices()[:1])):
+        model = synthetic_fitted_pipeline(d=D)
+        decision = attach_serving_partition(model, [1, 2, 4, 8])
+        assert not decision.eligible
+        assert decision.reason == "single-shard-mesh"

@@ -111,16 +111,18 @@ def test_four_node_chain_fuses_to_one_node():
 
 def test_fusion_rule_is_in_default_optimizer():
     # fusion is the last STRUCTURAL batch; only the streaming planner
-    # (which absorbs already-fused chains) and the measured-knob pass
-    # (which re-parameterizes, never restructures) may follow it.
+    # (which absorbs already-fused chains), the measured-knob pass
+    # (which re-parameterizes, never restructures), and the partition
+    # pass (which pins placement decisions onto final operators) may
+    # follow it.
     names = [b.name for b in default_optimizer().batches]
-    assert names[-3:] == ["fusion", "streaming", "measured-knobs"]
+    assert names[-4:] == ["fusion", "streaming", "measured-knobs", "partition"]
     from keystone_tpu.workflow.rules import auto_caching_optimizer
 
     names = [b.name for b in auto_caching_optimizer().batches]
     # fusion strictly after auto-cache: cache planning sees real nodes
     assert names.index("fusion") == names.index("auto-cache") + 1
-    assert names[-2:] == ["streaming", "measured-knobs"]
+    assert names[-3:] == ["streaming", "measured-knobs", "partition"]
 
 
 def test_cacher_is_a_fusion_boundary():

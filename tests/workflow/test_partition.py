@@ -1,0 +1,241 @@
+"""PartitionPlanRule + sharded execution through the workflow layer:
+the optimizer's partition batch pins decisions onto final operators, the
+streaming engine runs the sharded chunk plan with finish-time reduction,
+ineligible plans fall back cleanly, and the verifier explains both
+(KV203) and errors on infeasible sharded residency (KV304).
+
+The invariant throughout: IDENTICAL pipeline code on 1 and 8 virtual
+devices, parity ≤ 1e-5."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.parallel.mesh import make_mesh, use_mesh
+from keystone_tpu.parallel.partitioner import (
+    last_partition_report,
+    partition_disabled,
+)
+from keystone_tpu.workflow.executor import GraphExecutor, PipelineEnv
+from keystone_tpu.workflow.pipeline import BatchTransformer
+from keystone_tpu.workflow.streaming import (
+    StreamingFitOperator,
+    last_stream_report,
+)
+
+N, D, K = 512, 16, 3
+CHUNK = 64
+
+
+class Scale(BatchTransformer):
+    def __init__(self, c):
+        self.c = float(c)
+
+    def apply_arrays(self, a):
+        return a * self.c
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(N, D)).astype(np.float32)
+    w = rng.normal(size=(D, K)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(size=(N, K))).astype(np.float32)
+    return x, y
+
+
+def _stream_pipeline(x, y, est=None):
+    est = est or BlockLeastSquaresEstimator(8, num_iter=1, reg=1e-3)
+    return Scale(2.0).to_pipeline().then_label_estimator(
+        est, ArrayDataset(x), ArrayDataset(y)
+    )
+
+
+def test_partition_batch_pins_decision_on_streaming_operator(
+    data, monkeypatch
+):
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", str(CHUNK))
+    x, y = data
+    pipe = _stream_pipeline(x, y)
+    executor = GraphExecutor(pipe.graph)
+    graph = executor.graph
+    ops = [
+        graph.get_operator(n)
+        for n in graph.nodes
+        if isinstance(graph.get_operator(n), StreamingFitOperator)
+    ]
+    assert len(ops) == 1
+    decision = ops[0].partition
+    assert decision is not None and decision.eligible
+    assert decision.shards == len(jax.devices())
+    assert decision.chunk_rows == CHUNK  # 64 already divides 8
+    assert ops[0].chunk_rows == decision.chunk_rows
+    # the executor captured the plan's decisions at optimize time
+    assert any(d.eligible for d in executor.partition_decisions)
+
+
+def test_sharded_fit_stream_parity_and_finish_reduce(data, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", str(CHUNK))
+    x, y = data
+
+    fitted = _stream_pipeline(x, y).fit()
+    rep = last_stream_report()
+    assert rep.shards == len(jax.devices())
+    assert rep.mesh_shape == (len(jax.devices()),)
+    # finish-reduce payload: the carry (G, C, Σx, Σy) × (shards−1)
+    carry_bytes = 4 * (D * D + D * K + D + K)
+    assert rep.collective_bytes == carry_bytes * (rep.shards - 1)
+    assert rep.compiles_steady_state == 0
+    preds = np.asarray(fitted.apply_batch(ArrayDataset(x[:32])).data)
+
+    PipelineEnv.reset()
+    with partition_disabled():
+        fitted1 = _stream_pipeline(x, y).fit()
+        assert last_stream_report().shards == 1
+        preds1 = np.asarray(fitted1.apply_batch(ArrayDataset(x[:32])).data)
+
+    rel = np.linalg.norm(preds - preds1) / max(np.linalg.norm(preds1), 1e-30)
+    assert rel <= 1e-5, rel
+
+
+def test_sharded_exact_fit_stream_parity(data, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", str(CHUNK))
+    x, y = data
+    est = LinearMapEstimator(reg=1e-3)
+    fitted = _stream_pipeline(x, y, est=est).fit()
+    assert last_stream_report().shards == len(jax.devices())
+    preds = np.asarray(fitted.apply_batch(ArrayDataset(x[:32])).data)
+    PipelineEnv.reset()
+    with partition_disabled():
+        fitted1 = _stream_pipeline(x, y, est=LinearMapEstimator(reg=1e-3)).fit()
+        preds1 = np.asarray(fitted1.apply_batch(ArrayDataset(x[:32])).data)
+    rel = np.linalg.norm(preds - preds1) / max(np.linalg.norm(preds1), 1e-30)
+    assert rel <= 1e-5, rel
+
+
+def test_chunk_rows_rounded_up_to_shard_multiple(data, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", "100")  # 100 % 8 != 0
+    x, y = data
+    _stream_pipeline(x, y).fit()
+    rep = last_stream_report()
+    assert rep.shards == len(jax.devices())
+    assert rep.chunk_rows == 104
+
+
+def test_in_core_fit_pins_partition_mesh(data):
+    """Below the streaming floor the fit stays in-core; the partition
+    batch still pins an eligible fit decision whose mesh the estimator
+    consults (partitioner.fit_mesh), with 1-vs-8 parity."""
+    x, y = data
+
+    def fit_preds():
+        PipelineEnv.reset()
+        pipe = _stream_pipeline(x, y)  # n=512 < 2·4096 → in-core
+        fitted = pipe.fit()
+        decisions = [d for d in last_partition_report() if d.eligible]
+        return (
+            np.asarray(fitted.apply_batch(ArrayDataset(x[:32])).data),
+            decisions,
+        )
+
+    preds8, decisions = fit_preds()
+    assert decisions and decisions[0].kind == "fit"
+    assert decisions[0].shards == len(jax.devices())
+    with use_mesh(make_mesh(devices=jax.devices()[:1])):
+        preds1, decisions1 = fit_preds()
+        assert not decisions1  # single-shard mesh: recorded fallback
+    rel = np.linalg.norm(preds8 - preds1) / max(np.linalg.norm(preds1), 1e-30)
+    assert rel <= 1e-5, rel
+
+
+def test_ineligible_chunk_falls_back_to_single_device_plan(data, monkeypatch):
+    """chunk_rows below the shard count is a recorded fallback: the plan
+    still fits, single-device, with the reason in the report."""
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", "4")
+    monkeypatch.setenv("KEYSTONE_STREAM_MIN_ROWS", "1")
+    x, y = data
+    fitted = _stream_pipeline(x, y).fit()
+    rep = last_stream_report()
+    assert rep.shards == 1
+    reasons = {d.reason for d in last_partition_report()}
+    assert "chunk-below-shard-count" in reasons
+    preds = np.asarray(fitted.apply_batch(ArrayDataset(x[:16])).data)
+    assert np.isfinite(preds).all()
+
+
+def test_partitionable_false_on_estimator_respected_through_streaming_wrap(
+    data, monkeypatch
+):
+    """The opt-out lives on the estimator the user wrote; the planner's
+    StreamingFitOperator wrapper must not mask it."""
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", str(CHUNK))
+    x, y = data
+    est = BlockLeastSquaresEstimator(8, num_iter=1, reg=1e-3)
+    est.partitionable = False
+    fitted = _stream_pipeline(x, y, est=est).fit()
+    assert last_stream_report().shards == 1
+    decisions = last_partition_report()
+    assert decisions and decisions[0].reason == "operator-opt-out"
+    assert decisions[0].kind == "fit_stream"
+    assert np.isfinite(
+        np.asarray(fitted.apply_batch(ArrayDataset(x[:8])).data)
+    ).all()
+
+
+def test_partition_disabled_records_empty_report(data, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_STREAM_CHUNK_ROWS", str(CHUNK))
+    x, y = data
+    with partition_disabled():
+        _stream_pipeline(x, y).fit()
+    assert last_partition_report() == []
+    assert last_stream_report().shards == 1
+
+
+# ------------------------------------------------------------------- verifier
+
+
+def test_verify_emits_kv203_with_partitioner_reason(data):
+    from keystone_tpu.workflow.verify import verify_graph
+
+    x, y = data
+    pipe = _stream_pipeline(x[:8], y[:8])  # 8 rows < 8 shards × 2 min
+    report = verify_graph(pipe.graph, context="test")
+    diags = report.by_code("KV203")
+    assert diags, report.render()
+    assert any(
+        d.details.get("reason") == "below-rows-floor" for d in diags
+    ), [d.to_json() for d in diags]
+    # the decision list rides the report for check --pipeline --json
+    assert any(not p["eligible"] for p in report.partition)
+
+
+def test_verify_emits_kv304_when_sharded_residency_exceeds_budget(
+    data, monkeypatch
+):
+    from keystone_tpu.workflow.verify import verify_graph
+
+    x, y = data
+    pipe = _stream_pipeline(x, y)
+    # budget below even the O(d²) statistics: sharding cannot save it
+    report = verify_graph(pipe.graph, device_memory_bytes=64, context="test")
+    errors = report.by_code("KV304")
+    assert errors, report.render()
+    assert errors[0].severity == "error"
+    assert errors[0].details.get("shards") == len(jax.devices())
+
+
+def test_verify_no_kv304_within_budget(data):
+    from keystone_tpu.workflow.verify import verify_graph
+
+    x, y = data
+    report = verify_graph(
+        pipe := _stream_pipeline(x, y).graph,
+        device_memory_bytes=int(1e12),
+        context="test",
+    )
+    assert not report.by_code("KV304"), report.render()
+    assert any(p["eligible"] for p in report.partition)
