@@ -1367,6 +1367,182 @@ def _bench_streaming(small: bool) -> dict:
     return out
 
 
+def _bench_blocksparse(small: bool) -> dict:
+    """Block-sparse Gram fast path (docs/AUTOTUNING.md, BLaST): a
+    hashing-TF text featurization fit through the legacy dense path and
+    through the BSR kernels (``ops/pallas/blocksparse.py``), swept over
+    block density by shrinking the hash feature space (same corpus,
+    narrower space → more collisions per feature tile → denser blocks).
+    Per width: exact-gated ``density``/``blocks_skipped`` (pure
+    functions of the deterministic corpus + hash), fit-level and
+    Gram-kernel-level walls on identical device operands, parity, and
+    the ``speedup_ok`` invariant CI bool-gates (sparse Gram ≥2× dense at
+    the sparsest width, parity ≤1e-5). CPU-sized on purpose: the ratio
+    is a MAC-count argument (MACs ∝ block density), not a
+    device-specific one."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from keystone_tpu.data.dataset import ArrayDataset, ObjectDataset
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.nlp.text import HashingTF, block_sparse_features
+    from keystone_tpu.ops.pallas import blocksparse as bs_kernels
+    from keystone_tpu.parallel import linalg
+
+    n, k = 2048, 4
+    # 16-row tiles: doubles the transpose-matmul contraction depth per
+    # stored block (16×d GEMM panels instead of 8×d), which is what the
+    # one-sided Gram's efficiency rides on; topic-grouped rows keep the
+    # density unchanged at this granularity.
+    block_shape = (16, 16)
+    topics, vocab_per_topic = 64, 12
+    widths = [4096, 1024, 256]
+    # Deterministic topical corpus, docs grouped by topic: feature
+    # blocks get the column locality a sorted real corpus has (topic
+    # vocabularies hash into few tiles each).
+    rng = np.random.RandomState(11)
+    docs = []
+    for topic in range(topics):
+        vocab = [f"t{topic}w{j}" for j in range(vocab_per_topic)]
+        for _ in range(n // topics):
+            length = 5 + int(rng.randint(0, 10))
+            docs.append(
+                [vocab[int(rng.randint(0, vocab_per_topic))]
+                 for _ in range(length)]
+            )
+    y = rng.randn(n, k).astype(np.float32)
+    labels = ArrayDataset(y)
+    out: dict = {
+        "n": n, "k": k, "topics": topics,
+        "block_shape": f"{block_shape[0]}x{block_shape[1]}",
+    }
+    # The dispatch ceiling actually in force (tuned / env / default) —
+    # the "choices visible in BENCH json" satellite; the sweep itself
+    # pins the threshold so the leg measures kernels, not store state.
+    out["dispatch_threshold"] = round(bs_kernels.density_threshold(), 4)
+    out["threshold_source"] = (
+        "env" if os.environ.get("KEYSTONE_BLOCKSPARSE_THRESHOLD")
+        else (
+            "tune"
+            if out["dispatch_threshold"] != bs_kernels.DEFAULT_DENSITY_THRESHOLD
+            else "default"
+        )
+    )
+    prev = os.environ.get("KEYSTONE_BLOCKSPARSE_THRESHOLD")
+    os.environ["KEYSTONE_BLOCKSPARSE_THRESHOLD"] = "0.999"
+    try:
+        for d in widths:
+            if _deadline_within(45):
+                out["truncated"] = "child deadline before remaining widths"
+                break
+            tf = HashingTF(d)
+            rows = [tf.apply(doc) for doc in docs]
+            bsr = block_sparse_features(rows, block_shape=block_shape)
+            dense_np = bsr.to_dense()
+            leg: dict = {
+                "d": d,
+                "density": round(bsr.density(), 6),
+                "blocks_skipped": int(bsr.blocks_skipped()),
+            }
+            est = BlockLeastSquaresEstimator(min(256, d), num_iter=1, reg=1e-3)
+            sparse_data, dense_data = ObjectDataset(rows), ArrayDataset(dense_np)
+            # fit-level: BSR fast path vs the legacy dense estimator,
+            # both warmed so no XLA compile is timed
+            est.fit(sparse_data, labels)
+            t0 = time.perf_counter()
+            m_sparse = est.fit(sparse_data, labels)
+            leg["sparse_fit_wall_s"] = round(time.perf_counter() - t0, 4)
+            prev_bs = os.environ.get("KEYSTONE_BLOCKSPARSE")
+            os.environ["KEYSTONE_BLOCKSPARSE"] = "off"
+            try:
+                est.fit(dense_data, labels)
+                t0 = time.perf_counter()
+                m_dense = est.fit(dense_data, labels)
+                leg["dense_fit_wall_s"] = round(time.perf_counter() - t0, 4)
+            finally:
+                if prev_bs is None:
+                    os.environ.pop("KEYSTONE_BLOCKSPARSE", None)
+                else:
+                    os.environ["KEYSTONE_BLOCKSPARSE"] = prev_bs
+            leg["fit_speedup"] = round(
+                leg["dense_fit_wall_s"] / max(leg["sparse_fit_wall_s"], 1e-9), 2
+            )
+            xq = jnp.asarray(dense_np[:256])
+            p_sparse = np.asarray(m_sparse.apply_arrays(xq))
+            p_dense = np.asarray(m_dense.apply_arrays(xq))
+            leg["parity_rel_err"] = float(
+                np.linalg.norm(p_sparse - p_dense)
+                / max(np.linalg.norm(p_dense), 1e-30)
+            )
+            # kernel-level: BSR Gram vs the dense streaming-Gram
+            # accumulate on the SAME device-resident operands, ELL
+            # pre-built — the MACs-∝-density claim isolated from fit
+            # plumbing AND from host conversion/upload jitter (observed
+            # swinging ≥4× under ambient load; conversion cost is what
+            # the un-gated fit walls above report)
+            dj, yj = jnp.asarray(dense_np), jnp.asarray(y)
+            at = bsr.transpose()
+            idx_t, blocks_t = at.to_ell()
+            ij, bj = jnp.asarray(idx_t), jnp.asarray(blocks_t)
+
+            def sparse_gram():
+                g = bs_kernels.ell_matmul(ij, bj, dj, impl="lax")
+                g.block_until_ready()
+                return g[:d, :d]
+
+            def dense_gram():
+                carry = linalg.gram_stream_step(
+                    linalg.gram_stream_init(d, k), dj, yj
+                )
+                carry[0].block_until_ready()
+                return carry[0]
+
+            # min-of-5 timed reps after a warm call: this leg's verdict
+            # bool rides these walls and CI boxes are noisy
+            g_s = sparse_gram()
+            walls = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                g_s = sparse_gram()
+                walls.append(time.perf_counter() - t0)
+            leg["sparse_gram_wall_s"] = round(min(walls), 4)
+            g_ref_dev = dense_gram()
+            walls = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                g_ref_dev = dense_gram()
+                walls.append(time.perf_counter() - t0)
+            leg["dense_gram_wall_s"] = round(min(walls), 4)
+            leg["gram_speedup"] = round(
+                leg["dense_gram_wall_s"] / max(leg["sparse_gram_wall_s"], 1e-9),
+                2,
+            )
+            g_ref = np.asarray(g_ref_dev)
+            leg["gram_parity_rel_err"] = float(
+                np.linalg.norm(np.asarray(g_s) - g_ref)
+                / max(np.linalg.norm(g_ref), 1e-30)
+            )
+            out[f"d{d}"] = leg
+    finally:
+        if prev is None:
+            os.environ.pop("KEYSTONE_BLOCKSPARSE_THRESHOLD", None)
+        else:
+            os.environ["KEYSTONE_BLOCKSPARSE_THRESHOLD"] = prev
+    swept = [out[f"d{d}"] for d in widths if f"d{d}" in out]
+    if swept:
+        # The CI invariant: at SOME swept density the sparse Gram wins
+        # ≥2× at ≤1e-5 parity (best-of-widths, min-of-5 walls — the
+        # MAC-count claim must survive a noisy shared CI box).
+        best = max(swept, key=lambda leg: leg["gram_speedup"])
+        out["best_gram_speedup"] = best["gram_speedup"]
+        out["speedup_ok"] = bool(
+            best["gram_speedup"] >= 2.0
+            and best["gram_parity_rel_err"] <= 1e-5
+        )
+    return out
+
+
 def _bench_sharded(small: bool) -> dict:
     """First-class multi-device partitioning (docs/PARTITIONING.md): the
     same pipeline code run UNCHANGED over 1/2/4/8-device meshes, the
@@ -1575,6 +1751,7 @@ def _workload_registry() -> dict:
         "timit_wide_block": _bench_timit_wide_block,
         "fusion": _bench_fusion,
         "streaming": _bench_streaming,
+        "blocksparse": _bench_blocksparse,
         "sharded": _bench_sharded,
         "serving": _bench_serving,
         "serving_multiworker": _bench_serving_multiworker,

@@ -77,6 +77,40 @@ def build_config(config_cls, args: argparse.Namespace):
     return config_cls(**{k: v for k, v in vars(args).items() if k in names})
 
 
+def add_tune_arguments(parser: argparse.ArgumentParser) -> None:
+    """Flags for ``keystone-tpu tune`` — wired here (stdlib-only) so the
+    CLI's --help/--list paths never import the workflow package (whose
+    __init__ imports jax); ``workflow.tune.tune_from_args`` consumes the
+    parsed namespace at dispatch time."""
+    parser.add_argument(
+        "--tasks", default="stream,solver,blocksparse",
+        help="comma-separated tune tasks (stream, solver, blocksparse)",
+    )
+    parser.add_argument(
+        "--rows", type=int, default=8192,
+        help="synthetic problem rows (pick the shape class you serve)",
+    )
+    parser.add_argument(
+        "--dim", type=int, default=256, help="synthetic feature width",
+    )
+    parser.add_argument(
+        "--classes", type=int, default=4, help="synthetic label width",
+    )
+    parser.add_argument(
+        "--budget", type=int, default=None,
+        help="max measured candidates per task (default KEYSTONE_TUNE_BUDGET)",
+    )
+    parser.add_argument(
+        "--seed", type=int, default=None,
+        help="exploration seed (default KEYSTONE_TUNE_SEED)",
+    )
+    parser.add_argument(
+        "--time-budget-s", type=float, default=None,
+        help="per-task wall budget (default KEYSTONE_TUNE_TIME_S)",
+    )
+    parser.add_argument("--out", default=None, help="write result JSON here")
+
+
 # ----------------------------------------------------------------- registry
 
 
@@ -257,9 +291,23 @@ def main(argv: Optional[list] = None) -> int:
     check_parser = sub.add_parser(
         "check",
         help="static checks: --lint the codebase, --concurrency the lock "
-        "discipline, --pipeline verify a plan graph",
+        "discipline, --pipeline verify a plan graph, --store the profile "
+        "store's provenance",
     )
     add_check_arguments(check_parser)
+
+    # Offline autotuner (docs/AUTOTUNING.md): budgeted measured search
+    # over the plan-knob space, winners persisted to the profile store
+    # under the keys MeasuredKnobRule replays. Flag wiring lives HERE,
+    # not in workflow/tune.py: importing any workflow submodule executes
+    # the package __init__, which imports jax — and --list/--help must
+    # stay jax-free (pinned by tests/lint/test_check_cli.py).
+    tune_parser = sub.add_parser(
+        "tune",
+        help="offline autotuner: search chunk/block/precision/threshold "
+        "knobs per shape class, persist winners to the profile store",
+    )
+    add_tune_arguments(tune_parser)
 
     args = parser.parse_args(argv)
     logging.basicConfig(
@@ -276,6 +324,10 @@ def main(argv: Optional[list] = None) -> int:
         print(
             f"{'check':28s} static tier: keystone-lint + concurrency "
             "analysis + plan-time graph verification"
+        )
+        print(
+            f"{'tune':28s} offline autotuner: measured knob search → "
+            "profile-store winners"
         )
         return 0
 
@@ -303,6 +355,13 @@ def main(argv: Optional[list] = None) -> int:
         from .lint.check import check_from_args
 
         return check_from_args(args)
+
+    if args.workload == "tune":
+        from .utils.compilation_cache import enable_persistent_cache
+        from .workflow.tune import tune_from_args
+
+        enable_persistent_cache()  # measured runs warm the same cache
+        return tune_from_args(args)
 
     if args.workload == "profile":
         from .obs.profile import profile_from_args

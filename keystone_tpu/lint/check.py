@@ -87,6 +87,13 @@ def add_check_arguments(parser: argparse.ArgumentParser) -> None:
         help="deliberately mis-size the input spec (CI negative control)",
     )
     parser.add_argument(
+        "--store",
+        action="store_true",
+        dest="store_report",
+        help="report the profile store's provenance: entries by source "
+        "(observed vs tune) and the tuner-written keys",
+    )
+    parser.add_argument(
         "--json", action="store_true", dest="as_json", help="JSON output"
     )
 
@@ -114,12 +121,48 @@ def check_from_args(args: argparse.Namespace) -> int:
     human: List[str] = []
     ok = True
 
-    if args.lint is None and args.pipeline is None and args.concurrency is None:
+    if (
+        args.lint is None
+        and args.pipeline is None
+        and args.concurrency is None
+        and not getattr(args, "store_report", False)
+    ):
         print(
             "keystone-tpu check: nothing to do "
-            "(pass --lint, --concurrency, and/or --pipeline)"
+            "(pass --lint, --concurrency, --pipeline, and/or --store)"
         )
         return 2
+
+    if getattr(args, "store_report", False):
+        # Profile-store provenance (docs/AUTOTUNING.md): which decisions
+        # were actively searched (source=tune) vs passively replayed
+        # (source=observed). Pure store read — no jax, no device.
+        from ..obs import store as _store
+
+        store = _store.get_store()
+        if store is None:
+            out["store"] = {"enabled": False}
+            human.append("store: disabled (KEYSTONE_PROFILE_STORE=off)")
+        else:
+            by_source = store.by_source()
+            tuned_keys = sorted(
+                {
+                    key
+                    for key, _shape, m in store.entries(any_env=True)
+                    if m.get("source") == "tune"
+                }
+            )
+            out["store"] = {
+                "enabled": True,
+                **store.stats(),
+                "by_source": by_source,
+                "tuned_keys": tuned_keys,
+            }
+            human.append(
+                f"store[{store.path}]: {len(store)} entries, by source "
+                f"{by_source or '{}'}, {len(tuned_keys)} tuned keys"
+            )
+            human += ["  tuned: " + k for k in tuned_keys[:20]]
 
     if args.lint is not None:
         import keystone_tpu

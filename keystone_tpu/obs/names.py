@@ -64,6 +64,16 @@ PROFILE_STORE_INVALIDATIONS = "keystone_profile_store_invalidations_total"
 PROFILE_STORE_ENTRIES = "keystone_profile_store_entries"
 PROFILE_STORE_KNOB_OVERRIDES = "keystone_profile_store_knob_overrides_total"
 
+# ------------------------------------------------------------------ autotuner
+TUNE_CANDIDATES = "keystone_tune_candidates_total"
+TUNE_WINNERS = "keystone_tune_winners_total"
+TUNE_SECONDS = "keystone_tune_seconds"
+KNOB_REJECTED = "keystone_knob_rejected_total"
+
+# ---------------------------------------------------------------- block-sparse
+BLOCKSPARSE_FITS = "keystone_blocksparse_fits_total"
+BLOCKSPARSE_BLOCKS_SKIPPED = "keystone_blocksparse_blocks_skipped_total"
+
 # --------------------------------------------------------------------- solvers
 SOLVER_FIT_SECONDS = "keystone_solver_fit_seconds"
 SOLVER_RUNG_ATTEMPTS = "keystone_solver_rung_attempts_total"
@@ -155,6 +165,12 @@ SCHEMA: Dict[str, Tuple] = {
     PROFILE_STORE_INVALIDATIONS: ("counter", "Entries rejected for a stale environment fingerprint", ()),
     PROFILE_STORE_ENTRIES: ("gauge", "Live entries in the profile store", ()),
     PROFILE_STORE_KNOB_OVERRIDES: ("counter", "Plan knobs overridden from measured observations by MeasuredKnobRule", ("knob",)),
+    TUNE_CANDIDATES: ("counter", "Candidate configurations measured by the offline autotuner", ("task",)),
+    TUNE_WINNERS: ("counter", "Winning configurations persisted to the profile store by the autotuner", ("task",)),
+    TUNE_SECONDS: ("histogram", "Whole autotuner task runs (all budgeted measurements)", ("task",)),
+    KNOB_REJECTED: ("counter", "Measured knob overrides rejected before applying, by knob and reason", ("knob", "reason")),
+    BLOCKSPARSE_FITS: ("counter", "Estimator fits dispatched onto the block-sparse Gram path, by kernel impl", ("impl",)),
+    BLOCKSPARSE_BLOCKS_SKIPPED: ("counter", "Zero feature tiles skipped by block-sparse kernels (MACs never dispatched)", ()),
     SOLVER_FIT_SECONDS: ("histogram", "Solver fit wall time", ("solver",)),
     SOLVER_RUNG_ATTEMPTS: ("counter", "Degradation-ladder rung attempts inside solvers", ("solver",)),
     SOLVER_ITERATIONS: ("counter", "Host-level solver iterations (e.g. L-BFGS steps)", ("solver",)),

@@ -286,18 +286,25 @@ class ProfileStore:
         """Append one observation (merge-on-write: the newest observation
         per (key, shape, backend) wins at read time; the per-key ``obs``
         count survives merges). Never raises — a broken store must not
-        break a fit."""
+        break a fit.
+
+        Every entry carries a ``source`` provenance field in its
+        measurements: ``"observed"`` (default — recorded passively by a
+        fit that happened to run) vs ``"tune"`` (written by the offline
+        autotuner's active search, workflow/tune.py). Replayed and
+        searched decisions stay distinguishable post-hoc — surfaced by
+        ``keystone-tpu check --store`` and the tune/bench json."""
         backend = backend or self.fingerprint()["backend"]
         try:
+            fields = {k: v for k, v in measurements.items() if v is not None}
+            fields.setdefault("source", "observed")
             with self._lock:
                 self._seq += 1
                 rec = {
                     "k": key,
                     "s": shape,
                     "b": backend,
-                    "m": {
-                        k: v for k, v in measurements.items() if v is not None
-                    },
+                    "m": fields,
                     "fp": self.fingerprint(),
                     "seq": self._seq,
                     "obs": 1,
@@ -418,17 +425,24 @@ class ProfileStore:
         shape: Optional[str] = None,
         rows: Optional[str] = None,
         backend: Optional[str] = None,
+        any_env: bool = False,
     ) -> Iterator[Tuple[str, str, Dict[str, Any]]]:
         """Iterate valid (key, shape, measurements) tuples filtered by key
         prefix, exact shape class, or coarse rows bucket — the knob rule's
         query surface. Fingerprint-stale entries are skipped silently
-        (invalidation is counted at lookup, the authoritative read)."""
-        backend = backend or self.fingerprint()["backend"]
-        fp = self.fingerprint()
+        (invalidation is counted at lookup, the authoritative read).
+        ``any_env=True`` skips the fingerprint/backend filter — for
+        provenance REPORTING only (``check --store`` runs jax-free and
+        must still see what a tuned process wrote), never for replay."""
+        if not any_env:
+            backend = backend or self.fingerprint()["backend"]
+            fp = self.fingerprint()
         with self._lock:
             snapshot: List[Dict[str, Any]] = list(self._entries.values())
         for rec in snapshot:
-            if str(rec.get("b", "")) != backend or rec.get("fp") != fp:
+            if not any_env and (
+                str(rec.get("b", "")) != backend or rec.get("fp") != fp
+            ):
                 continue
             if key_prefix and not rec["k"].startswith(key_prefix):
                 continue
@@ -441,6 +455,17 @@ class ProfileStore:
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)
+
+    def by_source(self) -> Dict[str, int]:
+        """Live entry counts per provenance source (``observed`` vs
+        ``tune``) — the check/tune CLI surface for "which decisions were
+        searched vs merely replayed"."""
+        counts: Dict[str, int] = {}
+        with self._lock:
+            for rec in self._entries.values():
+                src = str(rec.get("m", {}).get("source", "observed"))
+                counts[src] = counts.get(src, 0) + 1
+        return counts
 
     def stats(self) -> Dict[str, Any]:
         with self._lock:

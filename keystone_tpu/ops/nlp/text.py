@@ -180,7 +180,12 @@ class HashingTF(Transformer):
     """Terms → sparse term-frequency vector via the hashing trick
     (reference: nodes/nlp/HashingTF.scala). Output rows are scipy CSR
     (1, num_features) — the host-side sparse format the Densify/sparse
-    solver path consumes."""
+    solver path consumes, and BSR-eligible: a dataset of these rows fed
+    straight into ``BlockLeastSquaresEstimator`` (no Densify) fits on the
+    block-sparse Gram kernels when block density is below the tuned
+    threshold — eligibility is probed on the rows themselves
+    (``utils.sparse.is_sparse_rows``), not declared here
+    (:func:`block_sparse_features`, docs/AUTOTUNING.md)."""
 
     def __init__(self, num_features: int):
         self.num_features = num_features
@@ -192,11 +197,28 @@ class HashingTF(Transformer):
         return csr_row(tf, self.num_features)
 
 
+def block_sparse_features(rows, block_shape=None):
+    """Stack hashing-TF / vectorizer CSR rows into the BSR container the
+    block-sparse Gram kernels consume (``ops/pallas/blocksparse.py``) —
+    the dense matrix is never materialized. ``block_shape`` defaults to
+    the env/tile default shrunk to the feature width."""
+    from ...ops.pallas.blocksparse import default_block_shape
+    from ...utils.sparse import BlockSparseMatrix
+
+    items = rows.collect() if isinstance(rows, Dataset) else list(rows)
+    if not items:
+        raise ValueError("no rows to convert")
+    if block_shape is None:
+        block_shape = default_block_shape(int(items[0].shape[-1]))
+    return BlockSparseMatrix.from_csr_rows(items, block_shape)
+
+
 class NGramsHashingTF(Transformer):
     """Rolling-hash fusion of NGramsFeaturizer >> HashingTF
     (reference: nodes/nlp/NGramsHashingTF.scala:25-121): hashes each n-gram
     incrementally without materializing it; produces the exact same sparse
-    vector as the unfused pair."""
+    vector as the unfused pair (and the same BSR-eligible row format as
+    :class:`HashingTF`)."""
 
     def __init__(self, orders: Sequence[int], num_features: int):
         self.featurizer_check = NGramsFeaturizer(orders)  # validates orders

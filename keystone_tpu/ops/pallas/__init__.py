@@ -1,6 +1,6 @@
-"""Pallas TPU kernels — currently empty, by measurement.
+"""Pallas TPU kernels — populated only where XLA's emitter can't win.
 
-Round 3 measured the two candidate kernels on a real v5e chip with
+Round 3 measured the two candidate dense kernels on a real v5e chip with
 dispatch-latency-free slope timing (K invocations inside one jitted
 fori_loop over dynamically-offset slices, lo=8 / hi=72, medians of 3):
 
@@ -14,12 +14,39 @@ fused panel @ W (ring hop)   164.3       127.2          XLA 1.3x
 
 XLA's matmul emitter + fused elementwise epilogue already keeps the
 squared-distance intermediate out of HBM well enough that hand tiling
-loses; the raw Gram matmul itself runs at 96.8% of bf16 peak (see
-bench.py gram_mfu, `method: slope`). Both kernels were therefore deleted
-rather than shipped dark (round-2 verdict: "measure the Pallas kernels or
-delete them"). If a future op is NOT emitter-friendly (ragged gathers,
-data-dependent masks), this package is where its kernel goes — with an
-on-chip slope measurement before it becomes a default.
+loses; both dense kernels were therefore deleted rather than shipped dark
+(round-2 verdict: "measure the Pallas kernels or delete them").
+
+The package's first SHIPPED kernels (``blocksparse.py``) are exactly the
+excepted case that verdict carved out: block-sparse (BSR) matmul and Gram
+accumulation, where the work to skip is data-dependent (which feature
+tiles of a hashing-TF matrix are nonzero) and no dense emitter can skip
+it. A ``jax.lax`` block-gather fallback shares the interface off-TPU;
+``interpret=True`` exists for parity tests only, and the on-chip slope
+measurement discipline still applies before any new kernel becomes a
+default.
 """
 
-__all__: list = []
+from .blocksparse import (
+    DEFAULT_BLOCK_SHAPE,
+    DEFAULT_DENSITY_THRESHOLD,
+    BlockSparseMatrix,
+    bsr_gram_totals,
+    bsr_matmul,
+    default_block_shape,
+    density_threshold,
+    ell_matmul,
+    resolve_impl,
+)
+
+__all__ = [
+    "DEFAULT_BLOCK_SHAPE",
+    "DEFAULT_DENSITY_THRESHOLD",
+    "BlockSparseMatrix",
+    "bsr_gram_totals",
+    "bsr_matmul",
+    "default_block_shape",
+    "density_threshold",
+    "ell_matmul",
+    "resolve_impl",
+]

@@ -307,7 +307,21 @@ def gram(
 
     Zero-padded rows contribute nothing, so callers may pass padded arrays.
     (Replaces mlmatrix ``NormalEquations``' treeReduce of partition Grams.)
+
+    ``a`` may also be a host-side
+    :class:`~keystone_tpu.utils.sparse.BlockSparseMatrix`: the Gram then
+    runs on the block-sparse kernels (``ops/pallas/blocksparse.py``),
+    skipping zero tiles entirely — single-device (no mesh reduce; the
+    block-sparse tier is below the partitioner's row floors today).
     """
+    from ..utils.sparse import BlockSparseMatrix
+
+    if isinstance(a, BlockSparseMatrix):
+        from ..ops.pallas.blocksparse import bsr_gram_totals
+
+        zeros = jnp.zeros((a.shape[0], 1), jnp.float32) if b is None else b
+        g, c, _sa, _sb = bsr_gram_totals(a, zeros, precision=precision())
+        return g, (None if b is None else c)
     mesh = mesh or get_mesh()
     if b is None:
         return _gram_fn(mesh)(a), None

@@ -56,6 +56,7 @@ from .streaming import (
 )
 from .knobs import MeasuredKnobRule, knob_mode
 from .tracing import PipelineTrace, current_trace, trace
+from .tune import RidgeCostModel, Tuner, TuneOutcome, TuneSpace
 
 __all__ = [
     "Graph", "NodeId", "SinkId", "SourceId",
@@ -75,5 +76,6 @@ __all__ = [
     "stream_pipelined", "last_stream_report",
     "streaming_enabled", "streaming_disabled", "set_streaming_enabled",
     "MeasuredKnobRule", "knob_mode",
+    "RidgeCostModel", "Tuner", "TuneOutcome", "TuneSpace",
     "PipelineTrace", "current_trace", "trace",
 ]

@@ -89,7 +89,8 @@ def _best_entry(
 
 
 def _unanimous_winner(
-    store, key_prefix: str, rows: str, field: str
+    store, key_prefix: str, rows: str, field: str,
+    knob: Optional[str] = None, sp=None,
 ) -> Optional[Dict[str, Any]]:
     """Group matching entries by their FULL shape class (exact d, not
     just the rows bucket), take the best-wall entry per group, and return
@@ -97,7 +98,13 @@ def _unanimous_winner(
     across different feature widths are incommensurable — a knob measured
     fast on a 64-wide problem must not win a 4096-wide one — but when
     every width in the scale band independently picked the same setting,
-    the measurement transfers."""
+    the measurement transfers.
+
+    A drop is never silent: disagreeing widths are counted in
+    ``keystone_knob_rejected_total{knob,reason="non_unanimous"}`` and
+    recorded as a span event naming the contenders, so a tuning gap
+    (more measurements needed before the override can apply) is visible
+    instead of an invisible no-op."""
     groups: Dict[str, Tuple[float, Dict[str, Any]]] = {}
     for key, shape, m in sorted(
         store.entries(key_prefix=key_prefix, rows=rows)
@@ -112,8 +119,23 @@ def _unanimous_winner(
         return None
     winners = {repr(m[field]) for _, m in groups.values()}
     if len(winners) != 1:
+        _reject_knob(
+            knob or field, "non_unanimous", sp=sp,
+            contenders=sorted(winners), groups=len(groups), rows=rows,
+        )
         return None  # the widths disagree: no defensible override
     return next(iter(groups.values()))[1]
+
+
+def _reject_knob(knob: str, reason: str, sp=None, **detail: Any) -> None:
+    """Count + trace a measured override that was dropped before it
+    could apply (the satellite of docs/AUTOTUNING.md: tuning gaps must
+    be observable, not invisible no-ops)."""
+    _names.metric(_names.KNOB_REJECTED).inc(knob=knob, reason=reason)
+    if sp is not None:
+        sp.set_attribute(f"knob_rejected:{knob}", reason)
+    _spans.add_span_event("measured_knob_rejected", knob=knob, reason=reason,
+                          **{k: repr(v) for k, v in detail.items()})
 
 
 class MeasuredKnobRule(Rule):
@@ -217,7 +239,8 @@ class MeasuredKnobRule(Rule):
             # winner must be unanimous across feature widths in the
             # bucket: absolute walls from different d never compete.
             best = _unanimous_winner(
-                store, "solver:block_ls:", rows, "block_size"
+                store, "solver:block_ls:", rows, "block_size",
+                knob="solver_block_size", sp=sp,
             )
             if best is None:
                 continue
@@ -265,7 +288,8 @@ class MeasuredKnobRule(Rule):
             # the winning precision must be unanimous across the bucket's
             # feature widths.
             best = _unanimous_winner(
-                store, "solver:block_ls:", rows, "precision"
+                store, "solver:block_ls:", rows, "precision",
+                knob="solver_precision", sp=sp,
             )
             if best is None:
                 continue
@@ -278,6 +302,10 @@ class MeasuredKnobRule(Rule):
                 logger.warning(
                     "measured precision override rejected: unknown mode %r",
                     precision,
+                )
+                _reject_knob(
+                    "solver_precision", "invalid_value", sp=sp,
+                    value=precision,
                 )
                 continue
             # Scoped to THIS operator's fit (operators.py wraps

@@ -120,6 +120,37 @@ def test_schema_registers_cleanly_and_is_documented():
     assert not missing, f"metric names undocumented in docs/OBSERVABILITY.md: {missing}"
 
 
+def test_autotuning_doc_in_sync_with_tune_surface():
+    """docs/AUTOTUNING.md must document every keystone_tune_* /
+    blocksparse / knob-rejected metric name and every KEYSTONE_TUNE_*
+    env knob the tuner reads — the doc is the operator's contract for
+    the search (PR satellite: docs-sync over the new names)."""
+    import os
+    import re
+
+    root = os.path.join(os.path.dirname(__file__), "..", "..")
+    doc = open(os.path.join(root, "docs", "AUTOTUNING.md")).read()
+    tune_metrics = [
+        n for n in names.ALL_METRIC_NAMES
+        if n.startswith(("keystone_tune_", "keystone_blocksparse_"))
+        or n == "keystone_knob_rejected_total"
+    ]
+    assert len(tune_metrics) >= 6
+    missing = [n for n in tune_metrics if n not in doc]
+    assert not missing, f"undocumented in docs/AUTOTUNING.md: {missing}"
+    # every KEYSTONE_TUNE_* knob read by workflow/tune.py is documented
+    src = open(
+        os.path.join(root, "keystone_tpu", "workflow", "tune.py")
+    ).read()
+    knobs = set(re.findall(r"KEYSTONE_TUNE_[A-Z_]+", src))
+    assert knobs  # the tuner actually reads budget knobs
+    undocumented = [k for k in sorted(knobs) if k not in doc]
+    assert not undocumented, (
+        f"KEYSTONE_TUNE_* knobs undocumented in docs/AUTOTUNING.md: "
+        f"{undocumented}"
+    )
+
+
 def test_register_all_idempotent_on_global_registry():
     names.register_all()
     names.register_all()  # second call must not raise or duplicate

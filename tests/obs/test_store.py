@@ -54,10 +54,10 @@ def test_record_lookup_round_trip_and_newest_wins(tmp_path):
     s.record("k", "n2^4", wall_s=1.0)
     s.record("k", "n2^4", wall_s=2.5)
     m = s.lookup("k", "n2^4")
-    assert m == {"wall_s": 2.5}
+    assert m == {"wall_s": 2.5, "source": "observed"}
     # a FRESH instance over the same file sees the same merged view
     s2 = make_store(tmp_path)
-    assert s2.lookup("k", "n2^4") == {"wall_s": 2.5}
+    assert s2.lookup("k", "n2^4") == {"wall_s": 2.5, "source": "observed"}
     assert s2._entries[("k", "n2^4", "cpu")]["obs"] == 2
 
 
@@ -65,7 +65,7 @@ def test_lookup_miss_and_backend_isolation(tmp_path):
     s = make_store(tmp_path)
     s.record("k", "n2^4", backend="tpu", wall_s=1.0)
     assert s.lookup("k", "n2^4") is None  # default backend is cpu
-    assert s.lookup("k", "n2^4", backend="tpu") == {"wall_s": 1.0}
+    assert s.lookup("k", "n2^4", backend="tpu") == {"wall_s": 1.0, "source": "observed"}
     assert s.misses == 1 and s.hits == 1
 
 
@@ -81,7 +81,7 @@ def test_fingerprint_invalidation_on_environment_change(tmp_path):
     assert changed.lookup("k", "n2^4") is None
     assert changed.invalidations == 1
     # the original environment still reads it
-    assert make_store(tmp_path).lookup("k", "n2^4") == {"wall_s": 1.0}
+    assert make_store(tmp_path).lookup("k", "n2^4") == {"wall_s": 1.0, "source": "observed"}
 
 
 def test_torn_lines_are_skipped_not_fatal(tmp_path):
@@ -90,7 +90,7 @@ def test_torn_lines_are_skipped_not_fatal(tmp_path):
     with open(s.path, "a") as f:
         f.write('{"k": "torn", "s": "n2^4"')  # no newline, no close brace
     s2 = make_store(tmp_path)
-    assert s2.lookup("good", "n2^4") == {"wall_s": 1.0}
+    assert s2.lookup("good", "n2^4") == {"wall_s": 1.0, "source": "observed"}
     assert s2.lookup("torn", "n2^4") is None
 
 

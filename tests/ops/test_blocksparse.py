@@ -1,0 +1,294 @@
+"""Block-sparse kernels: BSR container round-trips, lax-vs-Pallas
+(interpret) parity at ≤1e-5, and the estimator fast path dispatching on
+the tuned density threshold (docs/AUTOTUNING.md)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.dataset import ArrayDataset, ObjectDataset
+from keystone_tpu.obs import names as _names
+from keystone_tpu.ops.pallas import blocksparse as bs
+from keystone_tpu.utils.sparse import BlockSparseMatrix, is_sparse_rows
+
+BM, BN = 8, 16
+
+
+def _block_sparse_dense(rng, m, d, density):
+    """Dense (m, d) matrix whose nonzero structure is block-sparse."""
+    nbr = (m + BM - 1) // BM
+    nbc = (d + BN - 1) // BN
+    keep = rng.rand(nbr, nbc) < density
+    keep[0, 0] = True
+    vals = rng.randn(nbr, BM, nbc, BN).astype(np.float32)
+    return (vals * keep[:, None, :, None]).reshape(nbr * BM, nbc * BN)[:m, :d]
+
+
+# ----------------------------------------------------------- the container
+
+
+def test_from_dense_round_trip_and_counts():
+    rng = np.random.RandomState(0)
+    a = _block_sparse_dense(rng, 50, 70, 0.3)  # ragged: padding exercised
+    bsr = BlockSparseMatrix.from_dense(a, (BM, BN))
+    assert bsr.shape == (50, 70)
+    assert np.allclose(bsr.to_dense(), a)
+    total = bsr.n_block_rows * bsr.n_block_cols
+    assert bsr.nnz_blocks + bsr.blocks_skipped() == total
+    assert bsr.density() == pytest.approx(bsr.nnz_blocks / total)
+
+
+def test_density_probes_agree_with_container():
+    from keystone_tpu.utils.sparse import block_density, block_density_exceeds
+
+    rng = np.random.RandomState(12)
+    for m, d, density in ((50, 70, 0.3), (128, 64, 0.05), (64, 64, 1.0)):
+        a = _block_sparse_dense(rng, m, d, density)
+        bsr = BlockSparseMatrix.from_dense(a, (BM, BN))
+        exact = block_density(a, (BM, BN))
+        assert exact == pytest.approx(bsr.density())
+        for threshold in (0.01, exact, 0.99):
+            # the banded early-exit probe must agree with the exact
+            # density at every threshold (incl. bands smaller than nbr)
+            assert block_density_exceeds(
+                a, (BM, BN), threshold, band_rows=2
+            ) == (exact > threshold)
+
+
+def test_from_csr_rows_matches_from_dense():
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    rng = np.random.RandomState(1)
+    a = _block_sparse_dense(rng, 40, 64, 0.2)
+    rows = [scipy_sparse.csr_matrix(a[i : i + 1]) for i in range(40)]
+    assert is_sparse_rows(rows)
+    bsr = BlockSparseMatrix.from_csr_rows(rows, (BM, BN))
+    assert np.allclose(bsr.to_dense(), a)
+    # no dense detour: stored blocks match the dense-tiled construction
+    ref = BlockSparseMatrix.from_dense(a, (BM, BN))
+    assert bsr.nnz_blocks == ref.nnz_blocks
+
+
+def test_transpose_and_ell():
+    rng = np.random.RandomState(2)
+    a = _block_sparse_dense(rng, 32, 48, 0.25)
+    bsr = BlockSparseMatrix.from_dense(a, (BM, BN))
+    assert np.allclose(bsr.transpose().to_dense(), a.T)
+    idx, blocks = bsr.to_ell()
+    assert idx.shape[0] == bsr.n_block_rows
+    assert blocks.shape[1:] == (idx.shape[1], BM, BN)
+    # rebuild from ELL: padded slots are zero blocks at column 0 — inert
+    rebuilt = np.zeros((bsr.padded_shape[1] // BN, BN, idx.shape[0] * BM))
+    dense = np.zeros(bsr.padded_shape, np.float32)
+    for i in range(idx.shape[0]):
+        for k in range(idx.shape[1]):
+            j = idx[i, k]
+            dense[i * BM:(i + 1) * BM, j * BN:(j + 1) * BN] += blocks[i, k]
+    assert np.allclose(dense[:32, :48], a)
+
+
+# -------------------------------------------------------------- the kernels
+
+
+def test_matmul_parity_lax_vs_numpy_vs_interpret():
+    rng = np.random.RandomState(3)
+    a = _block_sparse_dense(rng, 48, 64, 0.3)
+    bsr = BlockSparseMatrix.from_dense(a, (BM, BN))
+    b = rng.randn(64, 5).astype(np.float32)
+    ref = a @ b
+    scale = np.abs(ref).max()
+    out_lax = np.asarray(bs.bsr_matmul(bsr, b, impl="lax"))
+    out_int = np.asarray(bs.bsr_matmul(bsr, b, impl="pallas", interpret=True))
+    assert np.abs(out_lax - ref).max() / scale <= 1e-5
+    # the CI parity gate's bound: interpret-vs-fallback ≤ 1e-5
+    assert np.abs(out_int - out_lax).max() / scale <= 1e-5
+
+
+def test_gram_totals_match_dense_reference_and_interpret():
+    rng = np.random.RandomState(4)
+    a = _block_sparse_dense(rng, 56, 48, 0.25)
+    bsr = BlockSparseMatrix.from_dense(a, (BM, BN))
+    y = rng.randn(56, 3).astype(np.float32)
+    g, c, sa, sb = [np.asarray(v) for v in bs.bsr_gram_totals(bsr, y, impl="lax")]
+    assert np.abs(g - a.T @ a).max() / np.abs(a.T @ a).max() <= 1e-5
+    assert np.abs(c - a.T @ y).max() / np.abs(a.T @ y).max() <= 1e-5
+    assert np.allclose(sa, a.sum(axis=0), atol=1e-4)
+    assert np.allclose(sb, y.sum(axis=0), atol=1e-4)
+    gi, ci, *_ = [
+        np.asarray(v)
+        for v in bs.bsr_gram_totals(bsr, y, impl="pallas", interpret=True)
+    ]
+    assert np.abs(gi - g).max() / np.abs(g).max() <= 1e-5
+    assert np.abs(ci - c).max() / max(np.abs(c).max(), 1e-9) <= 1e-5
+
+
+def test_duplicate_blocks_accumulate():
+    blocks = np.ones((2, BM, BN), np.float32)
+    bsr = BlockSparseMatrix(
+        (BM, BN), (BM, BN), np.array([0, 2]), np.array([0, 0]), blocks
+    )
+    assert np.allclose(bsr.to_dense(), 2.0)
+    out = np.asarray(bs.bsr_matmul(bsr, np.ones((BN, 2), np.float32)))
+    assert np.allclose(out, 2.0 * BN)
+
+
+# ---------------------------------------------------------------- dispatch
+
+
+def test_density_threshold_resolution(tmp_path, monkeypatch):
+    from keystone_tpu.obs.store import ProfileStore, set_store, shape_class
+
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_THRESHOLD", "0.42")
+    assert bs.density_threshold() == pytest.approx(0.42)
+    monkeypatch.delenv("KEYSTONE_BLOCKSPARSE_THRESHOLD")
+    # tuned store entry wins over the shipped default
+    monkeypatch.setenv("KEYSTONE_PROFILE_STORE", str(tmp_path / "ps.jsonl"))
+    st = ProfileStore(str(tmp_path / "ps.jsonl"))
+    set_store(st)
+    try:
+        shape = shape_class(4096, (512,), "float32")
+        st.record("blocksparse:threshold", shape, threshold=0.11,
+                  speedup=3.0, source="tune")
+        assert bs.density_threshold(rows="n2^12") == pytest.approx(0.11)
+        # no matching bucket: the shipped default
+        assert bs.density_threshold(rows="n2^20") == pytest.approx(
+            bs.DEFAULT_DENSITY_THRESHOLD
+        )
+    finally:
+        set_store(None)
+
+
+def test_default_block_shape_env_and_shrink(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_BLOCK", "16x64")
+    assert bs.default_block_shape() == (16, 64)
+    monkeypatch.delenv("KEYSTONE_BLOCKSPARSE_BLOCK")
+    bm, bn = bs.default_block_shape(64)  # tiny d: lane dim shrinks
+    assert bn <= 64
+
+
+# ------------------------------------------------------ estimator fast path
+
+
+def _sparse_problem(rng, n=512, d=256, k=2, density=0.08):
+    a = _block_sparse_dense(rng, n, d, density)
+    y = rng.randn(n, k).astype(np.float32)
+    return a, y
+
+
+def test_fast_path_parity_and_metrics(monkeypatch):
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_BLOCK", f"{BM}x{BN}")
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_THRESHOLD", "0.3")
+    rng = np.random.RandomState(5)
+    a, y = _sparse_problem(rng)
+    est = BlockLeastSquaresEstimator(64, num_iter=2, reg=1e-3)
+    fits = _names.metric(_names.BLOCKSPARSE_FITS)
+    skipped = _names.metric(_names.BLOCKSPARSE_BLOCKS_SKIPPED)
+    before, skipped_before = fits.value(impl="lax"), skipped.value()
+    sparse_model = est.fit(ArrayDataset(a), ArrayDataset(y))
+    assert fits.value(impl="lax") == before + 1
+    assert skipped.value() > skipped_before
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE", "off")
+    dense_model = est.fit(ArrayDataset(a), ArrayDataset(y))
+    p_sparse = np.asarray(sparse_model.apply_arrays(jnp.asarray(a[:64])))
+    p_dense = np.asarray(dense_model.apply_arrays(jnp.asarray(a[:64])))
+    rel = np.abs(p_sparse - p_dense).max() / np.abs(p_dense).max()
+    assert rel <= 1e-4  # same math as fit_stream; BCD-order differences only
+
+
+def test_fast_path_consumes_csr_row_datasets(monkeypatch):
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_BLOCK", f"{BM}x{BN}")
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_THRESHOLD", "0.3")
+    rng = np.random.RandomState(6)
+    a, y = _sparse_problem(rng)
+    rows = [scipy_sparse.csr_matrix(a[i : i + 1]) for i in range(len(a))]
+    est = BlockLeastSquaresEstimator(64, num_iter=1, reg=1e-3)
+    m_rows = est.fit(ObjectDataset(rows), ArrayDataset(y))
+    m_dense = est.fit(ArrayDataset(a), ArrayDataset(y))
+    p1 = np.asarray(m_rows.apply_arrays(jnp.asarray(a[:32])))
+    p2 = np.asarray(m_dense.apply_arrays(jnp.asarray(a[:32])))
+    assert np.abs(p1 - p2).max() / np.abs(p2).max() <= 1e-5
+
+
+def test_dense_input_above_threshold_keeps_legacy_path(monkeypatch):
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_THRESHOLD", "0.01")
+    rng = np.random.RandomState(7)
+    a = rng.randn(256, 64).astype(np.float32)  # fully dense
+    y = rng.randn(256, 2).astype(np.float32)
+    est = BlockLeastSquaresEstimator(32, num_iter=1, reg=1e-3)
+    fits = _names.metric(_names.BLOCKSPARSE_FITS)
+    before = fits.total()
+    est.fit(ArrayDataset(a), ArrayDataset(y))
+    assert fits.total() == before  # never dispatched sparse
+
+
+def test_csr_rows_above_threshold_densify_through_bsr(monkeypatch):
+    scipy_sparse = pytest.importorskip("scipy.sparse")
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_THRESHOLD", "0.001")
+    rng = np.random.RandomState(8)
+    a = rng.randn(128, 64).astype(np.float32)
+    y = rng.randn(128, 2).astype(np.float32)
+    rows = [scipy_sparse.csr_matrix(a[i : i + 1]) for i in range(len(a))]
+    est = BlockLeastSquaresEstimator(32, num_iter=1, reg=1e-3)
+    m = est.fit(ObjectDataset(rows), ArrayDataset(y))  # must not crash
+    ref = est.fit(ArrayDataset(a), ArrayDataset(y))
+    p1 = np.asarray(m.apply_arrays(jnp.asarray(a[:16])))
+    p2 = np.asarray(ref.apply_arrays(jnp.asarray(a[:16])))
+    assert np.abs(p1 - p2).max() / np.abs(p2).max() <= 1e-5
+
+
+def test_fast_path_oom_degrades_through_ladder(monkeypatch):
+    """The sparse dispatch keeps the estimator's OOM contract: a first-
+    attempt OOM halves the block through the DegradationLadder instead
+    of raising (the dense paths' behavior, preserved)."""
+    from keystone_tpu import reliability
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.reliability import FaultSpec
+
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_BLOCK", f"{BM}x{BN}")
+    monkeypatch.setenv("KEYSTONE_BLOCKSPARSE_THRESHOLD", "0.3")
+    rng = np.random.RandomState(10)
+    a, y = _sparse_problem(rng, n=256, d=256)
+    est = BlockLeastSquaresEstimator(64, num_iter=1, reg=1e-3)
+    with reliability.injected(
+        FaultSpec(
+            match="BlockLeastSquaresEstimator.solve", kind="oom", first_n=1
+        )
+    ):
+        m = est.fit(ArrayDataset(a), ArrayDataset(y))
+    assert m.degradation["reduced"] and m.block_size == 32
+
+
+def test_hashing_tf_block_sparse_features():
+    pytest.importorskip("scipy.sparse")
+    from keystone_tpu.ops.nlp.text import HashingTF, block_sparse_features
+
+    tf = HashingTF(512)
+    docs = [["alpha", "beta", "alpha"], ["gamma"], ["beta", "delta"]]
+    rows = [tf.apply(doc) for doc in docs]
+    bsr = block_sparse_features(rows, block_shape=(BM, BN))
+    assert bsr.shape == (3, 512)
+    assert bsr.density() < 0.5
+    stacked = np.vstack([r.toarray() for r in rows])
+    assert np.allclose(bsr.to_dense(), stacked)
+
+
+def test_linalg_gram_accepts_bsr():
+    from keystone_tpu.parallel import linalg
+
+    rng = np.random.RandomState(9)
+    a = _block_sparse_dense(rng, 64, 48, 0.2)
+    bsr = BlockSparseMatrix.from_dense(a, (BM, BN))
+    g, _ = linalg.gram(bsr)
+    assert np.abs(np.asarray(g) - a.T @ a).max() / np.abs(a.T @ a).max() <= 1e-5
+    b = rng.randn(64, 3).astype(np.float32)
+    g2, atb = linalg.gram(bsr, b)
+    assert np.abs(np.asarray(atb) - a.T @ b).max() / np.abs(a.T @ b).max() <= 1e-5

@@ -1,0 +1,309 @@
+"""Offline autotuner: jax-free search-core tests on a deterministic
+synthetic cost surface, plus the store round-trip that proves a tuned
+entry actually lands in a plan knob (docs/AUTOTUNING.md)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.obs import names as _names
+from keystone_tpu.obs.store import ProfileStore
+from keystone_tpu.workflow.tune import (
+    Measurement,
+    RidgeCostModel,
+    Tuner,
+    TuneSpace,
+)
+
+FP = {"jax": "test", "backend": "cpu", "device_kind": "virtual"}
+
+
+def store(tmp_path):
+    return ProfileStore(str(tmp_path / "ps.jsonl"), fingerprint=dict(FP))
+
+
+# ----------------------------------------------------------- the cost model
+
+
+def test_ridge_model_ranks_a_loglinear_surface():
+    space = TuneSpace("t", {"chunk_rows": [256, 512, 1024, 2048, 4096]})
+    cands = space.grid()
+    # wall grows with |log2(c) - log2(1024)|: optimum at 1024
+    cost = [2.0 ** abs(np.log2(c["chunk_rows"]) - 10.0) for c in cands]
+    model = RidgeCostModel().fit([space.encode(c) for c in cands], cost)
+    preds = model.predict([space.encode(c) for c in cands])
+    # the model need not be exact — it must RANK the optimum's basin
+    # first (the quadratic log2 features capture the V shape)
+    assert cands[int(np.argmin(preds))]["chunk_rows"] == 1024
+
+
+def test_space_encoding_numeric_and_categorical():
+    space = TuneSpace(
+        "t", {"block": [32, 64], "precision": ["default", "highest"]}
+    )
+    f = space.encode({"block": 32, "precision": "highest"})
+    # log2 + log2² features + one-hot(2)
+    assert len(f) == 4
+    assert f != space.encode({"block": 64, "precision": "highest"})
+
+
+# --------------------------------------------------------------- the search
+
+
+def _surface(cand):
+    """Deterministic synthetic cost surface with a unique known optimum
+    at (chunk_rows=2048, prefetch=2): smooth in log2(chunk), small
+    additive prefetch effect — the shape a real chunk sweep has."""
+    wall = 2.0 ** abs(np.log2(cand["chunk_rows"]) - 11.0)
+    wall += 0.25 if cand["prefetch"] == 1 else 0.0
+    return wall
+
+
+SPACE = TuneSpace(
+    "synthetic",
+    {"chunk_rows": [256, 512, 1024, 2048, 4096, 8192], "prefetch": [1, 2]},
+)
+
+
+def test_converges_to_known_optimum_within_budget():
+    # 12-point grid, budget 7: the model must steer to the optimum — an
+    # exhaustive sweep could not fit the budget.
+    tuner = Tuner(budget=7, explore=0.25, seed=0, time_budget_s=60)
+    out = tuner.search(
+        SPACE, _surface, default={"chunk_rows": 4096, "prefetch": 1}
+    )
+    assert len(out.measured) <= 7 < len(SPACE.grid())
+    assert out.winner.knobs == {"chunk_rows": 2048, "prefetch": 2}
+    assert out.improved  # the env default was beaten on the same surface
+    assert out.default.proposed_by == "default"
+
+
+def test_model_proposals_actually_steer():
+    tuner = Tuner(budget=8, explore=0.0, seed=3, time_budget_s=60)
+    out = tuner.search(
+        SPACE, _surface, default={"chunk_rows": 256, "prefetch": 1}
+    )
+    assert any(m.proposed_by == "model" for m in out.measured)
+    assert out.winner.knobs["chunk_rows"] == 2048
+
+
+def test_budget_and_failed_candidates():
+    calls = []
+
+    def flaky(cand):
+        calls.append(cand)
+        if cand["chunk_rows"] == 512:
+            raise RuntimeError("boom")
+        return _surface(cand)
+
+    tuner = Tuner(budget=5, explore=1.0, seed=1, time_budget_s=60)
+    out = tuner.search(SPACE, flaky, default={"chunk_rows": 512, "prefetch": 1})
+    # failures consume attempts but never land in measured
+    assert all(m.knobs["chunk_rows"] != 512 for m in out.measured)
+    assert len(out.measured) <= 5
+
+
+def test_time_budget_stops_search():
+    def slow(cand):
+        time.sleep(0.05)
+        return _surface(cand)
+
+    tuner = Tuner(budget=100, explore=1.0, seed=0, time_budget_s=0.12)
+    out = tuner.search(SPACE, slow)
+    assert 1 <= len(out.measured) <= 4
+
+
+def test_maximize_objective():
+    tuner = Tuner(budget=12, explore=1.0, seed=0, time_budget_s=60)
+    out = tuner.search(
+        SPACE, lambda c: 1.0 / _surface(c),
+        default={"chunk_rows": 256, "prefetch": 1}, maximize=True,
+    )
+    assert out.winner.knobs == {"chunk_rows": 2048, "prefetch": 2}
+    assert out.improved
+
+
+def test_outcome_json_shape():
+    tuner = Tuner(budget=3, explore=1.0, seed=0, time_budget_s=60)
+    out = tuner.search(SPACE, _surface, default={"chunk_rows": 256, "prefetch": 1})
+    payload = json.loads(json.dumps(out.to_json()))
+    assert payload["task"] == "synthetic"
+    assert payload["candidates_measured"] == len(out.measured)
+    assert {"knobs", "objective", "proposed_by"} <= set(payload["measured"][0])
+
+
+def test_candidate_metric_counted():
+    before = _names.metric(_names.TUNE_CANDIDATES).value(task="synthetic")
+    Tuner(budget=3, explore=1.0, seed=0, time_budget_s=60).search(
+        SPACE, _surface
+    )
+    after = _names.metric(_names.TUNE_CANDIDATES).value(task="synthetic")
+    assert after == before + 3
+
+
+# ------------------------------------------------------- store round-trip
+
+
+def test_tuned_store_entry_flows_into_plan_chunk_rows(tmp_path, monkeypatch):
+    """The whole point of the loop: a tuner-written entry (source=tune)
+    must be picked up by MeasuredKnobRule into an actual plan knob with
+    zero plan-semantics change."""
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.obs.store import dataset_shape_class
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.knobs import MeasuredKnobRule
+    from keystone_tpu.workflow.operators import DatasetOperator
+    from keystone_tpu.workflow.streaming import StreamingFitOperator, chain_class
+
+    st = store(tmp_path)
+    data = ArrayDataset(np.ones((4096, 8), dtype=np.float32))
+    shape = dataset_shape_class(data)
+    # what tune_stream persists for the winning candidate
+    st.record(
+        f"stream:{chain_class(())}:cr1536", shape,
+        chunk_rows=1536, rows_per_s=9e5, wall_s=0.01, source="tune",
+    )
+    # a worse passively-observed entry must lose to the tuned one
+    st.record(
+        f"stream:{chain_class(())}:cr4096", shape,
+        chunk_rows=4096, rows_per_s=1e5, wall_s=0.09,
+    )
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, s = g.add_node(
+        StreamingFitOperator(
+            BlockLeastSquaresEstimator(512, num_iter=1, reg=1e-3), ()
+        ),
+        [d],
+    )
+    g, _ = g.add_sink(s)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(s).chunk_rows == 1536
+
+
+def test_tuned_solver_entry_flows_into_block_size(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_BLOCK", raising=False)
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.obs.store import shape_class
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.knobs import MeasuredKnobRule
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    st = store(tmp_path)
+    st.record(
+        "solver:block_ls:bs64:prechighest", shape_class(4096, (8,), "float32"),
+        wall_s=0.005, block_size=64, precision="highest", donate=True,
+        source="tune",
+    )
+    data = ArrayDataset(np.ones((4096, 8), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, s = g.add_node(_estimator_node(), [d])
+    g, _ = g.add_sink(s)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    op = out.get_operator(s)
+    assert op.block_size == 64
+    assert op.solver_precision == "highest"
+
+
+def _estimator_node():
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    return BlockLeastSquaresEstimator(512, num_iter=1, reg=1e-3)
+
+
+# --------------------------------------------------- rejected-knob metric
+
+
+def test_non_unanimous_winner_counted_not_silent(tmp_path, monkeypatch):
+    """Two widths in the same rows bucket disagreeing on block_size must
+    not override — and must be COUNTED as a rejection, not dropped
+    silently (the PR's satellite)."""
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SOLVER_BLOCK", raising=False)
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.obs.store import shape_class
+    from keystone_tpu.workflow.graph import Graph
+    from keystone_tpu.workflow.knobs import MeasuredKnobRule
+    from keystone_tpu.workflow.operators import DatasetOperator
+
+    st = store(tmp_path)
+    st.record(
+        "solver:block_ls:bs64:prechighest", shape_class(4096, (8,), "float32"),
+        wall_s=0.005, block_size=64, precision="highest",
+    )
+    st.record(
+        "solver:block_ls:bs128:prechighest", shape_class(4096, (16,), "float32"),
+        wall_s=0.004, block_size=128, precision="highest",
+    )
+    data = ArrayDataset(np.ones((4096, 8), dtype=np.float32))
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, s = g.add_node(_estimator_node(), [d])
+    g, _ = g.add_sink(s)
+    rejected = _names.metric(_names.KNOB_REJECTED)
+    before = rejected.value(knob="solver_block_size", reason="non_unanimous")
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out.get_operator(s).block_size == 512  # untouched
+    after = rejected.value(knob="solver_block_size", reason="non_unanimous")
+    assert after > before
+
+
+def test_warm_rows_from_store_history(tmp_path):
+    """Prior persisted measurements train the surrogate for free; rows
+    missing any space axis are skipped, never padded with fabricated
+    knob values."""
+    from keystone_tpu.workflow.tune import _warm_from_store
+
+    st = store(tmp_path)
+    space = TuneSpace(
+        "solver",
+        {"block_size": [32, 64], "precision": ["default", "highest"],
+         "donation": [True, False]},
+    )
+    st.record(  # complete row: usable
+        "solver:block_ls:bs64:prechighest", "n2^10|64|float32",
+        wall_s=0.01, block_size=64, precision="highest", donate=True,
+    )
+    st.record(  # missing the donation axis: skipped
+        "solver:block_ls:bs32:precdefault", "n2^10|64|float32",
+        wall_s=0.02, block_size=32, precision="default",
+    )
+    warm = _warm_from_store(
+        st, "solver:block_ls:", "n2^10|64|float32", space,
+        {"block_size": "block_size", "precision": "precision",
+         "donation": "donate"},
+        "wall_s", maximize=False,
+    )
+    assert warm == [
+        ({"block_size": 64, "precision": "highest", "donation": True}, 0.01)
+    ]
+
+
+# ------------------------------------------------------- store provenance
+
+
+def test_source_provenance_default_and_by_source(tmp_path):
+    st = store(tmp_path)
+    st.record("solver:block_ls:bs64:prechighest", "n2^12|8|float32",
+              wall_s=0.1, block_size=64)
+    st.record("blocksparse:threshold", "n2^12|8|float32",
+              threshold=0.1, source="tune")
+    assert st.by_source() == {"observed": 1, "tune": 1}
+    # provenance round-trips through the file
+    st2 = ProfileStore(st.path, fingerprint=dict(FP))
+    assert st2.by_source() == {"observed": 1, "tune": 1}
+    m = st2.lookup("blocksparse:threshold", "n2^12|8|float32")
+    assert m["source"] == "tune"
+    # any_env reporting sees entries regardless of fingerprint
+    other = ProfileStore(st.path, fingerprint={"jax": "x", "backend": "tpu",
+                                               "device_kind": "v9"})
+    assert list(other.entries(any_env=True))
+    assert not list(other.entries())
