@@ -1191,6 +1191,45 @@ def _bench_serving_multiworker(small: bool) -> dict:
     return out
 
 
+def _bench_refit(small: bool) -> dict:
+    """Continuous refit (docs/REFIT.md): the drifting-workload closed
+    loop — live traffic served while a supervised daemon taps it, folds
+    labeled rows into the stored sufficient statistics (incremental
+    fit_stream, state-seeded), shadow-evaluates candidates, publishes
+    via registry hot-swap with re-warm, and auto-rolls-back a seeded bad
+    candidate from the post-publish watch window.
+
+    Headline: the incremental fold wall vs a from-scratch fit over
+    everything the state absorbed (the whole point of mergeable O(d²)
+    state) as an IN-RUN ratio (``refit_speedup`` / ``speedup_ok`` —
+    both walls see the same ambient load). Exact-gated by bench-diff:
+    publishes, rollbacks, skips, dropped requests (0), and the
+    post-settle steady-state serving compile count (0) — the loop is
+    deterministic in its seed, so a changed count is a changed loop."""
+    from keystone_tpu.refit.daemon import RefitDemoConfig, run_refit_demo
+    from keystone_tpu.utils.compilation_cache import install_compile_counter
+
+    install_compile_counter()
+    config = RefitDemoConfig(
+        d=16 if small else 64,
+        classes=4,
+        rounds=6,
+        rows_per_round=768 if small else 4096,
+        serve_requests=96 if small else 384,
+        chunk_rows=256 if small else 1024,
+        seed=0,
+    )
+    out = run_refit_demo(config)
+    # The per-round detail is smoke-log material, not a gated artifact;
+    # keep the leg payload to counters + the headline ratio.
+    outcome_by_round = {r["round"]: r["outcome"] for r in out.pop("rounds")}
+    out["outcomes"] = ",".join(
+        outcome_by_round[r] for r in sorted(outcome_by_round)
+    )
+    out.pop("models", None)
+    return out
+
+
 def _bench_fusion(small: bool) -> dict:
     """Whole-pipeline fusion (docs/OPTIMIZER.md): an 8-node dense chain
     applied through a FittedPipeline both fused (ONE XLA dispatch per
@@ -1753,6 +1792,7 @@ def _workload_registry() -> dict:
         "streaming": _bench_streaming,
         "blocksparse": _bench_blocksparse,
         "sharded": _bench_sharded,
+        "refit": _bench_refit,
         "serving": _bench_serving,
         "serving_multiworker": _bench_serving_multiworker,
         "ingest": _bench_ingest,

@@ -77,6 +77,54 @@ def build_config(config_cls, args: argparse.Namespace):
     return config_cls(**{k: v for k, v in vars(args).items() if k in names})
 
 
+def add_refit_arguments(parser: argparse.ArgumentParser) -> None:
+    """Flags for ``keystone-tpu refit`` — wired here (stdlib-only) so the
+    CLI's --help/--list paths never import the refit/workflow packages
+    (whose fold path imports jax); ``refit.daemon.refit_from_args``
+    consumes the parsed namespace at dispatch time."""
+    parser.add_argument(
+        "--rounds", type=int, default=6,
+        help="drifting-workload rounds to run",
+    )
+    parser.add_argument(
+        "--dim", type=int, default=16, help="synthetic feature width",
+    )
+    parser.add_argument(
+        "--classes", type=int, default=4, help="synthetic class count",
+    )
+    parser.add_argument(
+        "--rows-per-round", type=int, default=1024,
+        help="labeled rows fed to the tap per round",
+    )
+    parser.add_argument(
+        "--serve-requests", type=int, default=192,
+        help="live requests served through the pipeline per round",
+    )
+    parser.add_argument(
+        "--chunk-rows", type=int, default=256,
+        help="chunk rows for the incremental fold",
+    )
+    parser.add_argument(
+        "--drift", type=float, default=0.2,
+        help="per-round drift of the true weights",
+    )
+    parser.add_argument(
+        "--quiet-round", type=int, default=2,
+        help="round that feeds too few rows (a ledgered skip); 0 disables",
+    )
+    parser.add_argument(
+        "--bad-round", type=int, default=4,
+        help="round whose candidate is corrupted post-eval (exercises "
+        "auto-rollback); 0 disables",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--store-dir", default=None,
+        help="checkpoint-store directory for the stream state "
+        "(default: a fresh temp dir)",
+    )
+
+
 def add_tune_arguments(parser: argparse.ArgumentParser) -> None:
     """Flags for ``keystone-tpu tune`` — wired here (stdlib-only) so the
     CLI's --help/--list paths never import the workflow package (whose
@@ -309,6 +357,17 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_tune_arguments(tune_parser)
 
+    # Continuous refit (docs/REFIT.md): the drifting-workload closed
+    # loop — serve, tap, incremental fold, shadow-eval, publish, watch,
+    # auto-rollback — with a final REFIT_STATS: JSON line the chaos
+    # smoke asserts on. Stdlib-only flag wiring, same rule as tune.
+    refit_parser = sub.add_parser(
+        "refit",
+        help="continuous-refit demo loop: drifting traffic absorbed by "
+        "incremental refits with shadow eval and auto-rollback",
+    )
+    add_refit_arguments(refit_parser)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -328,6 +387,10 @@ def main(argv: Optional[list] = None) -> int:
         print(
             f"{'tune':28s} offline autotuner: measured knob search → "
             "profile-store winners"
+        )
+        print(
+            f"{'refit':28s} continuous-refit loop: incremental retrain + "
+            "shadow eval + auto-rollback"
         )
         return 0
 
@@ -362,6 +425,13 @@ def main(argv: Optional[list] = None) -> int:
 
         enable_persistent_cache()  # measured runs warm the same cache
         return tune_from_args(args)
+
+    if args.workload == "refit":
+        from .refit.daemon import refit_from_args
+        from .utils.compilation_cache import enable_persistent_cache
+
+        enable_persistent_cache()  # warm folds/warmups across runs
+        return refit_from_args(args)
 
     if args.workload == "profile":
         from .obs.profile import profile_from_args

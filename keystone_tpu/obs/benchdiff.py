@@ -64,6 +64,10 @@ _EXACT_SUBSTRINGS = (
     # Block-sparse invariants (docs/AUTOTUNING.md): density and skipped
     # tiles are pure functions of the deterministic corpus + hash.
     "density", "blocks_skipped",
+    # Continuous-refit invariants (docs/REFIT.md): the deterministic
+    # drifting workload publishes, skips, and rolls back EXACTLY the
+    # same rounds every run — a changed count is a changed loop.
+    "publishes", "rollbacks", "skips",
 )
 _SKIP_SUBSTRINGS = (
     # Environment-dependent measurements no two runs share: compile
@@ -75,6 +79,9 @@ _SKIP_SUBSTRINGS = (
     # IN-RUN ratios instead (speedup_ok bool + exact density counts),
     # where both paths see the same ambient load.
     "_gram_wall_s", "_fit_wall_s",
+    # Refit leg fold walls: same story — the gate is the in-run
+    # refit_speedup ratio (speedup_ok bool), not sub-second absolutes.
+    "_refit_wall_s",
 )
 
 

@@ -124,6 +124,15 @@ SERVING_SLO_TARGET_MS = "keystone_serving_slo_target_ms"
 SERVING_SLO_RUNG = "keystone_serving_slo_rung"
 SERVING_SLO_TRANSITIONS = "keystone_serving_slo_transitions_total"
 
+# ------------------------------------------------------------ continuous refit
+REFIT_ROUNDS = "keystone_refit_rounds_total"
+REFIT_PUBLISHES = "keystone_refit_publishes_total"
+REFIT_ROLLBACKS = "keystone_refit_rollbacks_total"
+REFIT_TAP_ROWS = "keystone_refit_tap_rows_total"
+REFIT_STATE_ROWS = "keystone_refit_state_rows"
+REFIT_FOLD_SECONDS = "keystone_refit_fold_seconds"
+REFIT_SCORE = "keystone_refit_score"
+
 # ---------------------------------------------------------------------- memory
 MEMORY_IN_USE_BYTES = "keystone_memory_in_use_bytes"
 PEAK_MEMORY_BYTES = "keystone_peak_memory_bytes"
@@ -207,6 +216,13 @@ SCHEMA: Dict[str, Tuple] = {
     SERVING_SLO_TARGET_MS: ("gauge", "SLO controller p99 target", ()),
     SERVING_SLO_RUNG: ("gauge", "Admission ladder rung index pinned by the SLO controller", ()),
     SERVING_SLO_TRANSITIONS: ("counter", "SLO-driven admission ladder transitions", ("direction",)),
+    REFIT_ROUNDS: ("counter", "Refit daemon rounds, by outcome (published/skipped_nodata/skipped_eval/rolled_back/error)", ("outcome",)),
+    REFIT_PUBLISHES: ("counter", "Candidate models published by the refit controller", ()),
+    REFIT_ROLLBACKS: ("counter", "Automatic rollbacks triggered by the post-publish watch window", ()),
+    REFIT_TAP_ROWS: ("counter", "Traffic-tap rows, by status (labeled/mirrored/dropped)", ("status",)),
+    REFIT_STATE_ROWS: ("gauge", "Examples absorbed into the persisted refit sufficient statistics", ()),
+    REFIT_FOLD_SECONDS: ("histogram", "Incremental refit folds (drain + fold + finish wall time)", ()),
+    REFIT_SCORE: ("gauge", "Latest shadow-evaluation score, per role (candidate/incumbent/live)", ("role",)),
     MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source", "device")),
     PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage", "device")),
 }

@@ -28,6 +28,7 @@ from ...obs import solver as solver_obs
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
 from ...parallel.partitioner import fit_mesh
+from ...refit.state import GramStreamStateMixin
 from ...reliability import DegradationLadder, halving_rungs, probe
 from ...utils.sparse import (
     BlockSparseMatrix,
@@ -96,7 +97,7 @@ class BlockLinearMapper(BatchTransformer):
         return results
 
 
-class BlockLeastSquaresEstimator(LabelEstimator):
+class BlockLeastSquaresEstimator(GramStreamStateMixin, LabelEstimator):
     """Feature-block coordinate-descent least squares
     (reference: BlockLinearMapper.scala:199-283 BlockLeastSquaresEstimator).
 
@@ -133,47 +134,63 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
         return dense_fit_spec(in_specs, self.label)
 
-    def fit_stream(self, stream) -> BlockLinearMapper:
+    def fit_stream(self, stream, state=None) -> BlockLinearMapper:
         """Row-chunked fit: accumulate (AᵀA, AᵀY, Σx, Σy) one fused
         dispatch per chunk, then run the SAME Gauss-Seidel block updates
         as the in-core solver directly from the centered statistics
         (``linalg.bcd_from_gram``) — identical math, identical block
         order, O(d²) residency instead of O(n·d), and the feature matrix
-        never exists (docs/STREAMING.md)."""
+        never exists (docs/STREAMING.md).
+
+        ``state`` (a refit :class:`StreamState`) seeds the carry from an
+        earlier fit's captured statistics; the fold then only pays for
+        the NEW chunks and the extended state is re-exported via
+        ``export_stream_state`` (docs/REFIT.md)."""
         probe("BlockLeastSquaresEstimator.solve")
 
         def init(feat_aval, y_aval):
             d, k = _stream_shapes(feat_aval, y_aval)
-            return linalg.gram_stream_init(d, k)
+            return self._seed_carry(state, d, k)
 
         import time as _time
 
         t_fit = _time.perf_counter()
         with solver_obs.fit_span("block_ls_stream", epochs=self.num_iter):
             carry, info = stream.fold(init, linalg.gram_stream_step)
-            n = info["num_examples"]
-            gc, cc, mu_a, mu_b = linalg.gram_stream_finish(carry, n)
-            d = gc.shape[0]
-            block = min(self.block_size, d)
-            # Same reg floor as the in-core fit: 1e-6 of the mean Gram
-            # diagonal — trace(Gc)/(n·d) IS E[x²] of the centered data.
-            reg = self.reg if self.reg > 0 else max(
-                1e-6 * float(jnp.trace(gc)) / d, 1e-6
+            n = info["num_examples"] + (state.num_examples if state else 0)
+            self._capture_state(
+                carry, n, reg=self.reg, block_size=self.block_size,
+                num_iter=self.num_iter,
             )
-            d_pad = _round_up(d, block)
-            if d_pad != d:  # zero pad rows/cols are inert (λ keeps PD)
-                gc = jnp.pad(gc, ((0, d_pad - d), (0, d_pad - d)))
-                cc = jnp.pad(cc, ((0, d_pad - d), (0, 0)))
-            w = linalg.bcd_from_gram(
-                gc, cc, reg=reg, num_epochs=self.num_iter, block_size=block
-            )
+            mapper = self._finish_from_stats(carry, n)
         _record_solver_observation(
             "block_ls_stream",
             rows=n,
-            d=d,
-            block_size=block,
+            d=int(carry[0].shape[0]),
+            block_size=mapper.block_size,
             wall_s=_time.perf_counter() - t_fit,
             rungs_attempted=1,
+        )
+        return mapper
+
+    def _finish_from_stats(self, carry, n: int) -> BlockLinearMapper:
+        """Gauss-Seidel block solve from accumulated statistics alone —
+        shared by the streamed fit and the refit ``finish_from_state``
+        path (no data pass, O(d²) inputs)."""
+        gc, cc, mu_a, mu_b = linalg.gram_stream_finish(carry, n)
+        d = gc.shape[0]
+        block = min(self.block_size, d)
+        # Same reg floor as the in-core fit: 1e-6 of the mean Gram
+        # diagonal — trace(Gc)/(n·d) IS E[x²] of the centered data.
+        reg = self.reg if self.reg > 0 else max(
+            1e-6 * float(jnp.trace(gc)) / d, 1e-6
+        )
+        d_pad = _round_up(d, block)
+        if d_pad != d:  # zero pad rows/cols are inert (λ keeps PD)
+            gc = jnp.pad(gc, ((0, d_pad - d), (0, d_pad - d)))
+            cc = jnp.pad(cc, ((0, d_pad - d), (0, 0)))
+        w = linalg.bcd_from_gram(
+            gc, cc, reg=reg, num_epochs=self.num_iter, block_size=block
         )
         return BlockLinearMapper(
             w, block_size=block, intercept=mu_b, feature_mean=mu_a

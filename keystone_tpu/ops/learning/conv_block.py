@@ -323,8 +323,9 @@ def _conv_bcd_step_fn(
         out_specs=(P(), P(axes, None), P(), P()),
     )
     # arg 3 is the loop-owned residual carry, rebuilt every call from
-    # this jit's own output.  # keystone: owns-donated
-    return jax.jit(fn, donate_argnums=(3,))
+    # this jit's own output. Suppressed where the persistent cache makes
+    # donation unsound (linalg.donation_safe).  # keystone: owns-donated
+    return jax.jit(fn, donate_argnums=(3,) if linalg.donation_safe() else ())
 
 
 def _round_up(x: int, m: int) -> int:

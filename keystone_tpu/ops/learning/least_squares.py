@@ -88,17 +88,48 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
     #: the streaming pick).
     supports_fit_stream = True
 
-    def fit_stream(self, stream):
-        if _stream_width(stream, self.block_size) > self.block_size:
+    #: Refit state contract (docs/REFIT.md): the meta-solver's state is
+    #: whatever its delegated concrete rung accumulates (Gram today).
+    stream_state_kind = "gram"
+
+    def fit_stream(self, stream, state=None):
+        inner = self._stream_solver(_stream_width(stream, self.block_size))
+        fitted = inner.fit_stream(stream, state=state)
+        # Surface the delegate's captured statistics as OUR export, so
+        # the refit loop can hold the meta-solver and never care which
+        # concrete rung the width picked.
+        self._stream_state = inner.export_stream_state()
+        return fitted
+
+    def _stream_solver(self, width: int):
+        """The concrete streaming rung for a featurized ``width``."""
+        if width > self.block_size:
             return BlockLeastSquaresEstimator(
                 self.block_size, num_iter=self.block_iters, reg=self.reg
-            ).fit_stream(stream)
+            )
         from .linear import LinearMapEstimator
 
         # Same contract as the exact rung: reg>0 is ridge, reg=0 is
         # plain least squares that fails LOUDLY on a singular Gram
         # (check_finite) rather than degrading to NaN predictions.
-        return LinearMapEstimator(reg=self.reg or None).fit_stream(stream)
+        return LinearMapEstimator(reg=self.reg or None)
+
+    # ------------------------------------------------ refit state contract
+    def export_stream_state(self):
+        return getattr(self, "_stream_state", None)
+
+    def merge_stream_state(self, a, b):
+        from ...refit.state import merge_stream_states
+
+        return merge_stream_states(a, b)
+
+    def finish_from_state(self, state):
+        """Finish from statistics alone, re-running the width dispatch
+        the streamed fit would have made (the carry's Gram is (d, d),
+        so the width is in the state itself)."""
+        return self._stream_solver(
+            int(state.carry[0].shape[0])
+        ).finish_from_state(state)
 
     def __init__(
         self,

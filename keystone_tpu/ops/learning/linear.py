@@ -24,6 +24,7 @@ from ...data.dataset import ArrayDataset, Dataset
 from ...parallel import linalg
 from ...parallel.mesh import get_mesh
 from ...parallel.partitioner import fit_mesh
+from ...refit.state import GramStreamStateMixin
 from ...workflow.pipeline import BatchTransformer, LabelEstimator
 from ..stats.core import _as_array_dataset
 
@@ -50,7 +51,7 @@ class LinearMapper(BatchTransformer):
         return out
 
 
-class LinearMapEstimator(LabelEstimator):
+class LinearMapEstimator(GramStreamStateMixin, LabelEstimator):
     """Distributed OLS/ridge via normal equations.
 
     λ=None → plain least squares; otherwise ridge with strength λ
@@ -71,21 +72,31 @@ class LinearMapEstimator(LabelEstimator):
 
         return dense_fit_spec(in_specs, self.label)
 
-    def fit_stream(self, stream) -> LinearMapper:
+    def fit_stream(self, stream, state=None) -> LinearMapper:
         """Row-chunked exact fit: the same algebraic centering identity
         the fused in-core solve uses (Σ(a−μ)(a−μ)ᵀ = AᵀA − n·μμᵀ), fed
         by per-chunk Gram accumulation instead of one whole-matrix
-        dispatch — O(d²) residency, feature matrix never materializes."""
+        dispatch — O(d²) residency, feature matrix never materializes.
+
+        ``state`` (a refit :class:`StreamState`) seeds the carry with
+        previously captured statistics so this fold EXTENDS an earlier
+        fit; the combined state is re-exported via
+        ``export_stream_state`` (docs/REFIT.md)."""
         from ..learning.block import _stream_shapes
 
         def init(feat_aval, y_aval):
             d, k = _stream_shapes(feat_aval, y_aval)
-            return linalg.gram_stream_init(d, k)
+            return self._seed_carry(state, d, k)
 
         carry, info = stream.fold(init, linalg.gram_stream_step)
-        gc, cc, mu_a, mu_b = linalg.gram_stream_finish(
-            carry, info["num_examples"]
-        )
+        n = info["num_examples"] + (state.num_examples if state else 0)
+        self._capture_state(carry, n, reg=self.reg)
+        return self._finish_from_stats(carry, n)
+
+    def _finish_from_stats(self, carry, n: int) -> LinearMapper:
+        """Exact solve from accumulated statistics alone — shared by the
+        streamed fit and the refit ``finish_from_state`` path."""
+        gc, cc, mu_a, mu_b = linalg.gram_stream_finish(carry, n)
         w = linalg.solve_from_gram(gc, cc, reg=self.reg or 0.0)
         if not self.reg:  # singular-risk case only: fail loudly, not NaN
             linalg.check_finite(w, "LinearMapEstimator (reg=0, streaming)")

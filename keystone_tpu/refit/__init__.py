@@ -1,0 +1,69 @@
+"""Continuous refit: a live-traffic incremental retraining loop.
+
+KeystoneML's batch model ends at ``fit``; this package composes the
+repo's existing investments into a living system (docs/REFIT.md):
+
+- :mod:`state`   — the solver-agnostic stream-state contract: estimators
+                   with ``supports_fit_stream`` export their mergeable
+                   O(d²) sufficient statistics (``export_stream_state``),
+                   extend them later (``fit_stream(..., state=)``), merge
+                   partials (``merge_stream_states``), and finish a
+                   fitted transformer from statistics alone
+                   (``finish_from_state``) — no refit-from-scratch.
+                   Persisted through the reliability checkpoint store.
+- :mod:`tap`     — the traffic tap: a bounded spill buffer fed by served
+                   requests (sampled) and/or a labeled side-channel,
+                   with drop-counting backpressure that never blocks the
+                   serve path.
+- :mod:`shadow`  — shadow evaluation: score a candidate against the
+                   incumbent with the ``evaluation/`` suite (and
+                   mirrored live traffic) before anything publishes.
+- :mod:`publish` — the publish/rollback controller: passing candidates
+                   publish via ``ModelRegistry`` hot-swap (in-process)
+                   or ``WorkerSupervisor.swap`` (per-worker re-warm
+                   acks); a post-publish watch window on serving metrics
+                   and live score triggers automatic rollback to the
+                   retained previous version. Every publish/skip/
+                   rollback lands in the recovery ledger and the
+                   ``keystone_refit_*`` metrics.
+- :mod:`daemon`  — the supervised refit loop driving tap → fold →
+                   shadow-eval → publish/watch, plus the synthetic
+                   drifting-workload demo behind ``keystone-tpu refit``.
+
+Exports resolve lazily (PEP 562, like the package root): the Gram
+estimators import :mod:`state` at module scope, and pulling the whole
+control plane in from there would both slow that import and risk cycles.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "GramStreamStateMixin": "keystone_tpu.refit.state",
+    "StateMismatch": "keystone_tpu.refit.state",
+    "StreamState": "keystone_tpu.refit.state",
+    "load_stream_state": "keystone_tpu.refit.state",
+    "merge_stream_states": "keystone_tpu.refit.state",
+    "save_stream_state": "keystone_tpu.refit.state",
+    "stream_state_key": "keystone_tpu.refit.state",
+    "TrafficTap": "keystone_tpu.refit.tap",
+    "ShadowEvaluator": "keystone_tpu.refit.shadow",
+    "ShadowReport": "keystone_tpu.refit.shadow",
+    "InProcessPublisher": "keystone_tpu.refit.publish",
+    "PublishTicket": "keystone_tpu.refit.publish",
+    "SupervisorPublisher": "keystone_tpu.refit.publish",
+    "RefitConfig": "keystone_tpu.refit.daemon",
+    "RefitDaemon": "keystone_tpu.refit.daemon",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module = importlib.import_module(_LAZY[name])
+        value = getattr(module, name)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

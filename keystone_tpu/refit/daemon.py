@@ -1,0 +1,662 @@
+"""The refit daemon: tap → fold → shadow-eval → publish → watch, forever.
+
+One round (:meth:`RefitDaemon.run_once` — the deterministic, testable
+unit the supervised loop repeats):
+
+    tap.drain ──► split train/eval ──► fit_stream(state=…)  [refit.fold]
+                        │                    │
+                        │              export + persist state
+                        ▼                    ▼
+                  shadow.compare ◄──── candidate
+                        │ fail → refit_skip (ledger) → done
+                        │ pass
+                        ▼
+                  [refit.candidate] ──► publisher.publish  [refit.publish]
+                        ▼
+                  watch window: live score on held-back rows +
+                  serving stats (failures, p99)
+                        │ regression → publisher.rollback (ledger)
+                        ▼
+                  publisher.settle() — compile baseline restamped
+
+The fold EXTENDS the persisted sufficient statistics (refit/state.py)
+through the existing chunked ``fit_stream`` plan — the incremental cost
+is O(new rows), never O(all rows ever seen), which is what the ``refit``
+bench leg measures against a from-scratch fit. Rows absorbed into the
+state stay absorbed even when a candidate is skipped or rolled back:
+the DATA was real; it was the published MODEL that regressed.
+
+Supervision: ``start()`` runs rounds on ``interval_s`` in a watched
+daemon thread; a crashing round lands in the recovery ledger
+(``refit_round_error``) and the loop keeps going until
+``max_consecutive_failures`` rounds fail back to back
+(``refit_daemon_failed``) — a poisoned feed must not spin forever.
+
+Chaos surface (docs/RELIABILITY.md): ``refit.fold`` faults the
+incremental fold, ``refit.candidate`` intercepts the candidate AFTER
+shadow eval and before publish (a ``corrupt`` spec here is the seeded
+bad-candidate the auto-rollback e2e rolls back), ``refit.publish``
+faults the swap itself.
+
+The module also carries the synthetic drifting-workload closed loop
+behind ``keystone-tpu refit`` (:func:`run_refit_demo`) — the chaos e2e
+scripts/refit_smoke.sh gates in CI and the ``refit`` bench leg measures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..envknobs import env_float, env_int
+from ..obs import names as _names
+from ..reliability import faultinject
+from ..reliability.faultinject import probe
+from ..reliability.recovery import get_recovery_log
+from .shadow import ShadowEvaluator
+from .state import StreamState, load_stream_state, save_stream_state
+from .tap import TrafficTap
+
+
+@dataclass
+class RefitConfig:
+    """Knobs for one :class:`RefitDaemon` (env defaults via envknobs;
+    the knob table lives in docs/REFIT.md)."""
+
+    name: str = "default"
+    #: seconds between supervised rounds (KEYSTONE_REFIT_INTERVAL_S).
+    interval_s: float = field(
+        default_factory=lambda: env_float("KEYSTONE_REFIT_INTERVAL_S", 30.0)
+    )
+    #: don't fold until this many labeled rows accumulated
+    #: (KEYSTONE_REFIT_MIN_ROWS) — tiny folds are all overhead.
+    min_rows: int = field(
+        default_factory=lambda: env_int("KEYSTONE_REFIT_MIN_ROWS", 256)
+    )
+    #: cap per-round drain (bounds fold wall under a backlog).
+    max_rows_per_round: int = 65536
+    #: chunk rows for the incremental fold's chunk plan
+    #: (KEYSTONE_REFIT_CHUNK_ROWS; one compiled shape → zero steady-state
+    #: fold compiles after round 1).
+    chunk_rows: int = field(
+        default_factory=lambda: env_int("KEYSTONE_REFIT_CHUNK_ROWS", 1024)
+    )
+    #: freshest fraction of each drain held OUT of training for shadow
+    #: eval + the post-publish watch window.
+    eval_fraction: float = 0.25
+    #: shadow gate: candidate passes at incumbent score - margin
+    #: (KEYSTONE_REFIT_MARGIN).
+    margin: float = field(
+        default_factory=lambda: env_float("KEYSTONE_REFIT_MARGIN", 0.02)
+    )
+    #: watch gate: live score under incumbent - watch_margin rolls back
+    #: (KEYSTONE_REFIT_WATCH_MARGIN).
+    watch_margin: float = field(
+        default_factory=lambda: env_float("KEYSTONE_REFIT_WATCH_MARGIN", 0.05)
+    )
+    #: watch gate: post-publish serving p99 above this rolls back
+    #: (None = score-only watch).
+    watch_max_p99_ms: Optional[float] = None
+    #: exponential forgetting applied to the stored statistics before
+    #: each fold (KEYSTONE_REFIT_STATE_DECAY; 1.0 = remember everything
+    #: equally — under drift a recency weight like 0.5 lets the model
+    #: track the CURRENT distribution instead of the lifetime mixture).
+    state_decay: float = field(
+        default_factory=lambda: env_float("KEYSTONE_REFIT_STATE_DECAY", 1.0)
+    )
+    #: mirror rows handed to shadow eval per round.
+    mirror_rows: int = 256
+    #: supervised-loop restart budget: this many back-to-back failed
+    #: rounds stops the daemon loudly.
+    max_consecutive_failures: int = 5
+    #: persisted-state key in the checkpoint store.
+    state_key: str = "refit-state"
+
+
+class RefitDaemon:
+    """Supervised incremental-retrain loop over a traffic tap."""
+
+    def __init__(
+        self,
+        estimator: Any,
+        tap: TrafficTap,
+        publisher: Any,
+        store: Any = None,
+        shadow: Optional[ShadowEvaluator] = None,
+        config: Optional[RefitConfig] = None,
+        partition: Any = None,
+        state: Optional[StreamState] = None,
+    ):
+        self.estimator = estimator
+        self.tap = tap
+        self.publisher = publisher
+        #: reliability CheckpointStore for the stream state (None = the
+        #: state lives only in this process).
+        self.store = store
+        self.shadow = shadow or ShadowEvaluator()
+        self.config = config or RefitConfig()
+        if self.shadow.margin == 0.0:
+            self.shadow.margin = self.config.margin
+        #: optional PartitionDecision: the fold rides the sharded chunk
+        #: plan exactly as a planned streamed fit would.
+        self.partition = partition
+        self._state: Optional[StreamState] = state
+        if self._state is None and store is not None:
+            self._state = load_stream_state(store, self.config.state_key)
+        self._rounds = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.outcomes: List[Dict[str, Any]] = []
+        self._m_rounds = _names.metric(_names.REFIT_ROUNDS)
+        self._m_state_rows = _names.metric(_names.REFIT_STATE_ROWS)
+        self._m_fold_s = _names.metric(_names.REFIT_FOLD_SECONDS)
+        self._m_score = _names.metric(_names.REFIT_SCORE)
+
+    # ------------------------------------------------------------------ state
+    @property
+    def state(self) -> Optional[StreamState]:
+        return self._state
+
+    def state_rows(self) -> int:
+        return int(self._state.num_examples) if self._state else 0
+
+    # ------------------------------------------------------------------ round
+    def run_once(self) -> str:
+        """One refit round; returns the outcome
+        (``published`` | ``skipped_nodata`` | ``skipped_eval`` |
+        ``rolled_back``). Exceptions propagate — the supervised loop
+        (not this method) owns the error ledger."""
+        with self._lock:  # one fold at a time; state is read-modify-write
+            return self._run_once_locked()
+
+    def _run_once_locked(self) -> str:
+        self._rounds += 1
+        round_index = self._rounds
+        depth = self.tap.depth()
+        if depth < self.config.min_rows:
+            get_recovery_log().record(
+                "refit_skip",
+                self.config.name,
+                reason="insufficient_rows",
+                rows=depth,
+                min_rows=self.config.min_rows,
+                round=round_index,
+            )
+            return self._outcome("skipped_nodata", round_index, rows=depth)
+
+        drained = self.tap.drain(self.config.max_rows_per_round)
+        if drained is None:  # raced another drainer
+            return self._outcome("skipped_nodata", round_index, rows=0)
+        x, y = drained
+        n = x.shape[0]
+        eval_n = max(min(int(n * self.config.eval_fraction), n - 1), 1)
+        train_x, train_y = x[: n - eval_n], y[: n - eval_n]
+        eval_x, eval_y = x[n - eval_n :], y[n - eval_n :]
+
+        # ---------------------------------------------------- incremental fold
+        probe("refit.fold")
+        t_fold = time.perf_counter()
+        candidate = self._fold(train_x, train_y)
+        self._state = self.estimator.export_stream_state()
+        if self.store is not None and self._state is not None:
+            save_stream_state(self.store, self.config.state_key, self._state)
+        fold_s = time.perf_counter() - t_fold
+        self._m_fold_s.observe(fold_s)
+        self._m_state_rows.set(self.state_rows())
+
+        # -------------------------------------------------------- shadow eval
+        incumbent = self.publisher.current_model()
+        report = self.shadow.compare(
+            candidate,
+            incumbent,
+            eval_x,
+            eval_y,
+            mirror_x=self.tap.mirror(self.config.mirror_rows),
+        )
+        if not report.passed:
+            get_recovery_log().record(
+                "refit_skip",
+                self.config.name,
+                reason="shadow_eval",
+                round=round_index,
+                **report.to_json(),
+            )
+            if hasattr(self.publisher, "settle"):
+                self.publisher.settle()
+            return self._outcome(
+                "skipped_eval", round_index, fold_s=fold_s,
+                shadow=report.to_json(),
+            )
+
+        # --------------------------------------------------- publish + watch
+        injector = faultinject.current()
+        if injector is not None:
+            # The seeded-bad-candidate door: a `corrupt` spec at
+            # refit.candidate lands AFTER shadow eval (an eval blind
+            # spot is exactly how a bad candidate reaches traffic) and
+            # the watch window below must catch it.
+            candidate = injector.wrap("refit.candidate", lambda: candidate)()
+        ticket = self.publisher.publish(candidate, round_index=round_index)
+        outcome = self._watch(ticket, report, eval_x, eval_y, round_index)
+        if hasattr(self.publisher, "settle"):
+            self.publisher.settle()
+        return self._outcome(
+            outcome, round_index, fold_s=fold_s, shadow=report.to_json(),
+            version=ticket.version,
+        )
+
+    def _fold(self, train_x: np.ndarray, train_y: np.ndarray):
+        """Fold new rows into the stored statistics through the existing
+        chunked (optionally sharded) fit_stream plan."""
+        from ..data.dataset import ArrayDataset
+        from ..workflow.streaming import ChunkStream
+
+        stream = ChunkStream(
+            ArrayDataset(train_x),
+            ArrayDataset(train_y),
+            (),
+            chunk_rows=min(self.config.chunk_rows, max(len(train_x), 1)),
+            partition=self.partition,
+        )
+        state = self._state
+        if state is not None and self.config.state_decay < 1.0:
+            state = state.scaled(self.config.state_decay)
+        return self.estimator.fit_stream(stream, state=state)
+
+    def _watch(
+        self, ticket, shadow_report, watch_x, watch_y, round_index: int
+    ) -> str:
+        """Post-publish watch window: score what the serve path is NOW
+        producing on held-back labeled rows, and check serving health.
+        Regression → O(1) rollback to the retained previous version."""
+        reason = None
+        live_score = None
+        try:
+            live_pred = self.publisher.apply_live(watch_x)
+            live_score = self.shadow.score_predictions(live_pred, watch_y)
+            self._m_score.set(live_score, role="live")
+        except Exception as exc:
+            # The published version cannot even answer — that IS the
+            # regression, not an excuse to skip the watch.
+            reason = f"live apply failed: {type(exc).__name__}: {exc}"
+        if reason is None and live_score is not None:
+            floor = shadow_report.incumbent_score - self.config.watch_margin
+            if live_score < floor:
+                reason = (
+                    f"live score {live_score:.4f} < incumbent "
+                    f"{shadow_report.incumbent_score:.4f} - "
+                    f"{self.config.watch_margin}"
+                )
+        if reason is None and self.config.watch_max_p99_ms is not None:
+            try:
+                p99 = self.publisher.serving_stats().get("p99_ms")
+            except Exception:
+                p99 = None
+            if isinstance(p99, (int, float)) and p99 > self.config.watch_max_p99_ms:
+                reason = f"p99 {p99:.1f}ms > {self.config.watch_max_p99_ms}ms"
+        if reason is None:
+            return "published"
+        self.publisher.rollback(ticket, reason=reason)
+        return "rolled_back"
+
+    def _outcome(self, outcome: str, round_index: int, **detail) -> str:
+        self._m_rounds.inc(outcome=outcome)
+        self.outcomes.append(
+            {"round": round_index, "outcome": outcome, **detail}
+        )
+        return outcome
+
+    # ------------------------------------------------------------ supervision
+    def start(self) -> "RefitDaemon":
+        """Run rounds every ``interval_s`` in a supervised daemon thread."""
+        if self._thread is not None:
+            raise RuntimeError("refit daemon already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="keystone-refit-daemon", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout_s)
+            self._thread = None
+
+    def __enter__(self) -> "RefitDaemon":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _loop(self) -> None:
+        failures = 0
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.run_once()
+                failures = 0
+            except Exception as exc:
+                failures += 1
+                self._m_rounds.inc(outcome="error")
+                get_recovery_log().record(
+                    "refit_round_error",
+                    self.config.name,
+                    error=f"{type(exc).__name__}: {exc}",
+                    consecutive=failures,
+                )
+                if failures >= self.config.max_consecutive_failures:
+                    get_recovery_log().record(
+                        "refit_daemon_failed",
+                        self.config.name,
+                        consecutive_failures=failures,
+                    )
+                    return
+
+
+# ----------------------------------------------------------------- the demo
+#
+# A self-contained closed loop over a drifting synthetic classification
+# workload: the CI face of the subsystem (scripts/refit_smoke.sh) and
+# the `refit` bench leg's engine. Everything deterministic in --seed.
+
+
+@dataclass
+class RefitDemoConfig:
+    d: int = 16
+    classes: int = 4
+    rounds: int = 6
+    rows_per_round: int = 1024
+    serve_requests: int = 192       # served per round (the live traffic)
+    chunk_rows: int = 256
+    drift: float = 0.2              # per-round weight perturbation scale
+    state_decay: float = 0.5        # recency weight on the stored stats
+    quiet_round: int = 2            # feeds too few rows → a ledgered skip
+    bad_round: int = 4              # candidate corrupted → auto-rollback
+    settle_round: int = 2           # steady-state compile assertions start
+    seed: int = 0
+    reg: float = 1e-2
+    store_dir: Optional[str] = None
+
+
+def _corrupt_mapper(model: Any) -> Any:
+    """The seeded bad candidate: weights negated — shadow-eval-invisible
+    by construction (it is injected AFTER eval) and catastrophically
+    wrong on live traffic, which is the watch window's job to catch."""
+    from ..ops.learning.linear import LinearMapper
+
+    return LinearMapper(
+        -np.asarray(model.weights),
+        intercept=model.intercept,
+        feature_mean=model.feature_mean,
+    )
+
+
+def run_refit_demo(config: RefitDemoConfig) -> Dict[str, Any]:
+    """Drifting workload × continuous refit, end to end, in-process.
+
+    Returns the evidence dict the smoke script and bench leg assert on:
+    round outcomes, dropped-request and steady-state-compile counts,
+    accuracy trajectory (live vs a stale never-refit incumbent), and the
+    incremental-vs-scratch fold walls.
+    """
+    import tempfile
+
+    from ..data.dataset import ArrayDataset
+    from ..ops.learning.linear import LinearMapEstimator
+    from ..reliability.checkpoint import CheckpointStore
+    from ..serving.config import ServingConfig
+    from ..serving.server import PipelineServer
+    from ..workflow.streaming import ChunkStream
+    from .publish import InProcessPublisher
+
+    cfg = config
+    rng = np.random.default_rng(cfg.seed)
+    drift_rng = np.random.default_rng(cfg.seed + 1)
+
+    w_true = rng.standard_normal((cfg.d, cfg.classes)).astype(np.float32)
+
+    def drift_weights():
+        nonlocal w_true
+        step = drift_rng.standard_normal(w_true.shape).astype(np.float32)
+        w = w_true + cfg.drift * step
+        w_true = (w / np.linalg.norm(w, axis=0, keepdims=True)).astype(
+            np.float32
+        )
+
+    def make_rows(n: int):
+        x = rng.standard_normal((n, cfg.d)).astype(np.float32)
+        labels = np.argmax(x @ w_true, axis=1)
+        y = np.eye(cfg.classes, dtype=np.float32)[labels]
+        return x, y, labels
+
+    def stream_over(x, y):
+        return ChunkStream(
+            ArrayDataset(x), ArrayDataset(y), (),
+            chunk_rows=min(cfg.chunk_rows, len(x)),
+        )
+
+    store_dir = cfg.store_dir or tempfile.mkdtemp(prefix="keystone-refit-")
+    store = CheckpointStore(store_dir)
+
+    # Incumbent v1: one streamed fit on pre-drift data, state captured.
+    estimator = LinearMapEstimator(reg=cfg.reg)
+    x0, y0, _ = make_rows(cfg.rows_per_round)
+    v1_model = estimator.fit_stream(stream_over(x0, y0))
+    save_stream_state(store, "refit-state", estimator.export_stream_state())
+
+    tap = TrafficTap(capacity_rows=cfg.rows_per_round * 4, mirror_rows=512)
+    server = PipelineServer(
+        config=ServingConfig(max_batch=8, queue_depth=cfg.serve_requests + 64),
+        name="demo",
+        tap=tap,
+    )
+    server.registry.publish("demo", v1_model, source="fit")
+    server.start()
+    example = np.zeros((cfg.d,), np.float32)
+    server.warmup(example)
+
+    publisher = InProcessPublisher(server, name="demo", example=example)
+    daemon = RefitDaemon(
+        estimator,
+        tap,
+        publisher,
+        store=store,
+        # Margin well above one eval-row accuracy quantum (1/eval_rows):
+        # under drift the incumbent and a one-round-fresher candidate
+        # can score within a row or two of each other, and a gate at
+        # that width would flip on compile-cache-level numeric jitter.
+        shadow=ShadowEvaluator(margin=0.06),
+        config=RefitConfig(
+            name="demo",
+            min_rows=max(cfg.rows_per_round // 2, 64),
+            chunk_rows=cfg.chunk_rows,
+            watch_margin=0.05,
+            state_decay=cfg.state_decay,
+        ),
+        state=estimator.export_stream_state(),
+    )
+
+    rounds: List[Dict[str, Any]] = []
+    dropped = 0
+    steady_compiles = 0
+    fold_walls: List[float] = []
+    all_x, all_y = [x0], [y0]
+
+    specs = []
+    if cfg.bad_round:
+        # The corrupt call number counts refit.candidate REACHES (rounds
+        # that got past shadow eval), not wall-clock rounds; the quiet
+        # round never reaches it.
+        reaches = cfg.bad_round - (
+            1 if cfg.quiet_round and cfg.quiet_round < cfg.bad_round else 0
+        )
+        specs.append(
+            faultinject.FaultSpec(
+                match="refit.candidate",
+                kind="corrupt",
+                calls=(reaches,),
+                corrupt=_corrupt_mapper,
+            )
+        )
+
+    import contextlib
+
+    chaos = faultinject.injected(*specs) if specs else contextlib.nullcontext()
+    try:
+        with chaos:
+            for r in range(1, cfg.rounds + 1):
+                drift_weights()
+                quiet = r == cfg.quiet_round
+                n = 96 if quiet else cfg.rows_per_round
+                x, y, labels = make_rows(n)
+
+                # ---- live traffic through the serve path (zero drops).
+                futures = server.submit_many(
+                    [row for row in x[: cfg.serve_requests]],
+                    deadline_s=120.0,
+                )
+                dropped += sum(
+                    1 for f in futures if f.exception(timeout=180) is not None
+                )
+                stats = server.stats()
+                if r > cfg.settle_round:
+                    # Post-settle: serving between refit rounds must not
+                    # compile (the publish re-warm + settle restamp own
+                    # every legitimate compile).
+                    steady_compiles = max(
+                        steady_compiles,
+                        int(stats.get("xla_compiles_since_warmup") or 0),
+                    )
+
+                # ---- labeled side-channel + one daemon round.
+                tap.feed(x, y)
+                all_x.append(x)
+                all_y.append(y)
+                t0 = time.perf_counter()
+                outcome = daemon.run_once()
+                round_wall = time.perf_counter() - t0
+                fold_s = daemon.outcomes[-1].get("fold_s")
+                if fold_s is not None:
+                    # The drain+fold+finish wall alone — what the refit
+                    # bench leg compares against a from-scratch fit.
+                    fold_walls.append(fold_s)
+
+                live_acc = _demo_accuracy(publisher, x, labels)
+                # The accuracy probe above is demo instrumentation, not
+                # serving traffic — restamp so next round's serving-only
+                # window still reads zero compiles.
+                server.restamp_compile_baseline()
+                rounds.append(
+                    {
+                        "round": r,
+                        "outcome": outcome,
+                        "rows": n,
+                        "live_accuracy": round(live_acc, 4),
+                        "fold_s": round(fold_s, 4) if fold_s else None,
+                        "round_wall_s": round(round_wall, 4),
+                        "shadow": daemon.outcomes[-1].get("shadow"),
+                    }
+                )
+    finally:
+        server.stop(drain=True)
+
+    # Evidence: stale v1 (never refit) vs the live, continuously-refit
+    # line on the FINAL drifted distribution.
+    final_x, _, final_labels = make_rows(2048)
+    stale_acc = _model_accuracy(v1_model, final_x, final_labels)
+    live_acc = _demo_accuracy(publisher, final_x, final_labels)
+
+    # From-scratch comparison: one fit over every row the state absorbed.
+    scratch_est = LinearMapEstimator(reg=cfg.reg)
+    xs, ys = np.concatenate(all_x), np.concatenate(all_y)
+    t0 = time.perf_counter()
+    scratch_est.fit_stream(stream_over(xs, ys))
+    scratch_wall = time.perf_counter() - t0
+    incremental_wall = float(np.median(fold_walls)) if fold_walls else None
+
+    outcomes = [r["outcome"] for r in rounds]
+    ledger = get_recovery_log()
+    return {
+        "d": cfg.d,
+        "classes": cfg.classes,
+        "rounds": rounds,
+        "publishes": outcomes.count("published"),
+        "rollbacks": outcomes.count("rolled_back"),
+        "skips": outcomes.count("skipped_nodata")
+        + outcomes.count("skipped_eval"),
+        "dropped": int(dropped),
+        "compiles_steady_state_post_settle": int(steady_compiles),
+        "state_rows": daemon.state_rows(),
+        "tap": tap.stats(),
+        "live_accuracy_final": round(live_acc, 4),
+        "stale_v1_accuracy_final": round(stale_acc, 4),
+        "incremental_refit_wall_s": (
+            round(incremental_wall, 4) if incremental_wall else None
+        ),
+        "scratch_fit_wall_s": round(scratch_wall, 4),
+        "refit_speedup": (
+            round(scratch_wall / incremental_wall, 2)
+            if incremental_wall
+            else None
+        ),
+        "speedup_ok": bool(
+            incremental_wall is not None and scratch_wall > incremental_wall
+        ),
+        "ledger_kinds": sorted(
+            {e.kind for e in ledger.events() if e.kind.startswith("refit_")}
+        ),
+        "models": server.registry.describe(),
+    }
+
+
+def _model_accuracy(model: Any, x: np.ndarray, labels: np.ndarray) -> float:
+    from ..evaluation import MulticlassClassifierEvaluator
+
+    scores = np.asarray(model.apply_arrays(x))
+    k = scores.shape[1]
+    return MulticlassClassifierEvaluator(k).evaluate(
+        scores.argmax(axis=1), labels
+    ).total_accuracy
+
+
+def _demo_accuracy(publisher: Any, x: np.ndarray, labels: np.ndarray) -> float:
+    from ..evaluation import MulticlassClassifierEvaluator
+
+    scores = publisher.apply_live(x)
+    k = scores.shape[1]
+    return MulticlassClassifierEvaluator(k).evaluate(
+        scores.argmax(axis=1), labels
+    ).total_accuracy
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def refit_from_args(args) -> int:
+    """``keystone-tpu refit``: run the drifting-workload closed loop and
+    print one ``REFIT_STATS:`` JSON line (the smoke-script contract)."""
+    import json
+
+    config = RefitDemoConfig(
+        d=args.dim,
+        classes=args.classes,
+        rounds=args.rounds,
+        rows_per_round=args.rows_per_round,
+        serve_requests=args.serve_requests,
+        chunk_rows=args.chunk_rows,
+        drift=args.drift,
+        quiet_round=args.quiet_round,
+        bad_round=args.bad_round,
+        seed=args.seed,
+        store_dir=args.store_dir,
+    )
+    results = run_refit_demo(config)
+    results["recovery"] = get_recovery_log().summary()
+    print("REFIT_STATS:" + json.dumps(results))
+    return 0

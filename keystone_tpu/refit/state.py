@@ -1,0 +1,275 @@
+"""The solver-agnostic stream-state contract.
+
+``fit_stream`` estimators accumulate *mergeable* state: for the Gram
+family that is the ``(AᵀA, AᵀY, Σx, Σy)`` carry ``parallel/linalg.py``
+threads through the chunk plan — O(d²), additive over row chunks, and
+sufficient to finish a fit with zero data passes. This module freezes
+that property into a portable envelope so the statistics captured at fit
+time can be persisted, shipped, merged with later traffic, and finished
+into a NEW fitted transformer without ever refitting from scratch — the
+heart of the continuous-refit loop (docs/REFIT.md).
+
+The contract is deliberately NOT Gram-specific: an envelope names its
+accumulation ``kind`` and carries an opaque host-numpy carry pytree plus
+the example count. ``merge_stream_states`` applies the kind's merge rule
+(``additive`` today; a future sketch tier registers its own), so the
+Panther-style sketched solvers (PAPERS.md) ride the same loop by
+exporting a different kind with O(s·d) carries.
+
+Estimator surface (the three ``supports_fit_stream`` estimators —
+``LinearMapEstimator``, ``BlockLeastSquaresEstimator``, and the
+``LeastSquaresEstimator`` meta-solver — all implement it):
+
+- ``fit_stream(stream, state=None)`` — ``state`` seeds the fold carry
+  with previously captured statistics, so new chunks EXTEND the old fit.
+- ``export_stream_state()`` — the envelope captured by this instance's
+  most recent ``fit_stream`` (host numpy; safe to pickle), or ``None``.
+- ``merge_stream_state(a, b)`` — combine two envelopes (disjoint data).
+- ``finish_from_state(state)`` — a fitted transformer from statistics
+  alone: no stream, no data, one device round for the solve.
+
+Persistence rides the reliability checkpoint store
+(:class:`~keystone_tpu.reliability.checkpoint.CheckpointStore`): the
+same atomic-write ``<digest>.pkl`` directory training checkpoints and
+serving artifacts already share, keyed by :func:`stream_state_key`.
+
+Import discipline: stdlib + numpy only at module scope (jax loads
+lazily inside the few device touch points), so the serving/refit control
+plane can import this without paying a backend import.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+#: Envelope format — bump when the layout changes; loads refuse unknown
+#: versions loudly rather than mis-merging silently.
+FORMAT_VERSION = 1
+
+#: kind → merge rule. "additive" is the Gram family's algebra (leafwise
+#: sum of carries, sum of example counts); future kinds register here.
+MERGE_RULES: Dict[str, str] = {"gram": "additive"}
+
+
+class StateMismatch(ValueError):
+    """Two envelopes (or an envelope and a stream) that can never be
+    combined: different kinds, shapes, or format versions. Raised BEFORE
+    any accumulation happens — a mismatched merge must fail loudly, not
+    produce statistics that solve to garbage."""
+
+
+@dataclass
+class StreamState:
+    """One estimator's exported sufficient statistics.
+
+    ``carry`` is a tuple of host numpy arrays (the estimator's fold
+    carry, device-fetched), ``num_examples`` the rows it has absorbed,
+    ``meta`` whatever the estimator needs to finish (d, k, reg...).
+    """
+
+    kind: str
+    estimator: str
+    num_examples: int
+    carry: Tuple[np.ndarray, ...]
+    meta: Dict[str, Any] = field(default_factory=dict)
+    format_version: int = FORMAT_VERSION
+
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self.carry))
+
+    def scaled(self, decay: float) -> "StreamState":
+        """Exponential forgetting for additive kinds: every statistic
+        (and the effective example count) scaled by ``decay`` ∈ (0, 1].
+        Folding new rows on a decayed state is a recency-weighted fit —
+        the knob that lets a drifting workload's OLD distribution stop
+        dominating the Gram (docs/REFIT.md). ``decay=1`` is a no-op;
+        the algebra stays exact because the centering identity uses the
+        same effective count the sums were scaled by."""
+        if not 0.0 < decay <= 1.0:
+            raise StateMismatch(f"decay must be in (0, 1], got {decay}")
+        if decay == 1.0:
+            return self
+        return StreamState(
+            kind=self.kind,
+            estimator=self.estimator,
+            num_examples=max(int(round(self.num_examples * decay)), 1),
+            carry=tuple(np.asarray(a) * decay for a in self.carry),
+            meta=dict(self.meta),
+            format_version=self.format_version,
+        )
+
+    def describe(self) -> Dict[str, Any]:
+        """Telemetry/ledger view — shapes and counts, never payloads."""
+        return {
+            "kind": self.kind,
+            "estimator": self.estimator,
+            "num_examples": int(self.num_examples),
+            "carry_shapes": [tuple(a.shape) for a in self.carry],
+            "nbytes": self.nbytes(),
+            "format_version": self.format_version,
+        }
+
+
+def _check_compatible(a: StreamState, b: StreamState) -> None:
+    if a.format_version != b.format_version:
+        raise StateMismatch(
+            f"format versions differ: {a.format_version} vs {b.format_version}"
+        )
+    if a.kind != b.kind:
+        raise StateMismatch(f"state kinds differ: {a.kind!r} vs {b.kind!r}")
+    shapes_a = [tuple(x.shape) for x in a.carry]
+    shapes_b = [tuple(x.shape) for x in b.carry]
+    if shapes_a != shapes_b:
+        raise StateMismatch(
+            f"carry shapes differ: {shapes_a} vs {shapes_b} — these "
+            "statistics were captured over different feature spaces"
+        )
+
+
+def merge_stream_states(a: StreamState, b: StreamState) -> StreamState:
+    """Combine two envelopes captured over DISJOINT data. For additive
+    kinds the merged statistics are exactly what one pass over the union
+    would have produced — the property the round-trip tests pin."""
+    _check_compatible(a, b)
+    rule = MERGE_RULES.get(a.kind)
+    if rule != "additive":
+        raise StateMismatch(
+            f"no merge rule for state kind {a.kind!r} "
+            f"(known: {sorted(MERGE_RULES)})"
+        )
+    return StreamState(
+        kind=a.kind,
+        estimator=a.estimator,
+        num_examples=int(a.num_examples) + int(b.num_examples),
+        carry=tuple(
+            np.asarray(x) + np.asarray(y) for x, y in zip(a.carry, b.carry)
+        ),
+        meta=dict(a.meta),
+        format_version=a.format_version,
+    )
+
+
+# --------------------------------------------------------------- persistence
+
+
+def stream_state_key(name: str) -> str:
+    """Stable checkpoint-store digest for a named refit state. Namespaced
+    so refit states can never collide with prefix-digest fit entries in
+    a shared store directory."""
+    return hashlib.sha1(f"keystone-refit-state:{name}".encode()).hexdigest()
+
+
+def save_stream_state(store: Any, name: str, state: StreamState) -> bool:
+    """Persist ``state`` under ``name`` in a reliability
+    :class:`CheckpointStore` (atomic tmp+rename write). Returns False
+    when the store refused (unpicklable — should never happen for numpy
+    carries)."""
+    return store.save(None, state, digest=stream_state_key(name))
+
+
+def load_stream_state(store: Any, name: str) -> Optional[StreamState]:
+    """The persisted state for ``name``, or None (missing/torn entries
+    are misses, the checkpoint-store contract)."""
+    from ..reliability.checkpoint import _MISS
+
+    value = store.lookup(None, digest=stream_state_key(name))
+    if value is _MISS or not isinstance(value, StreamState):
+        return None
+    if value.format_version != FORMAT_VERSION:
+        return None  # refuse to extend a layout this build doesn't speak
+    return value
+
+
+# ------------------------------------------------------------ the Gram mixin
+
+
+class GramStreamStateMixin:
+    """State-contract plumbing shared by the Gram-family estimators.
+
+    Concrete estimators implement ``_finish_from_stats(carry, n)`` —
+    fitted transformer from the (device) carry and total row count — and
+    get ``export_stream_state`` / ``merge_stream_state`` /
+    ``finish_from_state`` plus the fold-side helpers for free. The
+    captured envelope lands on ``self._stream_state`` (underscored on
+    purpose: excluded from checkpoint digests, so capturing state never
+    changes an estimator's structural identity).
+    """
+
+    stream_state_kind = "gram"
+
+    def export_stream_state(self) -> Optional[StreamState]:
+        return getattr(self, "_stream_state", None)
+
+    def merge_stream_state(self, a: StreamState, b: StreamState) -> StreamState:
+        return merge_stream_states(a, b)
+
+    def finish_from_state(self, state: StreamState):
+        """A fitted transformer from statistics alone (no data pass)."""
+        import jax.numpy as jnp
+
+        self._check_state_kind(state)
+        carry = tuple(jnp.asarray(a) for a in state.carry)
+        return self._finish_from_stats(carry, int(state.num_examples))
+
+    # ------------------------------------------------------- fold-side hooks
+    def _check_state_kind(self, state: StreamState) -> None:
+        if state.format_version != FORMAT_VERSION:
+            raise StateMismatch(
+                f"state format v{state.format_version} != v{FORMAT_VERSION}"
+            )
+        if state.kind != self.stream_state_kind:
+            raise StateMismatch(
+                f"{type(self).__name__} accumulates {self.stream_state_kind!r} "
+                f"state, got {state.kind!r}"
+            )
+
+    def _seed_carry(self, state: Optional[StreamState], d: int, k: int):
+        """The fold's initial carry: fresh zeros, or ``state``'s
+        statistics (shape-checked against the stream's featurized
+        width) so new chunks extend the old fit."""
+        from ..parallel import linalg
+
+        if state is None:
+            return linalg.gram_stream_init(d, k)
+        self._check_state_kind(state)
+        want = [(d, d), (d, k), (d,), (k,)]
+        got = [tuple(a.shape) for a in state.carry]
+        if got != want:
+            raise StateMismatch(
+                f"resume state shaped {got} cannot seed a (d={d}, k={k}) "
+                f"stream (want {want})"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        carry = tuple(jnp.asarray(a, jnp.float32) for a in state.carry)
+        # One-time fold setup, and load-bearing: the fold's step jit
+        # DONATES the carry, and with a warm compilation cache the first
+        # chunk dispatches immediately — donating a buffer whose async
+        # host→device transfer has not committed corrupts the seed
+        # (observed as nondeterministic garbage fits). Commit the O(d²)
+        # transfer before the donating dispatch can race it.
+        # keystone: allow-sync
+        return jax.block_until_ready(carry)
+
+    def _capture_state(self, carry, n_total: int, **meta: Any) -> StreamState:
+        """Device-fetch the post-fold carry into a portable envelope and
+        remember it on the instance for ``export_stream_state``."""
+        import jax
+
+        # Export crosses to host by definition: the envelope must pickle
+        # into the checkpoint store.  # keystone: allow-sync
+        host = tuple(np.asarray(jax.device_get(a)) for a in carry)
+        state = StreamState(
+            kind=self.stream_state_kind,
+            estimator=f"{type(self).__module__}.{type(self).__qualname__}",
+            num_examples=int(n_total),
+            carry=host,
+            meta=dict(meta),
+        )
+        self._stream_state = state
+        return state

@@ -214,6 +214,9 @@ KNOWN_PROBE_SITES = frozenset(
         "serving.worker.request",      # serving/worker.py: request handling
         "serving.worker.heartbeat",    # serving/worker.py: heartbeat wire
         "streaming.chunk",             # workflow/streaming.py: per-chunk dispatch
+        "refit.fold",                  # refit/daemon.py: incremental fold
+        "refit.candidate",             # refit/daemon.py: candidate, post-eval
+        "refit.publish",               # refit/publish.py: registry/fleet swap
         "ingest.decode_batch",         # data/loaders/archive.py: decode pool
         "BlockLeastSquaresEstimator.solve",
         "LeastSquaresEstimator.solve",

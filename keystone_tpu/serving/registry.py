@@ -65,18 +65,35 @@ class ModelEntry:
 
 class ModelRegistry:
     """Thread-safe name → version list with an atomically swappable
-    'current' pointer per name."""
+    'current' pointer per name.
 
-    def __init__(self):
+    History is BOUNDED: ``history_limit`` previous versions are retained
+    in memory alongside the current one, so rollback after a bad publish
+    is an O(1) pointer swap — no artifact re-load from disk — while a
+    continuously-refit server (docs/REFIT.md publishes a new version per
+    refit round, forever) cannot grow its resident model set without
+    bound. Older entries are evicted at publish time; the current entry
+    is never evicted, even when a rollback has pinned it outside the
+    retention window."""
+
+    def __init__(self, history_limit: int = 4):
         self._lock = threading.Lock()
         self._versions: Dict[str, List[ModelEntry]] = {}
         self._current: Dict[str, ModelEntry] = {}
+        # Floor of 1: with zero retained previous versions the refit
+        # watch window could never roll a bad publish back — the
+        # incumbent would already be evicted.
+        self.history_limit = max(1, int(history_limit))
         self.swaps = 0
+        self.evicted = 0
+        self._last_rollback: Dict[str, Dict[str, Any]] = {}
 
     # ---------------------------------------------------------------- publish
     def publish(self, name: str, model: Any, source: str = "publish") -> ModelEntry:
         """Register ``model`` as the next version of ``name`` and make it
-        current. Returns the new entry."""
+        current. Returns the new entry. Evicts history beyond
+        ``history_limit`` previous versions (the current entry is always
+        retained)."""
         with self._lock:
             history = self._versions.setdefault(name, [])
             entry = ModelEntry(
@@ -89,7 +106,21 @@ class ModelRegistry:
             if name in self._current:
                 self.swaps += 1
             self._current[name] = entry
+            self._evict_locked(name)
             return entry
+
+    def _evict_locked(self, name: str) -> None:
+        history = self._versions.get(name, [])
+        keep = self.history_limit + 1  # previous N + the one just published
+        if len(history) <= keep:
+            return
+        current = self._current.get(name)
+        tail, evicted = history[-keep:], history[:-keep]
+        # A rollback can pin 'current' outside the retention window; the
+        # live version is never evicted out from under in-flight holders.
+        tail = [e for e in evicted if e is current] + tail
+        self.evicted += len(history) - len(tail)
+        self._versions[name] = tail
 
     def load_fitted(
         self,
@@ -202,31 +233,78 @@ class ModelRegistry:
                     return entry
             raise UnknownModel(f"{name}@v{version}", self._current.keys())
 
-    def rollback(self, name: str, version: int) -> ModelEntry:
-        """Point 'current' back at an older published version (the entry
-        list is append-only; rollback is just a pointer swap)."""
-        entry = self.resolve(name, version)
+    def rollback(self, name: str, version: Optional[int] = None) -> ModelEntry:
+        """Point 'current' back at a retained older version — an O(1)
+        in-memory pointer swap, never a disk re-load (the bounded history
+        exists exactly for this). ``version=None`` rolls back to the
+        retained version just below the current one (the auto-rollback
+        path's default). Records rollback provenance for ``describe``."""
         with self._lock:
+            if name not in self._current:
+                raise UnknownModel(name, self._current.keys())
+            current = self._current[name]
+            if version is None:
+                older = [
+                    e for e in self._versions[name]
+                    if e.version < current.version
+                ]
+                if not older:
+                    raise UnknownModel(
+                        f"{name}@<no retained previous version>",
+                        self._current.keys(),
+                    )
+                entry = older[-1]
+            else:
+                entry = next(
+                    (
+                        e for e in self._versions[name]
+                        if e.version == version
+                    ),
+                    None,
+                )
+                if entry is None:
+                    raise UnknownModel(
+                        f"{name}@v{version}", self._current.keys()
+                    )
             self._current[name] = entry
             self.swaps += 1
+            self._last_rollback[name] = {
+                "from_version": current.version,
+                "to_version": entry.version,
+                "at": time.time(),
+            }
         return entry
+
+    def last_rollback(self, name: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            info = self._last_rollback.get(name)
+            return dict(info) if info else None
 
     def names(self) -> List[str]:
         with self._lock:
             return sorted(self._current)
 
     def versions(self, name: str) -> List[int]:
+        """RETAINED versions (eviction trims this list; the full publish
+        count is the current version number)."""
         with self._lock:
             return [e.version for e in self._versions.get(name, [])]
 
     def describe(self) -> Dict[str, Any]:
-        """Snapshot for telemetry / the serve CLI stats line."""
+        """Snapshot for telemetry / the serve CLI stats line / GET
+        /stats: active version + publish provenance per name."""
         with self._lock:
             return {
                 name: {
                     "current": self._current[name].version,
                     "versions": [e.version for e in self._versions[name]],
                     "source": self._current[name].source,
+                    "published_at": self._current[name].published_at,
+                    "last_rollback": (
+                        dict(self._last_rollback[name])
+                        if name in self._last_rollback
+                        else None
+                    ),
                 }
                 for name in sorted(self._current)
             }

@@ -63,12 +63,18 @@ class PipelineServer:
         registry: Optional[ModelRegistry] = None,
         name: str = "default",
         telemetry: Optional[ServingTelemetry] = None,
+        tap: Any = None,
     ):
         self.config = config or ServingConfig()
         self.registry = registry or ModelRegistry()
         if model is not None:
             self.registry.publish(name, model)
         self.default_model = name
+        #: Opt-in refit traffic tap (refit/tap.py): settled request
+        #: payloads are SAMPLED into its bounded mirror buffer after each
+        #: batch — off the submit hot path, O(1) per row, and a full or
+        #: slow tap only ever drops tap rows, never requests.
+        self.tap = tap
         self.telemetry = telemetry or ServingTelemetry(window=self.config.telemetry_window)
         self.admission = AdmissionController(self.config.queue_depth)
         self.batcher = MicroBatcher(
@@ -212,6 +218,16 @@ class PipelineServer:
                 futures.append(f)
         return futures
 
+    def restamp_compile_baseline(self) -> None:
+        """Re-zero ``xla_compiles_since_warmup`` at the CURRENT compile
+        count. The refit controller calls this when a publish/watch
+        round settles: the daemon's own fold/eval compiles land before
+        the stamp, so the steady-state serving invariant (zero compiles
+        between refit rounds) stays directly assertable."""
+        from ..utils.compilation_cache import compile_count
+
+        self._compile_baseline = compile_count()
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, Any]:
         out = self.telemetry.snapshot(queue_depth=self.batcher.depth())
@@ -344,6 +360,13 @@ class PipelineServer:
                 latency_s=done - req.enqueued_at,
                 queue_wait_s=t_apply - req.enqueued_at,
             )
+        if self.tap is not None:
+            # AFTER every future settled: tap work can never delay a
+            # response, and a tap bug must never fail a served request.
+            try:
+                self.tap.observe_batch([req.payload for req in group])
+            except Exception:
+                logger.debug("traffic tap observe failed", exc_info=True)
 
     def _apply_padded(
         self, entry: ModelEntry, payloads: List[Any], deadline: Any = None

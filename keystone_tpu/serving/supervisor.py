@@ -191,11 +191,17 @@ class WorkerSupervisor:
         config: Optional[SupervisorConfig] = None,
         worker_cmd: Optional[Callable[[str], List[str]]] = None,
         env: Optional[Dict[str, str]] = None,
+        tap: Any = None,
     ):
         self.spec = spec
         self.config = config or SupervisorConfig()
         self._worker_cmd = worker_cmd or self._default_worker_cmd
         self._env = dict(env or {})
+        #: Opt-in refit traffic tap (refit/tap.py): accepted payloads are
+        #: sampled at submit — the parent process is the only place that
+        #: sees every request in the multi-worker runtime. Non-blocking
+        #: by the tap contract; a tap bug never fails a submit.
+        self.tap = tap
         self._lock = threading.Lock()
         self._workers: Dict[str, _Worker] = {
             str(i): _Worker(str(i)) for i in range(self.config.workers)
@@ -567,6 +573,11 @@ class WorkerSupervisor:
             raise
         if hasattr(payload, "tolist"):
             payload = payload.tolist()
+        if self.tap is not None:
+            try:
+                self.tap.observe(payload)
+            except Exception:
+                pass  # the tap is advisory; submit never fails on it
         pending = _Pending(
             request_id=next(self._request_ids),
             payload=payload,
@@ -805,8 +816,22 @@ class WorkerSupervisor:
             ]
             if values:
                 aggregate[worst] = max(values)
+        # Publish provenance (satellite contract): the active model
+        # versions the fleet is serving, from the first ready worker that
+        # reports them — after a settled swap every worker agrees, and a
+        # mid-swap snapshot showing the old version is honest.
+        models = next(
+            (
+                w["stats"]["models"]
+                for w in workers.values()
+                if w["state"] == "ready"
+                and isinstance(w["stats"].get("models"), dict)
+            ),
+            None,
+        )
         out = {
             **aggregate,
+            **({"models": models} if models is not None else {}),
             "workers": workers,
             "supervisor": {
                 "alive": sum(1 for w in workers.values() if w["state"] == "ready"),

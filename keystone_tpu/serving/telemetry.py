@@ -114,6 +114,13 @@ class ServingTelemetry:
         with self._lock:
             self._warm_buckets.add(bucket)
 
+    def warmed_buckets(self) -> list:
+        """The buckets currently known warm — the warm set the refit
+        publish verifier (KV305, docs/VERIFICATION.md) checks candidate
+        bucket plans against."""
+        with self._lock:
+            return sorted(self._warm_buckets)
+
     def record_shed(self) -> None:
         with self._lock:
             self.sheds += 1

@@ -104,6 +104,7 @@ class StubBackend:
         self._latencies: list = []
         self.served = 0
         self.failures = 0
+        self.version = 1  # swap acks carry the version they "warmed"
 
     def handle(self, msg: Dict[str, Any], emitter: _Emitter) -> None:
         t0 = time.monotonic()
@@ -157,7 +158,14 @@ class StubBackend:
         )
 
     def swap(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        return {"name": msg.get("name", "default"), "version": -1, "warmup_s": 0.0}
+        with self._lock:
+            self.version += 1
+            version = self.version
+        return {
+            "name": msg.get("name", "default"),
+            "version": version,
+            "warmup_s": 0.0,
+        }
 
     def stats(self) -> Dict[str, Any]:
         from ..obs.metrics import percentile
@@ -174,6 +182,16 @@ class StubBackend:
                 "p50_ms": round(percentile(window, 50) * 1e3, 3),
                 "p99_ms": round(percentile(window, 99) * 1e3, 3),
                 "xla_compiles_since_warmup": 0,
+                # Publish provenance, the stub shape of the server
+                # backend's registry describe() (satellite contract:
+                # stats surface the active version everywhere).
+                "models": {
+                    "default": {
+                        "current": self.version,
+                        "published_at": None,
+                        "last_rollback": None,
+                    }
+                },
             }
         return out
 

@@ -56,6 +56,19 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         return None
 
 
+def persistent_cache_active() -> bool:
+    """True when a persistent compilation cache directory is configured
+    (via :func:`enable_persistent_cache` or raw jax config). Donation
+    sites consult this: see :func:`~keystone_tpu.parallel.linalg.
+    donation_safe` for the CPU deserialized-executable aliasing hazard."""
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:
+        return False
+
+
 # ------------------------------------------------------- compile accounting
 
 # Backend-compile event counter. The serving layer warms a fixed bucket

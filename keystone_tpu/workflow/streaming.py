@@ -392,9 +392,14 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
             probe = leaf.ravel()[:1]
             return new_carry, probe
 
-    # carry is owned by the fold loop: created by gram_stream_init and
-    # threaded only through this step.  # keystone: owns-donated
-    jitted = jax.jit(fused, donate_argnums=(0,))
+    from ..parallel.linalg import donation_safe
+
+    # carry is owned by the fold loop: created by gram_stream_init (or a
+    # refit state seed) and threaded only through this step. Donation is
+    # suppressed where the persistent cache makes it unsound
+    # (linalg.donation_safe — CPU deserialized-executable aliasing).
+    # keystone: owns-donated
+    jitted = jax.jit(fused, donate_argnums=(0,) if donation_safe() else ())
     with _step_cache_lock:
         _STEP_JIT_CACHE[key] = ((members, step_fn, partition), jitted, traces)
         _STEP_JIT_CACHE.move_to_end(key)

@@ -23,6 +23,10 @@ KV301     error     serving batch bucket not in the warmed bucket set
 KV302     warning   estimated peak bytes exceed the device memory budget
 KV303     warning   Gram/sufficient-stat state for a streamed fit does
                     not fit the device memory budget
+KV305     error     a refit-published candidate's apply spec or bucket
+                    set disagrees with the incumbent's warmed buckets
+                    (the steady-state-recompile hazard on the publish
+                    path; :func:`verify_refit_publish`)
 KV401     error     dependency cycle in the graph
 KV402     info      node not statically analyzable (no ``out_spec``,
                     not eval_shape-able) — propagation continues unknown
@@ -94,6 +98,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "KV302": (WARNING, "estimated peak memory exceeds budget"),
     "KV303": (WARNING, "streamed-fit Gram state exceeds memory budget"),
     "KV304": (ERROR, "sharded per-device residency exceeds memory budget"),
+    "KV305": (ERROR, "refit candidate disagrees with incumbent warm state"),
     "KV401": (ERROR, "dependency cycle"),
     "KV402": (INFO, "node not statically analyzable"),
 }
@@ -1059,6 +1064,126 @@ def verify_graph(
                 missing=missing,
                 warmed=sorted(warmed),
             )
+
+    report.seconds = time.perf_counter() - t0
+    _publish(report, context)
+    return report
+
+
+def _apply_out_spec(model: Any, example_spec: Any):
+    """Shape-only trace of a fitted model's batch apply on one request
+    spec — zero device execution. Returns a ``(kind, rendering)`` pair:
+    the two trace engines (``jax.eval_shape`` over ``apply_arrays`` vs
+    the graph verifier's sink annotation) render specs differently, so a
+    comparison is only meaningful between like kinds — the caller must
+    never diff a mapper's repr against a pipeline's annotation string
+    (that would flag every cross-kind publish). UNKNOWN when the model's
+    apply path isn't statically traceable (bespoke apply_batch etc.)."""
+    import jax
+
+    apply_arrays = getattr(model, "apply_arrays", None)
+    if apply_arrays is None and hasattr(model, "graph"):
+        # FittedPipeline: propagate through the verifier itself and read
+        # the sink annotation — the same engine load_fitted uses.
+        try:
+            report = verify_graph(
+                model.graph,
+                {model.source: example_spec},
+                context="refit-spec-probe",
+            )
+            sink_dep = model.graph.get_sink_dependency(model.sink)
+            for ann in report.annotations:
+                if ann.node == repr(sink_dep):
+                    return ("graph", ann.spec)
+        except Exception:
+            return UNKNOWN
+        return UNKNOWN
+    if apply_arrays is None:
+        return UNKNOWN
+    try:
+        out = jax.eval_shape(apply_arrays, example_spec)
+        return ("arrays", repr(out))
+    except Exception:
+        return UNKNOWN
+
+
+def verify_refit_publish(
+    candidate: Any,
+    incumbent: Any,
+    example: Any = None,
+    buckets: Optional[Sequence[int]] = None,
+    warmed_buckets: Optional[Sequence[int]] = None,
+    context: str = "refit-publish",
+) -> VerifyReport:
+    """The publish-path face of the steady-state-recompile hazard
+    (docs/REFIT.md, docs/VERIFICATION.md KV305).
+
+    A refit-published candidate serves through the INCUMBENT's warmed
+    executables: the fleet re-warms exactly the bucket set it already
+    holds, so a candidate whose apply spec (per-bucket output
+    shape/dtype) or required bucket set disagrees with what the
+    incumbent warmed compiles at serve time — on live traffic, after the
+    swap ack said "warm". This check is pure tracing (``jax.eval_shape``
+    / spec propagation), zero device execution, and runs before every
+    controller publish.
+    """
+    t0 = time.perf_counter()
+    report = VerifyReport(context=context)
+    interp = _Interpreter(Graph(), report.diagnostics, probe_objects=False)
+
+    if buckets is not None:
+        want = set(int(b) for b in buckets)
+        warmed = set(int(b) for b in (warmed_buckets or ()))
+        missing = sorted(want - warmed)
+        if missing:
+            interp.diag(
+                "KV305",
+                f"candidate's serving buckets {missing} are not in the "
+                f"incumbent's warmed set {sorted(warmed)} — every batch "
+                "padded onto them compiles at serve time, AFTER the "
+                "publish settled (steady-state recompile on the publish "
+                "path; re-warm the new buckets before swapping)",
+                missing=missing,
+                warmed=sorted(warmed),
+            )
+
+    if example is not None and incumbent is not None:
+        import jax
+        import numpy as np
+
+        def leaf_spec(a):
+            dtype = getattr(a, "dtype", None)
+            if dtype is None:
+                dtype = np.asarray(a).dtype
+            return jax.ShapeDtypeStruct(
+                (1,) + tuple(np.shape(a)), np.dtype(dtype)
+            )
+
+        try:
+            spec = jax.tree_util.tree_map(leaf_spec, example)
+        except Exception:
+            spec = None
+        if spec is not None:
+            cand_out = _apply_out_spec(candidate, spec)
+            inc_out = _apply_out_spec(incumbent, spec)
+            if (
+                cand_out is not UNKNOWN
+                and inc_out is not UNKNOWN
+                # Same trace engine only: the two renderings are not
+                # comparable across kinds (a mapper candidate over a
+                # pipeline incumbent would otherwise ALWAYS mismatch).
+                and cand_out[0] == inc_out[0]
+                and cand_out[1] != inc_out[1]
+            ):
+                interp.diag(
+                    "KV305",
+                    "candidate's apply spec "
+                    f"{cand_out[1]} != incumbent's {inc_out[1]} for the "
+                    "same request — the warmed executables cannot serve "
+                    "it (shape/dtype drift in the refit candidate)",
+                    candidate_spec=str(cand_out[1]),
+                    incumbent_spec=str(inc_out[1]),
+                )
 
     report.seconds = time.perf_counter() - t0
     _publish(report, context)

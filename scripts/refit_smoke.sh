@@ -1,0 +1,67 @@
+#!/usr/bin/env bash
+# Continuous-refit chaos smoke (docs/REFIT.md): run the drifting-workload
+# closed loop — serve, tap, incremental fold, shadow eval, publish,
+# watch, rollback — and assert the subsystem's invariants end to end:
+#
+#   - the drift is ABSORBED by >=2 incremental refits (final live
+#     accuracy beats a stale never-refit v1 by a wide margin)
+#   - ZERO dropped requests across every round (publishes and the
+#     rollback happen under live traffic)
+#   - ZERO steady-state XLA compiles post-settle (each publish re-warms
+#     and restamps; serving between refit rounds never compiles)
+#   - the seeded bad candidate (corrupted AFTER shadow eval — the eval
+#     blind spot) is auto-rolled-back by the watch window, exactly once
+#   - every publish, skip, and rollback left recovery-ledger evidence
+#   - the incremental fold is measurably cheaper than refitting from
+#     scratch over everything the state absorbed (in-run ratio: both
+#     walls see the same ambient load)
+#
+# This is the CI face of tests/refit/; the `refit` bench leg commits the
+# same counters to BENCH_CI_BASELINE.json for exact gating.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+timeout -k 10 360 python -m keystone_tpu refit \
+  --rounds 6 --rows-per-round 768 --serve-requests 96 \
+  | tee /tmp/refit_smoke.log
+
+timeout -k 10 60 python - <<'EOF'
+import json
+
+line = [
+    l for l in open("/tmp/refit_smoke.log")
+    if l.startswith("REFIT_STATS:")
+]
+assert len(line) == 1, f"expected one REFIT_STATS line, got {len(line)}"
+stats = json.loads(line[0][len("REFIT_STATS:"):])
+
+assert stats["publishes"] >= 2, f"drift not absorbed by >=2 refits: {stats}"
+assert stats["rollbacks"] == 1, f"seeded bad candidate not rolled back exactly once: {stats}"
+assert stats["skips"] >= 1, f"quiet round left no ledgered skip: {stats}"
+assert stats["dropped"] == 0, f"DROPPED requests during refit rounds: {stats['dropped']}"
+assert stats["compiles_steady_state_post_settle"] == 0, (
+    f"serving compiled in steady state: {stats['compiles_steady_state_post_settle']}")
+assert set(stats["ledger_kinds"]) >= {"refit_publish", "refit_rollback", "refit_skip"}, (
+    f"ledger trail incomplete: {stats['ledger_kinds']}")
+assert stats["live_accuracy_final"] > stats["stale_v1_accuracy_final"] + 0.15, (
+    f"refit line did not beat the stale incumbent: {stats['live_accuracy_final']} "
+    f"vs {stats['stale_v1_accuracy_final']}")
+assert stats["speedup_ok"] and stats["refit_speedup"] > 1.0, (
+    f"incremental refit not cheaper than from-scratch: {stats['refit_speedup']}")
+# The bad round must be a rollback and later rounds recover (publish).
+outcomes = {r["round"]: r["outcome"] for r in stats["rounds"]}
+assert outcomes[4] == "rolled_back", outcomes
+assert outcomes[6] == "published", outcomes
+# Post-rollback provenance rides the stats line (satellite contract).
+demo = stats["models"]["demo"]
+assert demo["last_rollback"] is not None and demo["published_at"], demo
+
+print(
+    f"refit_smoke OK: publishes={stats['publishes']} rollbacks={stats['rollbacks']} "
+    f"skips={stats['skips']} dropped=0 steady_compiles=0 "
+    f"live_acc={stats['live_accuracy_final']} vs stale={stats['stale_v1_accuracy_final']} "
+    f"refit_speedup={stats['refit_speedup']}x"
+)
+EOF

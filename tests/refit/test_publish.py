@@ -1,0 +1,216 @@
+"""Publish/rollback controller + the KV305 publish verifier + bounded
+registry history under live traffic (docs/REFIT.md)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.learning.linear import LinearMapper
+from keystone_tpu.refit.publish import InProcessPublisher, SupervisorPublisher
+from keystone_tpu.serving.config import ServingConfig
+from keystone_tpu.serving.server import PipelineServer
+
+pytestmark = pytest.mark.refit
+
+D, K = 6, 2
+
+
+def _mapper(scale=1.0):
+    rng = np.random.default_rng(0)
+    return LinearMapper((scale * rng.normal(size=(D, K))).astype(np.float32))
+
+
+def _server(tap=None):
+    server = PipelineServer(
+        model=_mapper(),
+        config=ServingConfig(max_batch=4, queue_depth=128),
+        name="m",
+        tap=tap,
+    ).start()
+    server.warmup(np.zeros((D,), np.float32))
+    return server
+
+
+def test_publish_then_rollback_is_o1_and_ledgered():
+    from keystone_tpu.reliability.recovery import get_recovery_log
+
+    server = _server()
+    try:
+        pub = InProcessPublisher(
+            server, name="m", example=np.zeros((D,), np.float32)
+        )
+        ticket = pub.publish(_mapper(scale=2.0), round_index=1)
+        assert server.registry.resolve("m").version == ticket.version == 2
+        assert ticket.acks["in-process"]["version"] == 2
+        entry = pub.rollback(ticket, reason="test")
+        assert entry.version == 1
+        assert server.registry.resolve("m").version == 1
+        info = server.registry.last_rollback("m")
+        assert info["from_version"] == 2 and info["to_version"] == 1
+        kinds = {e.kind for e in get_recovery_log().events()}
+        assert {"refit_publish", "refit_rollback"} <= kinds
+        # Provenance rides stats (satellite contract).
+        models = server.stats()["models"]["m"]
+        assert models["current"] == 1
+        assert models["last_rollback"]["from_version"] == 2
+        assert models["published_at"]
+    finally:
+        server.stop(drain=True)
+
+
+def test_hot_swap_then_rollback_zero_dropped_inflight():
+    """The bounded-history satellite pin: publish a new version and roll
+    back WHILE requests are in flight — every request answers (entries
+    are immutable; in-flight batches finish on the version they
+    resolved), and rollback never re-loads from disk."""
+    server = _server()
+    try:
+        pub = InProcessPublisher(
+            server, name="m", example=np.zeros((D,), np.float32)
+        )
+        payloads = [np.full((D,), float(i % 3), np.float32) for i in range(48)]
+        futures = server.submit_many(payloads[:24], deadline_s=60.0)
+        ticket = pub.publish(_mapper(scale=3.0), round_index=1)
+        futures += server.submit_many(payloads[24:36], deadline_s=60.0)
+        pub.rollback(ticket, reason="mid-traffic rollback")
+        futures += server.submit_many(payloads[36:], deadline_s=60.0)
+        results = [f.result(timeout=60.0) for f in futures]
+        assert len(results) == 48  # zero dropped through swap AND rollback
+        assert server.registry.resolve("m").version == 1
+    finally:
+        server.stop(drain=True)
+
+
+def test_registry_history_is_bounded_with_o1_rollback():
+    from keystone_tpu.serving.registry import ModelRegistry
+
+    # history_limit floors at 1: zero retained previous versions would
+    # make the watch window's auto-rollback impossible.
+    assert ModelRegistry(history_limit=0).history_limit == 1
+
+    r = ModelRegistry(history_limit=2)
+    for i in range(6):
+        r.publish("m", f"model-{i}")
+    # current (6) + previous 2 retained; older evicted.
+    assert r.versions("m") == [4, 5, 6]
+    assert r.evicted == 3
+    entry = r.rollback("m")  # default: the retained previous version
+    assert entry.version == 5
+    # A rollback-pinned current survives later evictions.
+    for i in range(3):
+        r.publish("m", f"model-late-{i}")
+    assert r.resolve("m").version == 9
+    from keystone_tpu.serving.config import UnknownModel
+
+    with pytest.raises(UnknownModel):
+        r.resolve("m", version=1)  # evicted long ago
+
+
+def test_kv305_bucket_and_spec_mismatch():
+    import jax
+
+    from keystone_tpu.workflow.verify import verify_refit_publish
+
+    incumbent = _mapper()
+    candidate = _mapper(scale=2.0)
+    # Bucket drift: candidate plan wants a bucket the fleet never warmed.
+    report = verify_refit_publish(
+        candidate, incumbent, buckets=[1, 2, 4, 16], warmed_buckets=[1, 2, 4]
+    )
+    assert [d.code for d in report.errors()] == ["KV305"]
+    assert report.errors()[0].details["missing"] == [16]
+    # Matching warm set: clean.
+    ok = verify_refit_publish(
+        candidate, incumbent, buckets=[1, 2], warmed_buckets=[1, 2, 4]
+    )
+    assert ok.ok
+    # Apply-spec drift: a candidate with a different output width than
+    # the incumbent cannot serve through the warmed executables.
+    wide = LinearMapper(np.zeros((D, K + 2), np.float32))
+    report = verify_refit_publish(
+        wide, incumbent, example=np.zeros((D,), np.float32)
+    )
+    assert [d.code for d in report.errors()] == ["KV305"]
+    same = verify_refit_publish(
+        candidate, incumbent, example=np.zeros((D,), np.float32)
+    )
+    assert same.ok
+
+
+def test_kv305_strict_mode_refuses_publish(monkeypatch):
+    from keystone_tpu.workflow.verify import VerificationError
+
+    server = _server()
+    try:
+        pub = InProcessPublisher(
+            server, name="m", example=np.zeros((D,), np.float32)
+        )
+        monkeypatch.setenv("KEYSTONE_VERIFY", "strict")
+        wide = LinearMapper(np.zeros((D, K + 2), np.float32))
+        with pytest.raises(VerificationError):
+            pub.publish(wide, round_index=1)
+        assert server.registry.resolve("m").version == 1  # nothing landed
+    finally:
+        server.stop(drain=True)
+
+
+def test_supervisor_stats_surface_model_provenance():
+    """GET /stats (supervisor.stats()) carries the fleet's active model
+    versions from the first ready worker that reports them — without
+    spawning processes here (the heartbeat path is exercised by the
+    multiworker e2e)."""
+    from keystone_tpu.serving.supervisor import WorkerSupervisor
+
+    sup = WorkerSupervisor({"stub": {}})
+    worker = sup._workers["0"]
+    worker.state = "ready"
+    worker.stats = {
+        "served": 3,
+        "models": {"m": {"current": 7, "published_at": 123.0,
+                         "last_rollback": None}},
+    }
+    stats = sup.stats()
+    assert stats["models"]["m"]["current"] == 7
+    assert stats["models"]["m"]["published_at"] == 123.0
+
+
+class _FakeSupervisor:
+    """Just the swap/stats surface SupervisorPublisher drives."""
+
+    def __init__(self):
+        self.spec = {"synthetic": {"d": D}}
+        self.swapped_to = []
+
+    def swap(self, spec, name=None, timeout_s=120.0):
+        self.swapped_to.append(spec)
+        return {"0": {"kind": "swapped", "version": len(self.swapped_to)},
+                "1": {"kind": "swapped", "version": len(self.swapped_to)}}
+
+    def stats(self):
+        return {"p99_ms": 1.0}
+
+
+def test_supervisor_publisher_swaps_digests_and_repoints_restart_spec(tmp_path):
+    sup = _FakeSupervisor()
+    pub = SupervisorPublisher(
+        sup, str(tmp_path), name="m", incumbent=_mapper()
+    )
+    t1 = pub.publish(_mapper(scale=2.0), round_index=1)
+    assert all(a["kind"] == "swapped" for a in t1.acks.values())
+    assert sup.spec == {"checkpoint_dir": str(tmp_path), "digest": t1.digest}
+    # Content-addressed: a different candidate at the SAME round tag
+    # (e.g. after a daemon restart) must not overwrite t1's entry —
+    # that would silently re-install the bad model at rollback time.
+    pub2 = SupervisorPublisher(
+        _FakeSupervisor(), str(tmp_path), name="m", incumbent=_mapper()
+    )
+    t1b = pub2.publish(_mapper(scale=9.0), round_index=1)
+    assert t1b.digest != t1.digest
+    t2 = pub.publish(_mapper(scale=3.0), round_index=2)
+    assert t2.prev_digest == t1.digest
+    pub.rollback(t2, reason="test")
+    # The fleet (and any future restart) is back on the previous digest.
+    assert sup.spec["digest"] == t1.digest
+    import pickle
+
+    with open(tmp_path / f"{t1.digest}.pkl", "rb") as f:
+        assert isinstance(pickle.load(f), LinearMapper)

@@ -1,0 +1,214 @@
+"""The stream-state contract: export → checkpoint → merge/resume →
+finish ≡ one-shot fit, for every ``supports_fit_stream`` estimator,
+single-device and sharded (docs/REFIT.md)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.refit.state import (
+    StateMismatch,
+    StreamState,
+    load_stream_state,
+    merge_stream_states,
+    save_stream_state,
+)
+from keystone_tpu.reliability.checkpoint import CheckpointStore
+from keystone_tpu.workflow.streaming import ChunkStream
+
+pytestmark = pytest.mark.refit
+
+N, D, K, CHUNK = 384, 10, 3, 64
+
+
+def _problem(seed=0, n=N):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    w = rng.normal(size=(D, K)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(size=(n, K))).astype(np.float32)
+    return x, y
+
+
+def _stream(x, y, chunk=CHUNK, partition=None):
+    return ChunkStream(
+        ArrayDataset(x), ArrayDataset(y), (), chunk_rows=chunk,
+        partition=partition,
+    )
+
+
+def _rel(a, b):
+    return float(np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-30))
+
+
+ESTIMATORS = [
+    ("linear_map", lambda: LinearMapEstimator(reg=1e-3)),
+    ("block_ls", lambda: BlockLeastSquaresEstimator(8, num_iter=2, reg=1e-3)),
+    ("least_squares_meta", lambda: LeastSquaresEstimator(reg=1e-3, block_size=8)),
+]
+
+
+@pytest.mark.parametrize("name,make", ESTIMATORS, ids=[e[0] for e in ESTIMATORS])
+def test_roundtrip_export_checkpoint_merge_finish(name, make, tmp_path):
+    """Split fit → export both halves → persist through the checkpoint
+    store → load → merge → finish_from_state ≡ the one-shot streamed fit
+    (parity ≤ 1e-6), for all three fit_stream estimators."""
+    x, y = _problem()
+    reference = make().fit_stream(_stream(x, y))
+    ref_out = np.asarray(reference.apply_arrays(x))
+
+    store = CheckpointStore(str(tmp_path))
+    half = N // 2
+    for i, sl in enumerate((slice(None, half), slice(half, None))):
+        est = make()
+        est.fit_stream(_stream(x[sl], y[sl]))
+        assert save_stream_state(store, f"part{i}", est.export_stream_state())
+
+    a = load_stream_state(store, "part0")
+    b = load_stream_state(store, "part1")
+    assert a is not None and b is not None
+    assert a.num_examples + b.num_examples == N
+    merged = merge_stream_states(a, b)
+    fitted = make().finish_from_state(merged)
+    assert _rel(np.asarray(fitted.apply_arrays(x)), ref_out) <= 1e-6
+
+
+@pytest.mark.parametrize("name,make", ESTIMATORS, ids=[e[0] for e in ESTIMATORS])
+def test_resume_fold_extends_state(name, make):
+    """fit_stream(state=…) seeds the carry: first-half fit + resumed
+    second-half fold ≡ one fit over everything (parity ≤ 1e-6)."""
+    x, y = _problem(seed=1)
+    reference = make().fit_stream(_stream(x, y))
+    ref_out = np.asarray(reference.apply_arrays(x))
+
+    first = make()
+    first.fit_stream(_stream(x[: N // 2], y[: N // 2]))
+    resumed_est = make()
+    resumed = resumed_est.fit_stream(
+        _stream(x[N // 2 :], y[N // 2 :]), state=first.export_stream_state()
+    )
+    assert _rel(np.asarray(resumed.apply_arrays(x)), ref_out) <= 1e-6
+    # The re-exported state covers the union.
+    assert resumed_est.export_stream_state().num_examples == N
+
+
+@pytest.mark.parametrize("name,make", ESTIMATORS, ids=[e[0] for e in ESTIMATORS])
+def test_sharded_fold_state_parity(name, make):
+    """The same contract through the PARTITIONED chunk plan: a sharded
+    resumed fold matches the 1-device one-shot fit ≤ 1e-6 (per-device
+    partial stats, one reduce at finish — docs/PARTITIONING.md)."""
+    import jax
+
+    from keystone_tpu.parallel.partitioner import Partitioner
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device CPU mesh")
+    x, y = _problem(seed=2)
+    reference = make().fit_stream(_stream(x, y))
+    ref_out = np.asarray(reference.apply_arrays(x))
+
+    decision = Partitioner().decide_stream("refit-test", CHUNK, record=False)
+    assert decision.eligible
+    first = make()
+    first.fit_stream(_stream(x[: N // 2], y[: N // 2], partition=decision))
+    est = make()
+    resumed = est.fit_stream(
+        _stream(x[N // 2 :], y[N // 2 :], partition=decision),
+        state=first.export_stream_state(),
+    )
+    assert _rel(np.asarray(resumed.apply_arrays(x)), ref_out) <= 1e-6
+
+
+def test_state_decay_scales_statistics():
+    x, y = _problem(seed=3, n=128)
+    est = LinearMapEstimator(reg=1e-3)
+    est.fit_stream(_stream(x, y))
+    state = est.export_stream_state()
+    assert state.scaled(1.0) is state
+    half = state.scaled(0.5)
+    assert half.num_examples == state.num_examples // 2
+    assert np.allclose(half.carry[0], state.carry[0] * 0.5)
+    # The decayed state still finishes to the SAME model (every
+    # statistic and the count scale together — the centering identity
+    # is homogeneous).
+    a = np.asarray(est.finish_from_state(state).apply_arrays(x))
+    b = np.asarray(est.finish_from_state(half).apply_arrays(x))
+    assert _rel(b, a) <= 1e-5
+    with pytest.raises(StateMismatch):
+        state.scaled(0.0)
+
+
+def test_mismatched_states_fail_loudly():
+    x, y = _problem(seed=4, n=128)
+    est = LinearMapEstimator(reg=1e-3)
+    est.fit_stream(_stream(x, y))
+    state = est.export_stream_state()
+    wrong_kind = StreamState(
+        kind="sketch", estimator="x", num_examples=1, carry=state.carry
+    )
+    with pytest.raises(StateMismatch):
+        merge_stream_states(state, wrong_kind)
+    narrow = LinearMapEstimator(reg=1e-3)
+    narrow.fit_stream(_stream(x[:, :4], y, chunk=32))
+    with pytest.raises(StateMismatch):
+        merge_stream_states(state, narrow.export_stream_state())
+    # Seeding a stream of the wrong width refuses before any chunk flows.
+    with pytest.raises(StateMismatch):
+        LinearMapEstimator(reg=1e-3).fit_stream(
+            _stream(x[:, :4], y, chunk=32), state=state
+        )
+
+
+def test_unknown_format_version_is_a_miss(tmp_path):
+    x, y = _problem(seed=5, n=128)
+    est = LinearMapEstimator(reg=1e-3)
+    est.fit_stream(_stream(x, y))
+    state = est.export_stream_state()
+    state.format_version = 99
+    store = CheckpointStore(str(tmp_path))
+    save_stream_state(store, "future", state)
+    assert load_stream_state(store, "future") is None
+
+
+def test_seeded_fold_correct_under_warm_cache(tmp_path):
+    """The donation gate (linalg.donation_safe): with a persistent
+    compilation cache configured on the CPU backend, the streaming step
+    jit must NOT donate its carry — jax 0.4.37 CPU executables
+    deserialized from the cache misapply input→output aliasing, and a
+    donated seeded carry silently accumulates garbage across folds
+    (minimal repro: jit(f, donate_argnums=(0,)) + persistent cache →
+    second process's results drift by hundreds). Asserted structurally:
+    carry buffers survive the step when the cache is active, and are
+    donated (deleted) when it is not."""
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.parallel.linalg import donation_safe
+    from keystone_tpu.workflow import streaming as streaming_mod
+
+    saved = jax.config.jax_compilation_cache_dir
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+        assert donation_safe()
+        jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+        assert not donation_safe()
+
+        def step(carry, x_feat, y_b):  # fresh fn: bypass the step cache
+            (g,) = carry
+            return (g + x_feat.T @ x_feat,)
+
+        jitted, _ = streaming_mod._shared_step_jit((), step)
+        carry = (jnp.zeros((D, D)),)
+        x_b = jnp.ones((8, D))
+        y_b = jnp.ones((8, K))
+        mask = jnp.ones((8, 1))
+        out, _probe = jitted(carry, x_b, y_b, mask)
+        jax.block_until_ready(out)
+        assert not carry[0].is_deleted(), (
+            "carry was donated under an active persistent cache — the "
+            "deserialized-executable aliasing hazard is live again"
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", saved)

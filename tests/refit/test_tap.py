@@ -1,0 +1,118 @@
+"""Traffic tap: bounded drop-oldest backpressure that never touches the
+serve path (docs/REFIT.md)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.refit.tap import TrafficTap
+
+pytestmark = pytest.mark.refit
+
+
+def test_feed_drain_roundtrip_oldest_first():
+    tap = TrafficTap(capacity_rows=100)
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.float32).reshape(6, 1)
+    assert tap.feed(x[:4], y[:4]) == 4
+    assert tap.feed(x[4:], y[4:]) == 2
+    got_x, got_y = tap.drain(4)
+    np.testing.assert_array_equal(got_x, x[:4])
+    np.testing.assert_array_equal(got_y, y[:4])
+    assert tap.depth() == 2
+    got_x, _ = tap.drain()
+    np.testing.assert_array_equal(got_x, x[4:])
+    assert tap.drain() is None
+
+
+def test_bound_drops_oldest_and_counts():
+    tap = TrafficTap(capacity_rows=8)
+    x = np.arange(24, dtype=np.float32).reshape(12, 2)
+    y = np.zeros((12, 1), np.float32)
+    retained = tap.feed(x, y)
+    assert retained == 8 and tap.dropped == 4
+    got_x, _ = tap.drain()
+    # Drop-OLDEST: the freshest 8 rows survive (drift keeps them relevant).
+    np.testing.assert_array_equal(got_x, x[4:])
+    assert tap.stats()["dropped"] == 4
+
+
+def test_feed_1d_class_labels_keeps_every_row():
+    """1-D integer class labels (the shadow-eval-supported label form)
+    are one label PER ROW — every row must survive the feed, as (n, 1)."""
+    tap = TrafficTap(capacity_rows=32)
+    x = np.arange(10, dtype=np.float32).reshape(5, 2)
+    labels = np.array([0, 1, 2, 1, 0], np.float32)
+    assert tap.feed(x, labels) == 5
+    got_x, got_y = tap.drain()
+    np.testing.assert_array_equal(got_x, x)
+    np.testing.assert_array_equal(got_y, labels[:, None])
+    # Misaligned batches are refused whole, never truncated.
+    assert tap.feed(x, np.zeros((3,), np.float32)) == 0
+    assert tap.depth() == 0
+
+
+def test_drain_drops_minority_shapes_instead_of_requeueing():
+    """A shape-anomalous row must not become the NEXT drain's reference
+    shape (that would starve the daemon down to the minority); misfits
+    are dropped and counted."""
+    tap = TrafficTap(capacity_rows=32)
+    tap.feed(np.zeros((4, 3), np.float32), np.zeros((4, 1), np.float32))
+    tap.feed(np.zeros((1, 5), np.float32), np.zeros((1, 1), np.float32))
+    tap.feed(np.zeros((2, 3), np.float32), np.zeros((2, 1), np.float32))
+    got_x, _ = tap.drain()
+    assert got_x.shape == (6, 3)  # the majority shape, both batches
+    assert tap.dropped == 1  # the odd (5,)-wide row was dropped, loudly
+    assert tap.drain() is None  # nothing requeued
+
+
+def test_single_row_feed_and_mirror_sampling():
+    tap = TrafficTap(capacity_rows=16, mirror_rows=4, sample_every=2)
+    tap.feed([1.0, 2.0], [0.0, 1.0])
+    got_x, got_y = tap.drain()
+    assert got_x.shape == (1, 2) and got_y.shape == (1, 2)
+    for i in range(10):
+        tap.observe(np.full((3,), float(i), np.float32))
+    mirror = tap.mirror()
+    assert mirror.shape == (4, 3)  # bounded, freshest kept
+    assert tap.mirrored == 5  # 1-in-2 sampling
+
+
+def test_slow_daemon_never_stalls_or_drops_serving():
+    """The backpressure satellite: serving through a full, never-drained
+    tap answers EVERY request — a slow (dead) refit daemon costs tap
+    rows, never serving traffic."""
+    from keystone_tpu.serving.config import ServingConfig
+    from keystone_tpu.serving.server import PipelineServer
+    from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
+
+    d, n = 8, 64
+    tap = TrafficTap(capacity_rows=4, mirror_rows=4)
+    # Pre-fill the labeled buffer to its bound: the daemon is "slow" —
+    # nothing ever drains it while traffic flows.
+    tap.feed(np.zeros((4, d), np.float32), np.zeros((4, 1), np.float32))
+    server = PipelineServer(
+        model=synthetic_fitted_pipeline(d=d, seed=0),
+        config=ServingConfig(max_batch=8, queue_depth=n + 16),
+        tap=tap,
+    ).start()
+    try:
+        server.warmup(np.zeros((d,), np.float32))
+        t0 = time.monotonic()
+        futures = server.submit_many(
+            [np.full((d,), float(i % 5), np.float32) for i in range(n)],
+            deadline_s=60.0,
+        )
+        results = [f.result(timeout=60.0) for f in futures]
+        wall = time.monotonic() - t0
+    finally:
+        server.stop(drain=True)
+    assert len(results) == n  # zero dropped
+    assert wall < 30.0  # never parked behind the tap
+    # The tap stayed at its bound; overflow was ITS loss, not serving's.
+    stats = tap.stats()
+    assert stats["labeled_depth"] <= 4
+    assert stats["mirror_depth"] <= 4
+    # Served payloads were sampled into the mirror without blocking.
+    assert tap.mirrored > 0
