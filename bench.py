@@ -1188,6 +1188,60 @@ def _bench_serving_multiworker(small: bool) -> dict:
     out["throughput_vs_one_worker"] = round(
         out["two_worker_kill_rps"] / max(out["one_worker_rps"], 1e-9), 2
     )
+
+    # Leg 3 — fleet-tracing overhead (docs/OBSERVABILITY.md budget:
+    # ≤5%). Same 2-worker synthetic fleet as the sweeps above, no
+    # chaos: one fleet with fleet tracing OFF, one with it ON (worker
+    # span sessions + heartbeat fragment shipping + parent ingress/
+    # dispatch spans + the wire field on every control line). Min-of-3
+    # sweeps per fleet so scheduler noise doesn't masquerade as tracing
+    # cost; the budget gate is the bool, the pct is the evidence.
+    from keystone_tpu.obs import spans as obs_spans
+
+    def overhead_sweep(traced: bool) -> float:
+        sup = WorkerSupervisor(
+            {"synthetic": {"d": d, "seed": 0}},
+            SupervisorConfig(
+                workers=2,
+                heartbeat_s=0.2,
+                hang_timeout_s=15.0,
+                ready_timeout_s=240.0,
+                max_batch=8,
+                queue_depth=n_load + 64,
+                worker_queue_depth=n_load + 32,
+            ),
+            env={"KEYSTONE_FLEET_TRACE": "1" if traced else ""},
+        ).start()
+        import contextlib
+
+        session = (
+            obs_spans.tracing_session("bench-trace", sync_timings=False)
+            if traced
+            else contextlib.nullcontext()
+        )
+        payloads = [[float(i % 7)] * d for i in range(n_load)]
+        best = float("inf")
+        try:
+            sup.wait_ready()
+            with session:
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    futures = sup.submit_many(payloads, deadline_s=180.0)
+                    for f in futures:
+                        f.result(timeout=240)
+                    best = min(best, time.perf_counter() - t0)
+        finally:
+            sup.stop()
+        return best
+
+    off_wall = overhead_sweep(False)
+    on_wall = overhead_sweep(True)
+    out["tracing_off_wall_s"] = round(off_wall, 4)
+    out["tracing_on_wall_s"] = round(on_wall, 4)
+    out["tracing_overhead_pct"] = round(
+        (on_wall - off_wall) / max(off_wall, 1e-9) * 100.0, 2
+    )
+    out["tracing_overhead_ok"] = bool(on_wall <= off_wall * 1.05)
     return out
 
 
@@ -1831,14 +1885,17 @@ def _leg_obs_before() -> dict:
     Diffed by :func:`_leg_obs_snapshot` after the leg so every BENCH leg
     payload carries its own counters (docs/OBSERVABILITY.md)."""
     from keystone_tpu.obs import metrics as obs_metrics
+    from keystone_tpu.obs import spans as obs_spans
     from keystone_tpu.utils.compilation_cache import compile_count
 
     from keystone_tpu.obs import device as obs_device
 
+    session = obs_spans.active_session()
     return {
         "metrics": obs_metrics.get_registry().snapshot(),
         "compiles": compile_count(),
         "bytes_in_use": obs_device.memory_snapshot()["bytes_in_use"],
+        "span_cursor": len(session) if session is not None else 0,
     }
 
 
@@ -1857,6 +1914,22 @@ def _leg_obs_snapshot(before: dict) -> dict:
     moved = obs_metrics.delta(
         obs_metrics.get_registry().snapshot(), before["metrics"]
     )
+    # Trace footprint (docs/OBSERVABILITY.md "Fleet tracing"): spans this
+    # leg recorded into the active session (0 for untraced legs — the
+    # bench's default) and their serialized fragment bytes, the wire
+    # cost fleet shipping would pay for them.
+    from keystone_tpu.obs import fleet as obs_fleet
+    from keystone_tpu.obs import spans as obs_spans
+
+    session = obs_spans.active_session()
+    span_count = 0
+    trace_bytes = 0
+    if session is not None:
+        fresh = session.spans()[before.get("span_cursor", 0):]
+        span_count = len(fresh)
+        trace_bytes = sum(
+            len(json.dumps(obs_fleet.span_fragment(s, session))) for s in fresh
+        )
     return {
         "xla_compiles": compile_count() - before["compiles"],
         # peak_bytes_in_use never resets between legs, so it is the
@@ -1865,6 +1938,8 @@ def _leg_obs_snapshot(before: dict) -> dict:
         "lifetime_peak_memory_bytes": mem["peak_bytes_in_use"],
         "memory_in_use_delta_bytes": mem["bytes_in_use"] - before["bytes_in_use"],
         "memory_source": mem["source"],
+        "span_count": span_count,
+        "trace_bytes": trace_bytes,
         "metrics_delta": moved,
     }
 

@@ -320,6 +320,20 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_profile_arguments(profile_parser)
 
+    # Fleet observability plane (docs/OBSERVABILITY.md "Fleet tracing"):
+    # drive a traffic sweep against a real multiworker fleet under
+    # cross-process tracing, emit the merged Perfetto trace + /metrics
+    # scrape artifacts. Stdlib-only flag wiring; the default stub
+    # backend keeps the whole run jax-free.
+    from .obs.fleet import add_trace_arguments
+
+    trace_parser = sub.add_parser(
+        "trace",
+        help="fleet trace: multiworker traffic sweep → merged Perfetto "
+        "trace + Prometheus scrape + flight-recorder artifacts",
+    )
+    add_trace_arguments(trace_parser)
+
     # Perf-regression gate (docs/OBSERVABILITY.md): compare two BENCH
     # json artifacts leg by leg with noise-aware tolerances. Entirely
     # stdlib — CI runs it without a backend.
@@ -379,6 +393,10 @@ def main(argv: Optional[list] = None) -> int:
             print(f"{name:28s} {entry[-1]}")
         print(f"{'serve':28s} online serving front-end (micro-batched, stdin/JSON)")
         print(f"{'profile':28s} instrumented run → Chrome trace + Prometheus snapshot")
+        print(
+            f"{'trace':28s} fleet trace: multiworker sweep → merged "
+            "Perfetto trace + /metrics scrape"
+        )
         print(f"{'bench-diff':28s} compare two BENCH json artifacts, fail on regression")
         print(
             f"{'check':28s} static tier: keystone-lint + concurrency "
@@ -408,6 +426,11 @@ def main(argv: Optional[list] = None) -> int:
         from .serving.server import serve_from_args
 
         return serve_from_args(args)
+
+    if args.workload == "trace":
+        from .obs.fleet import trace_from_args
+
+        return trace_from_args(args)
 
     if args.workload == "bench-diff":
         from .obs.benchdiff import bench_diff_from_args

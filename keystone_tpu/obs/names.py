@@ -133,6 +133,19 @@ REFIT_STATE_ROWS = "keystone_refit_state_rows"
 REFIT_FOLD_SECONDS = "keystone_refit_fold_seconds"
 REFIT_SCORE = "keystone_refit_score"
 
+# --------------------------------------------------------------- fleet tracing
+FLEET_SPAN_FRAGMENTS = "keystone_fleet_span_fragments_total"
+FLEET_TRACE_BYTES = "keystone_fleet_trace_bytes_total"
+FLEET_CLOCK_SKEW = "keystone_fleet_clock_skew_seconds"
+FLEET_REQUESTS = "keystone_fleet_requests_total"
+FLEET_FAILURES = "keystone_fleet_failures_total"
+FLEET_WORKER_SERIES = "keystone_fleet_worker_series"
+
+# ------------------------------------------------------------- flight recorder
+FLIGHT_RECORDS = "keystone_flight_records_total"
+FLIGHT_DUMPS = "keystone_flight_dumps_total"
+FLIGHT_DUMP_BYTES = "keystone_flight_dump_bytes"
+
 # ---------------------------------------------------------------------- memory
 MEMORY_IN_USE_BYTES = "keystone_memory_in_use_bytes"
 PEAK_MEMORY_BYTES = "keystone_peak_memory_bytes"
@@ -223,6 +236,15 @@ SCHEMA: Dict[str, Tuple] = {
     REFIT_STATE_ROWS: ("gauge", "Examples absorbed into the persisted refit sufficient statistics", ()),
     REFIT_FOLD_SECONDS: ("histogram", "Incremental refit folds (drain + fold + finish wall time)", ()),
     REFIT_SCORE: ("gauge", "Latest shadow-evaluation score, per role (candidate/incumbent/live)", ("role",)),
+    FLEET_SPAN_FRAGMENTS: ("counter", "Span fragments folded into the fleet trace collector, per shipping process role", ("role",)),
+    FLEET_TRACE_BYTES: ("counter", "Serialized span-fragment bytes shipped over the heartbeat channel", ()),
+    FLEET_CLOCK_SKEW: ("gauge", "Estimated per-process wall-clock offset vs the collector at heartbeat receipt", ("role",)),
+    FLEET_REQUESTS: ("counter", "Fleet-aggregated requests served per worker id, monotonic across worker incarnations", ("worker",)),
+    FLEET_FAILURES: ("counter", "Fleet-aggregated failed requests per worker id, monotonic across worker incarnations", ("worker",)),
+    FLEET_WORKER_SERIES: ("gauge", "Fleet-summed worker-process registry series (heartbeat metric deltas, folded across incarnations), keyed by flat series name", ("series",)),
+    FLIGHT_RECORDS: ("counter", "Entries appended to the flight-recorder ring buffers, by kind (ledger/metrics/mark)", ("kind",)),
+    FLIGHT_DUMPS: ("counter", "Flight-recorder dump artifacts written, by trigger", ("trigger",)),
+    FLIGHT_DUMP_BYTES: ("gauge", "Size of the last flight-recorder dump artifact written by this process", ()),
     MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source", "device")),
     PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage", "device")),
 }

@@ -26,28 +26,61 @@ times.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
-import uuid
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 TraceContext = Tuple[str, str]  # (trace_id, span_id)
 
+#: JSON field name the serving control pipe carries a wire context under
+#: (supervisor → worker request lines; docs/OBSERVABILITY.md "Fleet
+#: tracing").
+WIRE_FIELD = "trace"
+
+
+# Span-id generator: seeded from the system entropy pool once, then a
+# single C-level getrandbits per id (~0.5µs). uuid4 here cost ~17µs per
+# span (an os.urandom syscall each) — at serving dispatch rates that
+# alone blew the 5% tracing-overhead budget.
+_id_rng = random.Random()
+
 
 def _new_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return "%016x" % _id_rng.getrandbits(64)
 
 
-@dataclass
+def to_wire(context: Optional[TraceContext]) -> Optional[str]:
+    """Compact wire form of a trace context — ``"<trace_id>:<span_id>"``
+    — for JSON-lines control messages. None stays None (tracing off adds
+    zero bytes to the pipe)."""
+    if context is None:
+        return None
+    return f"{context[0]}:{context[1]}"
+
+
+def from_wire(value: Any) -> Optional[TraceContext]:
+    """Parse a wire context; tolerant of garbage (a malformed trace field
+    must never fail a request — it just drops the trace link)."""
+    if not isinstance(value, str) or ":" not in value:
+        return None
+    trace_id, _, span_id = value.partition(":")
+    if not trace_id:
+        return None
+    return (trace_id, span_id)
+
+
+@dataclass(slots=True)
 class SpanEvent:
     name: str
     ts_s: float  # perf_counter timestamp
     attributes: Dict[str, Any] = field(default_factory=dict)
 
 
-@dataclass
+@dataclass(slots=True)
 class Span:
     """One finished (or in-flight) timed operation."""
 
@@ -108,6 +141,17 @@ class TraceSession:
     dispatch time only and async dispatch between nodes is preserved
     (the right trade for sessions that exist to collect counters and
     coarse phase spans, e.g. metrics-only serving runs).
+
+    ``ring`` selects what the cap sacrifices: False (default — bounded
+    profiling runs) drops NEW spans past ``max_spans`` (``dropped``
+    counts them), so a runaway run can't evict the phases you captured;
+    True (process-lifetime sessions: serving workers, fleet tracing)
+    evicts the OLDEST (``evicted`` counts them), so the buffer always
+    holds the most recent window — a flight-recorder dump hours into a
+    worker's life captures the crash window, not startup, and heartbeat
+    shipping never goes dark. ``added`` counts every accepted span, so
+    ring consumers (``fleet.drain_fragments``) can cursor by absolute
+    index across evictions.
     """
 
     def __init__(
@@ -115,6 +159,7 @@ class TraceSession:
         name: str = "trace",
         max_spans: int = 100_000,
         sync_timings: bool = True,
+        ring: bool = False,
     ):
         self.name = name
         self.sync_timings = sync_timings
@@ -122,20 +167,34 @@ class TraceSession:
         self.started_unix = time.time()
         self.started_s = time.perf_counter()
         self.max_spans = max_spans
+        self.ring = ring
         self.dropped = 0
+        self.evicted = 0
+        self.added = 0
         self._lock = threading.Lock()
-        self._spans: List[Span] = []
+        self._spans: "deque[Span]" = deque()
 
     def add(self, span: Span) -> None:
         with self._lock:
             if len(self._spans) >= self.max_spans:
-                self.dropped += 1
-                return
+                if not self.ring:
+                    self.dropped += 1
+                    return
+                self._spans.popleft()
+                self.evicted += 1
             self._spans.append(span)
+            self.added += 1
 
     def spans(self) -> List[Span]:
         with self._lock:
             return list(self._spans)
+
+    def tail(self) -> Tuple[List[Span], int]:
+        """(current buffer, total spans ever accepted): the absolute
+        index of ``buffer[0]`` is ``total - len(buffer)`` — the datum
+        ring-aware cursors (fleet shipping) advance against."""
+        with self._lock:
+            return list(self._spans), self.added
 
     def find(self, name_prefix: str) -> List[Span]:
         return [s for s in self.spans() if s.name.startswith(name_prefix)]
@@ -188,47 +247,129 @@ def tracing_session(
                 _session = None
 
 
-@contextmanager
-def span(name: str, **attributes: Any):
+def install_session(
+    name: str = "trace",
+    max_spans: int = 100_000,
+    sync_timings: bool = True,
+    ring: bool = True,
+) -> TraceSession:
+    """Install a process-LIFETIME session (no context manager — worker
+    processes and long-lived daemons own the process scope; the fleet
+    tracing layer uses this so recent worker spans are shippable on
+    heartbeats). Ring semantics by default: a long-lived process must
+    keep its most RECENT spans — drop-newest would go permanently dark
+    once full, and a crash dump would capture startup instead of the
+    crash window. Idempotent: an existing session is reused, exactly
+    like a nested :func:`tracing_session`."""
+    global _session
+    with _session_lock:
+        if _session is None:
+            _session = TraceSession(
+                name, max_spans=max_spans, sync_timings=sync_timings, ring=ring
+            )
+        return _session
+
+
+class _NoopSpanContext:
+    """Shared no-op ``with`` target when no session is active."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return NOOP_SPAN
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP_SPAN_CM = _NoopSpanContext()
+
+
+class _SpanContext:
+    """Slotted context manager for one open span. Hand-rolled instead of
+    ``@contextmanager``: the generator protocol costs several µs per
+    span, and span() sits on the serving dispatch hot path where the
+    fleet-tracing budget is 5% of a ~300µs request."""
+
+    __slots__ = ("_record", "_stack", "_session")
+
+    def __init__(self, record: Span, stack: List[Span], session: TraceSession):
+        self._record = record
+        self._stack = stack
+        self._session = session
+
+    def __enter__(self) -> Span:
+        # Side effects happen HERE, not at span() call time: a
+        # constructed-but-never-entered context manager must not leave a
+        # phantom record on the thread's stack (it would corrupt every
+        # later span's parentage and unbalance __exit__'s pop).
+        record = self._record
+        self._stack.append(record)
+        record.start_s = time.perf_counter()
+        return record
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        record = self._record
+        if exc_type is not None:
+            record.status = "error"
+            record.add_event(
+                "exception", type=exc_type.__name__, message=str(exc)[:200]
+            )
+        record.end_s = time.perf_counter()
+        self._stack.pop()
+        self._session.add(record)
+        return False  # always re-raise
+
+
+def _thread_info() -> Tuple[int, str]:
+    """(ident, name) of the current thread, cached thread-locally —
+    ``threading.current_thread()`` costs ~0.5µs per call on the dispatch
+    hot path and a thread's identity never changes."""
+    info = getattr(_state, "thread_info", None)
+    if info is None:
+        thread = threading.current_thread()
+        info = (thread.ident or 0, thread.name)
+        _state.thread_info = info
+    return info
+
+
+def span(name: str, parent: Optional[TraceContext] = None, **attributes: Any):
     """Open a child span of the current thread's active span (or of the
-    attached remote context, or a session root). No-op without a session."""
+    attached remote context, or a session root). No-op without a session.
+
+    ``parent`` hands a REMOTE context in directly — shorthand for
+    ``with attach(ctx), span(name)`` on threads with no open span (the
+    worker request path), skipping the attach scope. An open span on
+    this thread still wins: nesting is local first, like attach."""
     session = _session
     if session is None:
-        yield NOOP_SPAN
-        return
+        return _NOOP_SPAN_CM
     stack = _stack()
     if stack:
-        trace_id, parent_id = stack[-1].trace_id, stack[-1].span_id
+        top = stack[-1]
+        trace_id, parent_id = top.trace_id, top.span_id
     else:
-        attached: Optional[TraceContext] = getattr(_state, "attached", None)
+        attached: Optional[TraceContext] = (
+            parent
+            if parent is not None
+            else getattr(_state, "attached", None)
+        )
         if attached is not None:
             trace_id, parent_id = attached
         else:
             trace_id, parent_id = session.trace_id, None
-    thread = threading.current_thread()
+    thread_id, thread_name = _thread_info()
     record = Span(
         name=name,
         trace_id=trace_id,
         span_id=_new_id(),
         parent_id=parent_id,
-        start_s=time.perf_counter(),
-        attributes=dict(attributes),
-        thread_id=thread.ident or 0,
-        thread_name=thread.name,
+        start_s=0.0,  # stamped in __enter__, where the stack push lives
+        attributes=attributes,
+        thread_id=thread_id,
+        thread_name=thread_name,
     )
-    stack.append(record)
-    try:
-        yield record
-    except BaseException as exc:
-        record.status = "error"
-        record.add_event(
-            "exception", type=type(exc).__name__, message=str(exc)[:200]
-        )
-        raise
-    finally:
-        record.end_s = time.perf_counter()
-        stack.pop()
-        session.add(record)
+    return _SpanContext(record, stack, session)
 
 
 def record_span(
@@ -248,7 +389,7 @@ def record_span(
         trace_id, parent_id = parent
     else:
         trace_id, parent_id = session.trace_id, None
-    thread = threading.current_thread()
+    thread_id, thread_name = _thread_info()
     record = Span(
         name=name,
         trace_id=trace_id,
@@ -257,8 +398,8 @@ def record_span(
         start_s=start_s,
         end_s=end_s,
         attributes=dict(attributes),
-        thread_id=thread.ident or 0,
-        thread_name=thread.name,
+        thread_id=thread_id,
+        thread_name=thread_name,
     )
     session.add(record)
     return record
@@ -274,12 +415,18 @@ def current_span():
 
 def current_context() -> Optional[TraceContext]:
     """(trace_id, span_id) handoff token for cross-thread continuation, or
-    None when not tracing."""
+    None when not tracing. On a thread with no open span but an attached
+    remote context (a worker pipe thread continuing a supervisor trace),
+    the ATTACHED context is the answer — a second hop of handoff must
+    keep the originating trace, not restart at the local session root."""
     if _session is None:
         return None
     stack = getattr(_state, "stack", None)
     if stack:
         return stack[-1].context()
+    attached: Optional[TraceContext] = getattr(_state, "attached", None)
+    if attached is not None:
+        return attached
     return (_session.trace_id, "")
 
 
@@ -293,13 +440,27 @@ def add_span_event(name: str, **attributes: Any) -> None:
         stack[-1].add_event(name, **attributes)
 
 
-@contextmanager
-def attach(context: Optional[TraceContext]) -> Iterator[None]:
+class _AttachContext:
+    """Slotted attach scope (see :class:`_SpanContext` for why this is
+    not ``@contextmanager``). The attachment is installed at
+    construction — ``with attach(ctx):`` evaluates it immediately — and
+    restored on exit."""
+
+    __slots__ = ("_prev",)
+
+    def __init__(self, context: Optional[TraceContext]):
+        self._prev = getattr(_state, "attached", None)
+        _state.attached = context
+
+    def __enter__(self) -> None:
+        return None
+
+    def __exit__(self, *exc) -> bool:
+        _state.attached = self._prev
+        return False
+
+
+def attach(context: Optional[TraceContext]) -> "_AttachContext":
     """Continue a trace captured on another thread: spans opened inside
     parent under ``context`` instead of starting a new root."""
-    prev = getattr(_state, "attached", None)
-    _state.attached = context
-    try:
-        yield
-    finally:
-        _state.attached = prev
+    return _AttachContext(context)

@@ -54,6 +54,8 @@ import numpy as np
 
 from ..envknobs import env_float, env_int
 from ..obs import names as _names
+from ..obs import spans as _spans
+from ..obs.flight import install_flight_recorder
 from ..reliability import faultinject
 from ..reliability.faultinject import probe
 from ..reliability.recovery import get_recovery_log
@@ -148,6 +150,9 @@ class RefitDaemon:
         if self._state is None and store is not None:
             self._state = load_stream_state(store, self.config.state_key)
         self._rounds = 0
+        # Always-on flight recorder (idempotent): a watch-window
+        # rollback's ledger event dumps this process's post-mortem.
+        install_flight_recorder("refit")
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -170,9 +175,17 @@ class RefitDaemon:
         """One refit round; returns the outcome
         (``published`` | ``skipped_nodata`` | ``skipped_eval`` |
         ``rolled_back``). Exceptions propagate — the supervised loop
-        (not this method) owns the error ledger."""
+        (not this method) owns the error ledger. Under an active trace
+        session the whole round is one ``refit:round`` span tree —
+        tap drain → fold → shadow → publish → watch share its trace id
+        (docs/OBSERVABILITY.md "Fleet tracing")."""
         with self._lock:  # one fold at a time; state is read-modify-write
-            return self._run_once_locked()
+            with _spans.span(
+                "refit:round", round=self._rounds + 1, daemon=self.config.name
+            ) as round_span:
+                outcome = self._run_once_locked()
+                round_span.set_attribute("outcome", outcome)
+                return outcome
 
     def _run_once_locked(self) -> str:
         self._rounds += 1
@@ -199,25 +212,27 @@ class RefitDaemon:
         eval_x, eval_y = x[n - eval_n :], y[n - eval_n :]
 
         # ---------------------------------------------------- incremental fold
-        probe("refit.fold")
-        t_fold = time.perf_counter()
-        candidate = self._fold(train_x, train_y)
-        self._state = self.estimator.export_stream_state()
-        if self.store is not None and self._state is not None:
-            save_stream_state(self.store, self.config.state_key, self._state)
-        fold_s = time.perf_counter() - t_fold
+        with _spans.span("refit:fold", rows=int(train_x.shape[0])):
+            probe("refit.fold")
+            t_fold = time.perf_counter()
+            candidate = self._fold(train_x, train_y)
+            self._state = self.estimator.export_stream_state()
+            if self.store is not None and self._state is not None:
+                save_stream_state(self.store, self.config.state_key, self._state)
+            fold_s = time.perf_counter() - t_fold
         self._m_fold_s.observe(fold_s)
         self._m_state_rows.set(self.state_rows())
 
         # -------------------------------------------------------- shadow eval
         incumbent = self.publisher.current_model()
-        report = self.shadow.compare(
-            candidate,
-            incumbent,
-            eval_x,
-            eval_y,
-            mirror_x=self.tap.mirror(self.config.mirror_rows),
-        )
+        with _spans.span("refit:shadow", eval_rows=int(eval_n)):
+            report = self.shadow.compare(
+                candidate,
+                incumbent,
+                eval_x,
+                eval_y,
+                mirror_x=self.tap.mirror(self.config.mirror_rows),
+            )
         if not report.passed:
             get_recovery_log().record(
                 "refit_skip",
@@ -241,7 +256,8 @@ class RefitDaemon:
             # spot is exactly how a bad candidate reaches traffic) and
             # the watch window below must catch it.
             candidate = injector.wrap("refit.candidate", lambda: candidate)()
-        ticket = self.publisher.publish(candidate, round_index=round_index)
+        with _spans.span("refit:publish", round=round_index):
+            ticket = self.publisher.publish(candidate, round_index=round_index)
         outcome = self._watch(ticket, report, eval_x, eval_y, round_index)
         if hasattr(self.publisher, "settle"):
             self.publisher.settle()
@@ -271,9 +287,39 @@ class RefitDaemon:
     def _watch(
         self, ticket, shadow_report, watch_x, watch_y, round_index: int
     ) -> str:
-        """Post-publish watch window: score what the serve path is NOW
-        producing on held-back labeled rows, and check serving health.
-        Regression → O(1) rollback to the retained previous version."""
+        """Post-publish watch window, on its OWN thread: it scores live
+        traffic, which is the shape a future non-blocking watch (running
+        through the next round's tap accumulation) takes — today the
+        round joins it before returning. The thread inherits the round's
+        trace context via ``attach(current_context())``, so the
+        ``refit:watch`` span nests under ``refit:round`` even though it
+        runs on another thread (pinned by tests/refit/test_daemon.py)."""
+        context = _spans.current_context()
+        box: Dict[str, Any] = {}
+
+        def watch_body() -> None:
+            try:
+                with _spans.attach(context), _spans.span(
+                    "refit:watch", round=round_index,
+                    version=str(ticket.version),
+                ) as watch_span:
+                    box["outcome"] = self._watch_inner(
+                        ticket, shadow_report, watch_x, watch_y
+                    )
+                    watch_span.set_attribute("outcome", box["outcome"])
+            except BaseException as exc:  # re-raised on the round thread
+                box["error"] = exc
+
+        thread = threading.Thread(
+            target=watch_body, name="keystone-refit-watch"
+        )
+        thread.start()
+        thread.join()
+        if "error" in box:
+            raise box["error"]
+        return box["outcome"]
+
+    def _watch_inner(self, ticket, shadow_report, watch_x, watch_y) -> str:
         reason = None
         live_score = None
         try:

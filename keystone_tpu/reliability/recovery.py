@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List
 
+from ..obs import flight as _flight
 from ..obs import names as _names
 from ..obs import spans as _spans
 
@@ -49,6 +50,10 @@ class RecoveryLog:
             k: v for k, v in detail.items()
             if isinstance(v, (bool, int, float, str))
         })
+        # Flight recorder (obs/flight.py): ring-append, and crash-class
+        # kinds (worker_crash, fault, refit_rollback, slo degrade) dump
+        # the post-mortem artifact. Single global read when uninstalled.
+        _flight.observe_ledger(kind, label, detail)
 
     def events(self, kind: str = None) -> List[RecoveryEvent]:
         with self._lock:

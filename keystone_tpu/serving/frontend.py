@@ -24,6 +24,13 @@ HTTP API (JSON in, JSON out):
                        carries per-worker states (the failure matrix in
                        docs/SERVING.md keys off these)
     GET  /stats      → the supervisor's aggregated stats snapshot
+    GET  /metrics    → Prometheus text exposition aggregated across the
+                       fleet (restart-safe: counters stay monotonic
+                       through worker incarnations — docs/OBSERVABILITY.md)
+
+Tracing: each ``POST /v1/apply`` opens an ``http:apply`` ingress span
+when a trace session is active; the supervisor forwards its context on
+the control pipe so worker spans re-parent under it ("Fleet tracing").
 
 ``deadline_ms`` enters here and is *remaining budget* from this moment:
 the front-end stamps a Deadline, the supervisor forwards what is left at
@@ -40,6 +47,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from ..obs import spans as _spans
 from .config import (
     RequestShed,
     RequestTimeout,
@@ -88,7 +96,21 @@ class ServingFrontend:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, text: str) -> None:
+                body = text.encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self) -> None:
+                if self.path == "/metrics":
+                    code, text = frontend._metrics()
+                    self._reply_text(code, text)
+                    return
                 if self.path == "/healthz":
                     code, obj = frontend._health()
                 elif self.path == "/stats":
@@ -129,7 +151,27 @@ class ServingFrontend:
             "workers": workers,
         }
 
+    def _metrics(self) -> Tuple[int, str]:
+        """Fleet-aggregated Prometheus exposition (obs/fleet.py): the
+        local registry — the supervisor's own serving/SLO series live in
+        this process — plus restart-safe ``keystone_fleet_*`` counters
+        from the supervisor's per-worker high-water totals."""
+        from ..obs.fleet import fleet_prometheus_text
+
+        try:
+            return 200, fleet_prometheus_text(self.supervisor)
+        except Exception as exc:
+            return 500, f"# metrics export failed: {type(exc).__name__}: {exc}\n"
+
     def _apply(self, obj: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """HTTP ingress: the ``http:apply`` span opened here is the trace
+        root the whole cross-process request tree hangs under."""
+        with _spans.span("http:apply") as ingress:
+            code, out = self._apply_inner(obj)
+            ingress.set_attribute("http_status", code)
+            return code, out
+
+    def _apply_inner(self, obj: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
         x = obj.get("x")
         if not isinstance(x, list) or not x:
             return 400, {"error": f"x must be a non-empty array, got {x!r}"}
@@ -236,6 +278,9 @@ def serve_multiworker_from_args(args) -> int:
     the per-worker breakdown under ``workers``."""
     import sys
 
+    from ..envknobs import env_flag, env_raw
+    from ..obs.fleet import FLEET_TRACE_ENV
+    from ..obs.flight import install_flight_recorder
     from .supervisor import SupervisorConfig
 
     try:
@@ -243,6 +288,17 @@ def serve_multiworker_from_args(args) -> int:
     except ValueError as exc:
         print(f"serve: {exc}", file=sys.stderr)
         return 2
+    install_flight_recorder("frontend")
+    # KEYSTONE_FLEET_TRACE=1: trace this front-end/supervisor process
+    # too (workers read the same flag from their inherited environment);
+    # KEYSTONE_FLEET_TRACE_OUT names a merged-trace artifact written at
+    # shutdown.
+    trace_session = (
+        _spans.install_session("serve-frontend", sync_timings=False)
+        if env_flag(FLEET_TRACE_ENV)
+        else None
+    )
+    trace_out = env_raw("KEYSTONE_FLEET_TRACE_OUT")
     config = SupervisorConfig(
         workers=args.workers,
         model_name=args.model_name,
@@ -320,6 +376,20 @@ def serve_multiworker_from_args(args) -> int:
     finally:
         if frontend is not None:
             frontend.stop()
+        if trace_out:
+            # Merge BEFORE stop: fragments ship on heartbeats, and the
+            # last beats land while workers are still alive.
+            try:
+                time.sleep(supervisor.config.heartbeat_s * 2)
+                from ..obs.fleet import write_fleet_trace
+
+                write_fleet_trace(
+                    supervisor.fleet, trace_out,
+                    local_session=trace_session, local_role="frontend",
+                )
+                print(f"FLEET_TRACE:{trace_out}", file=sys.stderr, flush=True)
+            except Exception:
+                pass  # an artifact failure must not fail the serve run
         # Drain settles every outstanding future; each worker's exit
         # stats line lands through the reader before its pipe closes, so
         # the aggregate below carries final counters.

@@ -56,6 +56,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..obs import names as _names
+from ..obs import spans as _spans
+from ..obs.fleet import MONOTONIC_WORKER_COUNTERS, FleetTraceCollector
+from ..obs.flight import install_flight_recorder
 from ..reliability.recovery import get_recovery_log
 from ..reliability.retry import Deadline, RetryPolicy
 from .admission import AdmissionController
@@ -154,6 +157,11 @@ class _Pending:
     deadline: Optional[Deadline]
     future: Future = field(default_factory=Future)
     requeues: int = 0
+    #: submit-time trace context; every (re)dispatch forwards it on the
+    #: control pipe so the worker's spans re-parent under the originating
+    #: trace (docs/OBSERVABILITY.md "Fleet tracing"). None when tracing
+    #: is off — zero wire bytes.
+    trace: Optional[_spans.TraceContext] = None
 
 
 class _Worker:
@@ -170,6 +178,14 @@ class _Worker:
         self.spawn_at = 0.0
         self.last_beat = 0.0
         self.stats: Dict[str, Any] = {}
+        #: restart-safe counter accounting: ``counter_hw`` is the
+        #: high-water mark of the CURRENT incarnation's counters (from
+        #: heartbeats, monotone within an incarnation); ``counter_base``
+        #: holds the folded totals of every dead incarnation. Lifetime
+        #: value = base + hw, monotonic across restarts — what stats()
+        #: aggregates and the fleet /metrics exposition publishes.
+        self.counter_base: Dict[str, float] = {}
+        self.counter_hw: Dict[str, float] = {}
         self.inflight: Dict[int, _Pending] = {}
         self.write_lock = threading.Lock()
         self.control_replies: "deque[Dict[str, Any]]" = deque()
@@ -226,6 +242,14 @@ class WorkerSupervisor:
             self.slo = SLOController(
                 self.admission, self.config.slo_target_p99_ms
             )
+        #: Fleet observability sink: worker span fragments + metric
+        #: deltas arriving on heartbeats land here; the frontend's
+        #: /metrics and the `keystone-tpu trace` artifact read it.
+        self.fleet = FleetTraceCollector()
+        # Always-on flight recorder (idempotent; a frontend sharing this
+        # process may have installed one already): worker_crash ledger
+        # events auto-dump the supervisor's post-mortem view.
+        install_flight_recorder("supervisor")
         self._m_restarts = _names.metric(_names.SERVING_WORKER_RESTARTS)
         self._m_requeued = _names.metric(_names.SERVING_WORKER_REQUEUED)
         self._m_alive = _names.metric(_names.SERVING_WORKERS_ALIVE)
@@ -348,6 +372,18 @@ class WorkerSupervisor:
     # ------------------------------------------------------------------ spawn
     def _spawn(self, worker: _Worker) -> None:
         worker.incarnation += 1
+        if worker.incarnation > 0:
+            # A restart: fold the dead incarnation's counter high-water
+            # marks into the base BEFORE the new process starts counting
+            # from zero — aggregated counters stay monotonic across
+            # incarnations (stats() and the fleet /metrics contract).
+            with self._lock:
+                for counter, value in worker.counter_hw.items():
+                    worker.counter_base[counter] = (
+                        worker.counter_base.get(counter, 0.0) + value
+                    )
+                worker.counter_hw = {}
+                worker.stats = {}
         # A child worker inherits the WHOLE parent environment (platform,
         # cache, store knobs) — a structural pass-through, not a knob
         # read, so it stays a raw access.  # keystone: allow-env
@@ -374,7 +410,7 @@ class WorkerSupervisor:
         worker.last_beat = worker.spawn_at
         worker.reader_thread = threading.Thread(
             target=self._reader_loop,
-            args=(worker, worker.proc),
+            args=(worker, worker.proc, worker.incarnation),
             name=f"keystone-supervisor-read-{worker.id}",
             daemon=True,
         )
@@ -387,7 +423,9 @@ class WorkerSupervisor:
         ).start()
 
     # ----------------------------------------------------------------- reader
-    def _reader_loop(self, worker: _Worker, proc: subprocess.Popen) -> None:
+    def _reader_loop(
+        self, worker: _Worker, proc: subprocess.Popen, incarnation: int
+    ) -> None:
         for raw in proc.stdout:
             raw = raw.strip()
             if not raw:
@@ -403,24 +441,77 @@ class WorkerSupervisor:
             if kind == "heartbeat":
                 worker.last_beat = time.monotonic()
                 worker.stats = msg.get("stats", {})
+                self._update_counter_hw(worker, incarnation, worker.stats)
+                self._ingest_fleet_telemetry(worker, msg, len(raw))
                 self._m_beats.inc(status="ok")
             elif kind == "response":
                 self._on_response(worker, msg)
             elif kind == "ready":
-                self._on_ready(worker)
+                self._on_ready(worker, msg)
             elif kind in ("swapped", "swap_failed", "stats"):
                 with self._lock:
                     worker.control_replies.append(msg)
                 if kind == "stats" and isinstance(msg.get("stats"), dict):
                     worker.stats = msg["stats"]
+                    self._update_counter_hw(worker, incarnation, worker.stats)
         # EOF: the process is exiting; the monitor loop owns the verdict.
 
     def _stderr_loop(self, worker: _Worker, proc: subprocess.Popen) -> None:
         for raw in proc.stderr:
             worker.stderr_tail.append(raw.rstrip())
 
-    def _on_ready(self, worker: _Worker) -> None:
+    def _update_counter_hw(
+        self, worker: _Worker, incarnation: int, stats: Any
+    ) -> None:
+        """Raise the current incarnation's counter high-water marks from a
+        heartbeat/stats payload. The incarnation guard is checked INSIDE
+        the lock: ``_spawn`` bumps ``worker.incarnation`` before folding
+        hw into base under the same lock, so a buffered line from a dead
+        incarnation's pipe either lands before the fold (and is folded —
+        it is legitimate old-incarnation data) or is rejected here; it
+        can never re-pollute the marks after the fold."""
+        if not isinstance(stats, dict):
+            return
+        with self._lock:
+            if worker.incarnation != incarnation:
+                return
+            for counter in MONOTONIC_WORKER_COUNTERS:
+                value = stats.get(counter)
+                if isinstance(value, (int, float)):
+                    worker.counter_hw[counter] = max(
+                        worker.counter_hw.get(counter, 0.0), float(value)
+                    )
+
+    def _ingest_fleet_telemetry(
+        self, worker: _Worker, msg: Dict[str, Any], raw_bytes: int
+    ) -> None:
+        """Heartbeat-borne fleet telemetry (docs/OBSERVABILITY.md): span
+        fragments, the clock anchor, and the metric-registry delta. All
+        optional — a worker not running fleet tracing ships none.
+        ``raw_bytes`` is the heartbeat line's length — the wire cost the
+        trace-bytes counter reports, without re-serializing fragments on
+        this (response-settling) reader thread."""
+        role = f"worker{worker.id}"
+        pid = msg.get("pid") or worker.pid or 0
+        fragments = msg.get("spans")
+        if isinstance(fragments, list) and fragments:
+            self.fleet.add_fragments(role, pid, fragments, raw_bytes=raw_bytes)
+        clock = msg.get("clock")
+        if isinstance(clock, dict):
+            self.fleet.observe_clock(role, pid, clock)
+        delta = msg.get("metrics_delta")
+        if isinstance(delta, dict) and delta:
+            self.fleet.observe_metrics(worker.id, worker.incarnation, delta)
+
+    def _on_ready(self, worker: _Worker, msg: Optional[Dict[str, Any]] = None) -> None:
         worker.last_beat = time.monotonic()
+        if msg is not None and isinstance(msg.get("clock"), dict):
+            # The ready handshake carries the worker's clock anchor —
+            # the alignment datum the merged fleet trace records.
+            self.fleet.observe_clock(
+                f"worker{worker.id}", msg.get("pid") or worker.pid or 0,
+                msg["clock"],
+            )
         first = worker.incarnation == 0
         with self._lock:
             if worker.state != "spawning":
@@ -584,6 +675,10 @@ class WorkerSupervisor:
             model=model,
             key=key,
             deadline=Deadline(deadline_s) if deadline_s is not None else None,
+            # Submit-time trace capture (None with tracing off — a single
+            # global read): the HTTP ingress span, or whatever span the
+            # submitting thread holds, becomes the request's wire parent.
+            trace=_spans.current_context(),
         )
         self._route_or_park(pending)
         return pending.future
@@ -694,15 +789,34 @@ class WorkerSupervisor:
             # Remaining-at-boundary, recomputed on every (re)dispatch so a
             # requeued request carries only what is left of its budget.
             msg["deadline_ms"] = max(pending.deadline.remaining(), 0.0) * 1e3
-        try:
-            with worker.write_lock:
-                worker.proc.stdin.write(json.dumps(msg) + "\n")
-                worker.proc.stdin.flush()
-            return True
-        except Exception:
-            with self._lock:
-                owned = worker.inflight.pop(pending.request_id, None) is not None
-            return not owned or pending.future.done()
+        # Per-dispatch span, parented under the submit-time context (a
+        # requeue shows up as a SECOND dispatch span on the same trace);
+        # the worker re-parents its spans under THIS hop via the wire
+        # field. The explicit parent covers the monitor/drain threads,
+        # whose span stacks are empty; on the submitting thread the open
+        # ingress span (== pending.trace) parents directly.
+        with _spans.span(
+            "supervisor:dispatch",
+            parent=pending.trace,
+            worker=worker.id,
+            request_id=pending.request_id,
+            requeues=pending.requeues,
+        ) as dispatch:
+            wire = _spans.to_wire(dispatch.context() or pending.trace)
+            if wire is not None:
+                msg[_spans.WIRE_FIELD] = wire
+            try:
+                with worker.write_lock:
+                    worker.proc.stdin.write(json.dumps(msg) + "\n")
+                    worker.proc.stdin.flush()
+                return True
+            except Exception:
+                dispatch.set_attribute("broken_pipe", True)
+                with self._lock:
+                    owned = (
+                        worker.inflight.pop(pending.request_id, None) is not None
+                    )
+                return not owned or pending.future.done()
 
     def _expire_pending(self) -> None:
         with self._lock:
@@ -783,10 +897,28 @@ class WorkerSupervisor:
                 time.sleep(0.02)
         return acks
 
+    def fleet_counter_totals(self) -> Dict[str, Dict[str, float]]:
+        """Per-worker LIFETIME counter totals (dead-incarnation base +
+        current high-water): monotonic across restarts by construction —
+        the series the fleet /metrics exposition publishes."""
+        with self._lock:
+            return {
+                w.id: {
+                    counter: w.counter_base.get(counter, 0.0)
+                    + w.counter_hw.get(counter, 0.0)
+                    for counter in MONOTONIC_WORKER_COUNTERS
+                }
+                for w in self._workers.values()
+            }
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, Any]:
         """Aggregate across workers (counters summed, p99 worst-case) plus
-        the per-worker breakdown and the supervisor's own accounting."""
+        the per-worker breakdown and the supervisor's own accounting.
+        Counter aggregates are LIFETIME values (monotonic through worker
+        restarts — a restarted worker's in-process counters restart from
+        zero, the fleet's never do); each worker row carries the raw
+        current-incarnation ``stats`` plus the ``lifetime`` view."""
         with self._lock:
             workers = {
                 w.id: {
@@ -796,19 +928,35 @@ class WorkerSupervisor:
                     "restarts": w.restarts,
                     "inflight": len(w.inflight),
                     "stats": dict(w.stats),
+                    "lifetime": {
+                        counter: w.counter_base.get(counter, 0.0)
+                        + w.counter_hw.get(counter, 0.0)
+                        for counter in MONOTONIC_WORKER_COUNTERS
+                        if counter in w.counter_base or counter in w.counter_hw
+                    },
                 }
                 for w in self._workers.values()
             }
             pending = len(self._pending)
         aggregate: Dict[str, Any] = {}
-        for counter in ("served", "batches", "sheds", "timeouts", "retries",
-                        "failures", "xla_compiles_since_warmup"):
+        for counter in MONOTONIC_WORKER_COUNTERS:
             values = [
-                w["stats"].get(counter) for w in workers.values()
-                if isinstance(w["stats"].get(counter), (int, float))
+                w["lifetime"].get(counter) for w in workers.values()
+                if isinstance(w["lifetime"].get(counter), (int, float))
             ]
             if values:
                 aggregate[counter] = int(sum(values))
+        # Since-warmup compile counts are per-incarnation gauges, not
+        # lifetime counters: a restarted worker legitimately re-zeroes
+        # (the steady-state-compiles invariant reads the CURRENT fleet).
+        compile_values = [
+            w["stats"].get("xla_compiles_since_warmup") for w in workers.values()
+            if isinstance(
+                w["stats"].get("xla_compiles_since_warmup"), (int, float)
+            )
+        ]
+        if compile_values:
+            aggregate["xla_compiles_since_warmup"] = int(sum(compile_values))
         for worst in ("p50_ms", "p95_ms", "p99_ms"):
             values = [
                 w["stats"].get(worst) for w in workers.values()

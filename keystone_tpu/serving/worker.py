@@ -13,15 +13,26 @@ worker side of that contract — run as
 Protocol (one JSON object per line; supervisor → worker on stdin,
 worker → supervisor on stdout):
 
-    → {"kind": "request", "id": N, "x": [...], "model": ..., "deadline_ms": ...}
+    → {"kind": "request", "id": N, "x": [...], "model": ..., "deadline_ms": ...,
+       "trace": "<trace_id>:<span_id>"}
     → {"kind": "swap", "name": ..., "spec": {...}}
     → {"kind": "stats"}
     → {"kind": "shutdown"}
-    ← {"kind": "ready", "worker": ..., "pid": ..., "mode": ..., "init_s": ...}
+    ← {"kind": "ready", "worker": ..., "pid": ..., "mode": ..., "init_s": ...,
+       "clock": {"unix": ..., "perf": ...}}
     ← {"kind": "response", "id": N, "y": [...], "latency_ms": ...}   (or "error")
-    ← {"kind": "heartbeat", "seq": K, "worker": ..., "stats": {...}}
+    ← {"kind": "heartbeat", "seq": K, "worker": ..., "stats": {...},
+       "spans": [...], "metrics_delta": {...}, "clock": {...}}
     ← {"kind": "swapped", "name": ..., "version": ..., "warmup_s": ...}
     ← {"kind": "stats", "stats": {...}}
+
+``trace`` is the optional wire trace context stamped at ingress and
+forwarded on every (re)dispatch; the worker re-parents its spans under
+it so a request's trace id survives frontend → supervisor → worker
+(docs/OBSERVABILITY.md "Fleet tracing"). ``spans``/``metrics_delta``/
+``clock`` ride heartbeats only under ``KEYSTONE_FLEET_TRACE=1``: bounded
+span fragments, the metric-registry delta since the last beat, and the
+clock-alignment anchor.
 
 ``deadline_ms`` is the REMAINING budget at the supervisor→worker
 boundary; the worker rebuilds a :class:`~keystone_tpu.reliability.retry.
@@ -58,6 +69,11 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from ..envknobs import env_flag
+from ..obs import fleet as _fleet
+from ..obs import spans as _spans
+from ..obs.flight import get_flight_recorder, install_flight_recorder
+from ..obs.metrics import delta as _metrics_delta, get_registry
 from ..reliability import faultinject
 from ..reliability.faultinject import probe
 
@@ -364,7 +380,18 @@ def main(argv: Optional[list] = None) -> int:
     parser = argparse.ArgumentParser(prog="keystone_tpu.serving.worker")
     add_worker_arguments(parser)
     args = parser.parse_args(argv)
+    # Always-on flight recorder: an armed fault probe (including `kill`,
+    # which records its ledger event BEFORE the SIGKILL) dumps this
+    # worker's post-mortem to KEYSTONE_FLIGHT_DIR on the way down.
+    install_flight_recorder(f"worker{args.worker_id}")
     faultinject.install_from_env()
+    # Fleet tracing (docs/OBSERVABILITY.md): a process-lifetime span
+    # session whose spans ship to the supervisor as heartbeat fragments.
+    session = (
+        _spans.install_session(f"worker{args.worker_id}", sync_timings=False)
+        if env_flag(_fleet.FLEET_TRACE_ENV)
+        else None
+    )
     emitter = _Emitter()
     spec = json.loads(args.spec)
     t0 = time.monotonic()
@@ -376,6 +403,8 @@ def main(argv: Optional[list] = None) -> int:
             "pid": os.getpid(),
             "mode": backend.mode,
             "init_s": round(time.monotonic() - t0, 3),
+            # Clock anchor for the fleet trace's alignment handshake.
+            "clock": {"unix": time.time(), "perf": time.perf_counter()},
         }
     )
 
@@ -383,17 +412,39 @@ def main(argv: Optional[list] = None) -> int:
 
     def heartbeat_loop() -> None:
         seq = 0
+        span_cursor = 0
+        last_metrics: Dict[str, float] = get_registry().snapshot()
         while not stop.is_set():
             seq += 1
-            line = json.dumps(
-                {
-                    "kind": "heartbeat",
-                    "seq": seq,
-                    "worker": args.worker_id,
-                    "pid": os.getpid(),
-                    "stats": backend.stats(),
+            payload: Dict[str, Any] = {
+                "kind": "heartbeat",
+                "seq": seq,
+                "worker": args.worker_id,
+                "pid": os.getpid(),
+                "stats": backend.stats(),
+            }
+            if session is not None:
+                # Fleet telemetry rides the beat: bounded span-fragment
+                # drain, the clock anchor, and the metric-registry delta
+                # since the last beat (the supervisor folds deltas
+                # monotonically across incarnations).
+                fragments, span_cursor = _fleet.drain_fragments(
+                    session, span_cursor
+                )
+                if fragments:
+                    payload["spans"] = fragments
+                snapshot = get_registry().snapshot()
+                moved = _metrics_delta(snapshot, last_metrics)
+                last_metrics = snapshot
+                if moved:
+                    payload["metrics_delta"] = moved
+                payload["clock"] = {
+                    "unix": time.time(), "perf": time.perf_counter()
                 }
-            )
+            recorder = get_flight_recorder()
+            if recorder is not None:
+                recorder.observe_metrics()  # rate-limited ring snapshot
+            line = json.dumps(payload)
             injector = faultinject.current()
             if injector is not None:
                 # One wrap covers the whole chaos menu at this site:
@@ -422,8 +473,20 @@ def main(argv: Optional[list] = None) -> int:
                 continue
             if kind == "request":
                 try:
-                    probe(PROBE_REQUEST)
-                    backend.handle(msg, emitter)
+                    # Re-parent under the originating trace: the wire
+                    # context (supervisor dispatch hop) becomes this
+                    # worker's span parent, so serve:request spans land
+                    # on the ingress trace id. No-ops without a session;
+                    # a malformed trace field just drops the link.
+                    context = _spans.from_wire(msg.get(_spans.WIRE_FIELD))
+                    with _spans.span(
+                        "worker:request",
+                        parent=context,
+                        worker=args.worker_id,
+                        request_id=msg.get("id"),
+                    ):
+                        probe(PROBE_REQUEST)
+                        backend.handle(msg, emitter)
                 except Exception as exc:
                     # Injected faults (and anything else request-scoped)
                     # answer THIS request; the loop must survive them.

@@ -1,0 +1,98 @@
+#!/usr/bin/env bash
+# Fleet observability smoke: `keystone-tpu trace` drives an HTTP sweep
+# against a 2-worker fleet (jax-free stub backend — the pipe layer is
+# what fleet tracing instruments) with a seeded SIGKILL of worker 0,
+# then asserts the tentpole invariants on the artifacts:
+#
+#   - ONE trace id flows HTTP ingress → supervisor dispatch → worker
+#     apply across >= 3 processes in the merged Perfetto artifact
+#   - the killed worker left a parseable flight-recorder dump (written
+#     by the fault probe BEFORE the SIGKILL), and the supervisor left
+#     its worker_crash view
+#   - the /metrics scrape parses with >= 5 metric families, and the
+#     fleet counters are monotonic through the worker restart
+#   - zero request errors (the requeue invariant holds under tracing)
+#
+# docs/OBSERVABILITY.md "Fleet tracing" documents the plane; the
+# in-process faces are tests/serving/test_supervisor.py and
+# tests/obs/test_fleet.py.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+timeout -k 10 200 python - <<'EOF'
+import glob, json, os, subprocess, sys, tempfile
+
+out_dir = tempfile.mkdtemp(prefix="keystone-trace-smoke-")
+proc = subprocess.run(
+    [sys.executable, "-m", "keystone_tpu", "trace",
+     "--workers", "2", "--requests", "60", "--kill-request", "7",
+     "--out-dir", out_dir],
+    capture_output=True, text=True, timeout=180,
+)
+assert proc.returncode == 0, proc.stderr[-2000:]
+stats_lines = [l for l in proc.stdout.splitlines()
+               if l.startswith("TRACE_STATS:")]
+assert len(stats_lines) == 1, proc.stdout[-2000:]
+stats = json.loads(stats_lines[0][len("TRACE_STATS:"):])
+
+# ---- sweep health: zero errors even with the seeded kill
+assert stats["errors"] == 0, stats
+assert stats["restarts"] >= 1 and stats["requeued"] >= 1, stats
+
+# ---- merged Perfetto artifact: one trace id across >= 3 processes,
+# with the full ingress → dispatch → worker chain
+merged = json.load(open(stats["trace_path"]))
+events = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+processes = merged["otherData"]["processes"]
+by_trace = {}
+for event in events:
+    trace_id = event["args"].get("trace_id")
+    by_trace.setdefault(trace_id, {"pids": set(), "names": set()})
+    by_trace[trace_id]["pids"].add(event["pid"])
+    by_trace[trace_id]["names"].add(event["name"])
+spanning = {
+    t: info for t, info in by_trace.items() if len(info["pids"]) >= 3
+}
+assert spanning, {t: len(i["pids"]) for t, i in by_trace.items()}
+trace_id, info = next(iter(spanning.items()))
+for name in ("http:apply", "supervisor:dispatch", "worker:request"):
+    assert name in info["names"], (name, sorted(info["names"]))
+roles = {processes[str(pid)] for pid in info["pids"]}
+assert "frontend" in roles and any(r.startswith("worker") for r in roles), roles
+# process tracks are labeled for Perfetto
+meta_roles = {e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("name") == "process_name"}
+assert "frontend" in meta_roles and "worker0" in meta_roles, meta_roles
+
+# ---- flight recorder: the killed worker dumped on the armed fault
+# probe (pre-SIGKILL), the supervisor dumped its worker_crash view
+worker_dumps = glob.glob(os.path.join(out_dir, "flightrec-worker0-*.json"))
+assert worker_dumps, os.listdir(out_dir)
+dump = json.load(open(worker_dumps[0]))
+assert dump["flightrec"] == 1 and dump["trigger"] == "fault_probe", dump["trigger"]
+assert any(e["kind"] == "fault" for e in dump["ledger"]), dump["ledger"]
+front_dumps = glob.glob(os.path.join(out_dir, "flightrec-frontend-*.json"))
+assert front_dumps and json.load(open(front_dumps[0]))["trigger"] == "worker_crash"
+
+# ---- /metrics scrape: parses, >= 5 families, fleet counters monotonic
+# through the restart (mid-sweep scrape vs final scrape)
+prom = open(stats["prom_path"]).read()
+families = [l for l in prom.splitlines() if l.startswith("# HELP")]
+assert len(families) >= 5, len(families)
+assert any(l.startswith("keystone_fleet_requests_total{") for l in prom.splitlines())
+assert stats["fleet_served_final"] >= stats["fleet_served_mid"], stats
+# Near-complete, not exact: counts a worker served between its LAST
+# heartbeat and the SIGKILL are unreportable by construction (the
+# requests themselves were answered — errors == 0 above — only the
+# dead incarnation's final counter delta can be lost).
+assert stats["fleet_served_final"] >= stats["requests"] - 10, stats
+
+print(f"trace_smoke OK: trace id {trace_id} across {len(info['pids'])} "
+      f"processes, {stats['requests']} requests 0 errors, "
+      f"requeued={stats['requeued']} restarts={stats['restarts']}, "
+      f"{len(families)} metric families, fleet served "
+      f"{stats['fleet_served_mid']:.0f}→{stats['fleet_served_final']:.0f}, "
+      f"flight dumps: {stats['flight_dumps']}")
+EOF

@@ -1,0 +1,194 @@
+"""Fleet aggregation (obs/fleet.py): span fragments, the collector's
+merge + incarnation-safe metric folding, and the /metrics exposition."""
+
+import json
+import time
+
+import pytest
+
+from keystone_tpu.obs import spans
+from keystone_tpu.obs.fleet import (
+    FleetTraceCollector,
+    drain_fragments,
+    fleet_prometheus_text,
+    span_fragment,
+)
+
+
+def _fragment(name, trace_id, span_id, parent=None, start=100.0, end=100.01,
+              tid=1, tn="main"):
+    out = {"n": name, "t": trace_id, "s": span_id, "a": start, "b": end,
+           "tid": tid, "tn": tn}
+    if parent:
+        out["p"] = parent
+    return out
+
+
+def test_span_fragment_absolute_times_and_shape():
+    with spans.tracing_session("t") as session:
+        with spans.span("outer", model="m") as outer:
+            with spans.span("inner"):
+                time.sleep(0.01)
+        before, after = session.started_unix, time.time()
+    inner, outer_f = [span_fragment(s, session) for s in session.spans()]
+    assert outer_f["n"] == "outer"
+    assert inner["p"] == outer_f["s"]
+    assert inner["t"] == outer_f["t"] == session.trace_id
+    # absolute unix timestamps inside the session's wall window
+    for f in (inner, outer_f):
+        assert before - 1 <= f["a"] <= f["b"] <= after + 1
+    assert inner["b"] - inner["a"] >= 0.008
+    assert outer_f["at"] == {"model": "m"}
+
+
+def test_drain_fragments_cursor_ships_once_and_bounds():
+    with spans.tracing_session("t") as session:
+        for i in range(10):
+            with spans.span(f"s{i}"):
+                pass
+        frags, cursor = drain_fragments(session, 0, limit=4)
+        assert [f["n"] for f in frags] == ["s0", "s1", "s2", "s3"]
+        frags, cursor = drain_fragments(session, cursor, limit=100)
+        assert [f["n"] for f in frags] == [f"s{i}" for i in range(4, 10)]
+        frags, cursor = drain_fragments(session, cursor)
+        assert frags == [] and cursor == 10
+
+
+def test_collector_merge_spans_processes_single_trace_id():
+    collector = FleetTraceCollector()
+    t = "aaaa0000aaaa0000"
+    collector.add_fragments(
+        "worker0", 101, [_fragment("worker:request", t, "s1", parent="d1")]
+    )
+    collector.add_fragments(
+        "worker1", 102, [_fragment("worker:request", t, "s2", parent="d2")]
+    )
+    with spans.tracing_session("local") as session:
+        with spans.span("http:apply"):
+            pass
+    merged = collector.merge(local_session=session, local_role="frontend")
+    slices = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+    assert {e["pid"] for e in slices} == {101, 102} | {
+        e["pid"] for e in slices if e["name"] == "http:apply"
+    }
+    assert len({e["pid"] for e in slices}) == 3
+    # worker fragments keep their shipped trace id; process tracks named
+    metas = {
+        (e["pid"], e["args"]["name"])
+        for e in merged["traceEvents"] if e["name"] == "process_name"
+    }
+    roles = dict(metas)
+    assert roles[101] == "worker0" and roles[102] == "worker1"
+    assert "frontend" in roles.values()
+    assert t in merged["otherData"]["trace_ids"]
+    # normalized timestamps: everything >= 0
+    assert all(e["ts"] >= 0 for e in slices)
+
+
+def test_collector_clock_skew_published():
+    collector = FleetTraceCollector()
+    collector.observe_clock(
+        "worker0", 101, {"unix": time.time() - 0.5, "perf": 1.0}
+    )
+    anchors = collector.clocks()[("worker0", 101)]
+    assert 0.4 <= anchors["skew_s"] <= 2.0
+
+
+def test_metric_deltas_fold_monotonically_across_incarnations():
+    collector = FleetTraceCollector()
+    collector.observe_metrics("0", 0, {"keystone_serving_requests_total": 5})
+    collector.observe_metrics("0", 0, {"keystone_serving_requests_total": 3})
+    assert collector.metric_totals()["keystone_serving_requests_total"] == 8
+    # incarnation 1: the worker's registry restarted from zero — the
+    # fleet total must NOT dip
+    collector.observe_metrics("0", 1, {"keystone_serving_requests_total": 2})
+    assert collector.metric_totals()["keystone_serving_requests_total"] == 10
+    collector.observe_metrics("1", 0, {"keystone_serving_requests_total": 4})
+    assert collector.metric_totals()["keystone_serving_requests_total"] == 14
+
+
+def test_fragment_retention_bound_drops_oldest():
+    import keystone_tpu.obs.fleet as fleet_mod
+
+    collector = FleetTraceCollector()
+    bound = fleet_mod.MAX_FRAGMENTS_PER_PROCESS
+    batch = [_fragment(f"s{i}", "t0", f"id{i}") for i in range(200)]
+    for _ in range((bound // 200) + 2):
+        collector.add_fragments("worker0", 101, list(batch))
+    kept = collector.fragments()[("worker0", 101)]
+    assert len(kept) == bound
+    assert collector.merge()["otherData"]["dropped_fragments"] > 0
+
+
+class _FakeSupervisor:
+    def fleet_counter_totals(self):
+        return {
+            "0": {"served": 12.0, "failures": 1.0},
+            "1": {"served": 7.0, "failures": 0.0},
+        }
+
+
+def _series_value(text, prefix):
+    for line in text.splitlines():
+        if line.startswith(prefix):
+            return float(line.rsplit(" ", 1)[1])
+    raise AssertionError(f"{prefix} not in exposition")
+
+
+def test_fleet_prometheus_text_counters_and_families():
+    # The exposition publishes into the process-wide registry, so assert
+    # high-water semantics (raised to at least the supervisor's totals,
+    # never dipping) rather than exact values — other tests may have
+    # published these series already.
+    text = fleet_prometheus_text(_FakeSupervisor())
+    assert text.count("# HELP") >= 5
+    served0 = _series_value(text, 'keystone_fleet_requests_total{worker="0"}')
+    served1 = _series_value(text, 'keystone_fleet_requests_total{worker="1"}')
+    failures0 = _series_value(text, 'keystone_fleet_failures_total{worker="0"}')
+    assert served0 >= 12 and served1 >= 7 and failures0 >= 1
+    # monotonic: a second exposition over the same totals never dips
+    text2 = fleet_prometheus_text(_FakeSupervisor())
+    assert _series_value(
+        text2, 'keystone_fleet_requests_total{worker="0"}'
+    ) == served0
+
+
+def test_drain_fragments_cursor_survives_ring_eviction():
+    """A ring session outrunning the heartbeat skips evicted spans —
+    never re-ships, never double-ships, never goes dark."""
+    session = spans.TraceSession("w", max_spans=4, ring=True)
+    spans._session = session
+    try:
+        for i in range(3):
+            with spans.span(f"a{i}"):
+                pass
+        frags, cursor = drain_fragments(session, 0, limit=10)
+        assert [f["n"] for f in frags] == ["a0", "a1", "a2"]
+        # 6 more spans: the ring (cap 4) evicts a0..a4 — two of the
+        # unshipped ones (b0, b1) are lost to eviction
+        for i in range(6):
+            with spans.span(f"b{i}"):
+                pass
+        frags, cursor = drain_fragments(session, cursor, limit=10)
+        assert [f["n"] for f in frags] == ["b2", "b3", "b4", "b5"]
+        frags, cursor = drain_fragments(session, cursor, limit=10)
+        assert frags == []
+    finally:
+        spans._session = None
+
+
+class _FakeCollectorSupervisor(_FakeSupervisor):
+    class fleet:
+        @staticmethod
+        def metric_totals():
+            return {"keystone_serving_retries_total": 7.0}
+
+
+def test_worker_metric_deltas_surface_in_exposition():
+    """The heartbeat-shipped metric deltas are CONSUMED: they surface as
+    the keystone_fleet_worker_series gauge family in /metrics."""
+    text = fleet_prometheus_text(_FakeCollectorSupervisor())
+    assert _series_value(
+        text,
+        'keystone_fleet_worker_series{series="keystone_serving_retries_total"}',
+    ) == 7.0

@@ -1,0 +1,128 @@
+"""Flight recorder (obs/flight.py): ring bounds, the recovery-ledger
+hook, trigger-driven dumps, rate limiting, and the artifact schema."""
+
+import json
+import os
+
+import pytest
+
+from keystone_tpu.obs import flight, spans
+from keystone_tpu.obs.flight import (
+    FlightRecorder,
+    get_flight_recorder,
+    install_flight_recorder,
+    reset_flight_recorder,
+)
+from keystone_tpu.reliability.recovery import get_recovery_log
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    reset_flight_recorder()
+    yield
+    reset_flight_recorder()
+
+
+def test_ledger_hook_rings_and_bounds(tmp_path):
+    recorder = install_flight_recorder(
+        "test", capacity=8, out_dir=str(tmp_path)
+    )
+    assert get_flight_recorder() is recorder
+    # install is idempotent: the first role wins
+    assert install_flight_recorder("other") is recorder
+    # every recovery-ledger record lands in the ring via the hook...
+    for i in range(20):
+        get_recovery_log().record("retry", f"op-{i}", attempt=i)
+    with recorder._lock:
+        ring = list(recorder._ledger)
+    # ...bounded drop-oldest
+    assert len(ring) == 8
+    assert ring[-1]["label"] == "op-19"
+    assert ring[0]["label"] == "op-12"
+    assert all(e["kind"] == "retry" for e in ring)
+    # a benign kind does not dump
+    assert not list(tmp_path.glob("flightrec-*.json"))
+
+
+def test_fault_probe_trigger_dumps_artifact(tmp_path):
+    """An armed fault probe firing IS a trigger: the `fault` ledger event
+    (recorded BEFORE a kill spec SIGKILLs) dumps the post-mortem — this
+    is how a killed worker leaves evidence behind."""
+    from keystone_tpu.reliability import faultinject
+
+    install_flight_recorder("w0", out_dir=str(tmp_path))
+    with spans.tracing_session("t") as session:
+        with spans.span("serving-ish"):
+            pass
+        with faultinject.injected(
+            faultinject.FaultSpec(
+                match="serving.apply", kind="transient", calls=(1,)
+            )
+        ):
+            with pytest.raises(ConnectionError):
+                faultinject.probe("serving.apply")
+    dumps = sorted(tmp_path.glob("flightrec-w0-*.json"))
+    assert len(dumps) == 1
+    artifact = json.loads(dumps[0].read_text())
+    assert artifact["flightrec"] == 1
+    assert artifact["role"] == "w0"
+    assert artifact["pid"] == os.getpid()
+    assert artifact["trigger"] == "fault_probe"
+    assert any(e["kind"] == "fault" for e in artifact["ledger"])
+    # the active session's span tail rides along, fragment-shaped
+    names = {f["n"] for f in artifact["spans"]}
+    assert "serving-ish" in names
+    assert all({"n", "t", "s", "a", "b"} <= set(f) for f in artifact["spans"])
+    # and the registry snapshot is attached
+    assert isinstance(artifact["metrics"], dict)
+
+
+def test_refit_rollback_and_slo_degrade_trigger(tmp_path):
+    recorder = install_flight_recorder(
+        "refit", out_dir=str(tmp_path), min_dump_interval_s=0.0
+    )
+    get_recovery_log().record("refit_rollback", "m", reason="live score")
+    get_recovery_log().record(
+        "slo", "serving-slo", direction="degrade", p99_ms=50.0
+    )
+    # recover direction is NOT a trigger
+    get_recovery_log().record(
+        "slo", "serving-slo", direction="recover", p99_ms=1.0
+    )
+    triggers = [d["trigger"] for d in recorder.dumps]
+    assert triggers == ["refit_rollback", "slo_degrade"]
+
+
+def test_dump_rate_limit_and_force(tmp_path):
+    recorder = FlightRecorder(
+        "r", out_dir=str(tmp_path), min_dump_interval_s=60.0
+    )
+    assert recorder.dump("fault_probe") is not None
+    assert recorder.dump("fault_probe") is None  # inside the interval
+    assert recorder.dump("worker_crash") is not None  # per-trigger limits
+    assert recorder.dump("fault_probe", force=True) is not None
+    assert [d["trigger"] for d in recorder.dumps] == [
+        "fault_probe", "worker_crash", "fault_probe",
+    ]
+
+
+def test_marks_and_metric_snapshots_are_bounded_and_rate_limited(tmp_path):
+    recorder = FlightRecorder(
+        "r", out_dir=str(tmp_path), metrics_interval_s=60.0
+    )
+    for i in range(100):
+        recorder.mark("beat", seq=i)
+    assert recorder.observe_metrics() is True
+    assert recorder.observe_metrics() is False  # rate-limited
+    path = recorder.dump("fault_probe", force=True)
+    artifact = json.loads(open(path).read())
+    assert len(artifact["marks"]) == 64  # mark ring bound
+    assert artifact["marks"][-1]["seq"] == 99
+    assert len(artifact["metric_snapshots"]) == 1
+
+
+def test_hook_is_noop_without_recorder():
+    # No recorder installed: the module hook is a single global read and
+    # the ledger write always succeeds.
+    flight.observe_ledger("fault", "x", {"a": 1})
+    get_recovery_log().record("fault", "y")

@@ -102,3 +102,81 @@ def test_nested_sessions_reuse_outer():
         assert spans.active_session() is a  # inner exit keeps outer installed
     assert spans.active_session() is None
     assert [s.name for s in a.spans()] == ["x"]
+
+
+def test_wire_context_round_trip_and_garbage_tolerance():
+    """The fleet wire format: a context survives to_wire/from_wire, and a
+    malformed wire field degrades to None (drops the link) instead of
+    failing the request that carried it."""
+    assert spans.to_wire(None) is None
+    ctx = ("abcd1234abcd1234", "ffff0000ffff0000")
+    assert spans.from_wire(spans.to_wire(ctx)) == ctx
+    # session-root context (empty span id) survives too
+    root = ("abcd1234abcd1234", "")
+    assert spans.from_wire(spans.to_wire(root)) == root
+    for garbage in (None, 17, "", "no-separator", ":missing-trace", {"t": 1}):
+        assert spans.from_wire(garbage) is None
+
+
+def test_current_context_falls_back_to_attached():
+    """A thread with no open span but an attached remote context hands
+    off the ATTACHED context — the second hop of a cross-process chain
+    (worker pipe thread → server worker thread) must keep the
+    originating trace id, not restart at the local session root."""
+    with spans.tracing_session("t") as session:
+        remote = ("feedface00000000", "0123456789abcdef")
+        with spans.attach(remote):
+            assert spans.current_context() == remote
+            # an OPEN span still wins over the attached context
+            with spans.span("inner") as inner:
+                assert spans.current_context() == (remote[0], inner.span_id)
+        # detached again: back to the session root handoff
+        assert spans.current_context() == (session.trace_id, "")
+
+
+def test_install_session_is_process_lifetime_and_idempotent():
+    session = spans.install_session("proc", sync_timings=False)
+    try:
+        assert spans.active_session() is session
+        assert spans.install_session("other") is session  # idempotent
+        with spans.span("s"):
+            pass
+        assert [s.name for s in session.spans()] == ["s"]
+        # nested context-manager sessions reuse it rather than replacing
+        with spans.tracing_session("nested") as inner:
+            assert inner is session
+        assert spans.active_session() is session
+    finally:
+        # install_session has no uninstall by design (process scope);
+        # tests clear the module global directly.
+        spans._session = None
+
+
+def test_ring_session_evicts_oldest_and_counts():
+    """Process-lifetime (ring) sessions keep the most RECENT spans: a
+    worker hours into its life must ship fresh spans and dump the crash
+    window, not freeze on its first max_spans and go dark."""
+    session = spans.TraceSession("w", max_spans=4, ring=True)
+    spans._session = session
+    try:
+        for i in range(10):
+            with spans.span(f"s{i}"):
+                pass
+    finally:
+        spans._session = None
+    assert [s.name for s in session.spans()] == ["s6", "s7", "s8", "s9"]
+    assert session.added == 10 and session.evicted == 6
+    assert session.dropped == 0  # ring evicts, never drops new spans
+    buffer, total = session.tail()
+    assert total - len(buffer) == 6  # absolute index of buffer[0]
+
+
+def test_unentered_span_context_leaves_no_phantom_on_stack():
+    """span() has no side effects until __enter__: constructing a
+    context manager and never entering it must not corrupt later spans'
+    parentage on this thread."""
+    with spans.tracing_session("t") as session:
+        spans.span("never-entered", a=1)  # constructed, not entered
+        with spans.span("real") as real:
+            assert real.parent_id is None  # roots at the session
+    assert [s.name for s in session.spans()] == ["real"]

@@ -185,3 +185,61 @@ def test_supervised_loop_survives_errors_within_budget(tmp_path):
         assert "refit_daemon_failed" in kinds
     finally:
         server.stop(drain=True)
+
+
+def test_watch_window_thread_inherits_round_trace_context(tmp_path):
+    """Satellite contract: the watch window runs on its OWN thread but
+    must inherit the round's trace context via attach()/current_context()
+    — refit:watch nests under refit:round in the same trace, and the
+    whole tap→fold→shadow→publish→watch round is one span tree."""
+    from keystone_tpu.obs import spans
+
+    server, tap, daemon = _loop(tmp_path)
+    try:
+        x, y = _rows(seed=11)
+        with spans.tracing_session("refit-trace", sync_timings=False) as session:
+            tap.feed(x, y)
+            assert daemon.run_once() == "published"
+        by_name = {}
+        for s in session.spans():
+            by_name.setdefault(s.name, []).append(s)
+        for name in ("refit:round", "refit:fold", "refit:shadow",
+                     "refit:publish", "refit:watch"):
+            assert name in by_name, (name, sorted(by_name))
+        round_span = by_name["refit:round"][0]
+        watch = by_name["refit:watch"][0]
+        # one trace id ties the whole round together...
+        assert {s.trace_id for spans_ in by_name.values() for s in spans_} == {
+            session.trace_id
+        }
+        # ...the phase spans nest under the round...
+        for name in ("refit:fold", "refit:shadow", "refit:publish"):
+            assert by_name[name][0].parent_id == round_span.span_id, name
+        # ...and the watch span does too, from ANOTHER thread (the
+        # attach() handoff, not stack nesting).
+        assert watch.parent_id == round_span.span_id
+        assert watch.thread_name == "keystone-refit-watch"
+        assert watch.thread_id != round_span.thread_id
+        assert watch.attributes.get("outcome") == "published"
+        assert round_span.attributes.get("outcome") == "published"
+    finally:
+        server.stop(drain=True)
+
+
+def test_watch_window_thread_exception_propagates_to_round(tmp_path):
+    """An exception inside the watch thread must re-raise on the round
+    thread (the supervised loop owns the error ledger) — never vanish
+    into a dead thread."""
+    server, tap, daemon = _loop(tmp_path)
+    try:
+        x, y = _rows(seed=12)
+        tap.feed(x, y)
+
+        def boom(*a, **k):
+            raise RuntimeError("watch exploded")
+
+        daemon._watch_inner = boom
+        with pytest.raises(RuntimeError, match="watch exploded"):
+            daemon.run_once()
+    finally:
+        server.stop(drain=True)

@@ -260,3 +260,58 @@ def test_parse_listen():
     assert parse_listen("9000") == ("127.0.0.1", 9000)
     with pytest.raises(ValueError):
         parse_listen("localhost")
+
+
+def _get_text(front, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://{front.host}:{front.port}{path}", timeout=timeout
+    ) as response:
+        return response.status, response.headers.get("Content-Type", ""), \
+            response.read().decode()
+
+
+def test_metrics_route_prometheus_exposition(frontend):
+    """GET /metrics: text exposition (not JSON), the full pre-registered
+    schema (>= 5 families even on a fresh process), serving and fleet
+    families present."""
+    front, _ = frontend
+    code, content_type, text = _get_text(front, "/metrics")
+    assert code == 200
+    assert content_type.startswith("text/plain")
+    assert text.count("# HELP") >= 5
+    for family in (
+        "keystone_serving_workers_alive",
+        "keystone_fleet_requests_total",
+        "keystone_flight_dumps_total",
+    ):
+        assert f"# TYPE {family}" in text, family
+
+
+def test_metrics_route_aggregates_supervisor_counters(frontend):
+    """A supervisor exposing fleet_counter_totals gets its per-worker
+    lifetime counters published as keystone_fleet_* series."""
+    front, supervisor = frontend
+    supervisor.fleet_counter_totals = lambda: {
+        "0": {"served": 1e9, "failures": 3.0}
+    }
+    _, _, text = _get_text(front, "/metrics")
+    line = next(
+        l for l in text.splitlines()
+        if l.startswith('keystone_fleet_requests_total{worker="0"}')
+    )
+    assert float(line.rsplit(" ", 1)[1]) >= 1e9
+
+
+def test_ingress_span_opens_per_apply(frontend):
+    """The http:apply ingress span is the trace root the supervisor's
+    dispatch (and the workers, cross-process) re-parent under."""
+    from keystone_tpu.obs import spans
+
+    front, supervisor = frontend
+    with spans.tracing_session("http", sync_timings=False) as session:
+        code, _ = _post(front, "/v1/apply", {"x": [1.0]})
+        assert code == 200
+    ingress = [s for s in session.spans() if s.name == "http:apply"]
+    assert len(ingress) == 1
+    assert ingress[0].trace_id == session.trace_id
+    assert ingress[0].attributes.get("http_status") == 200

@@ -347,3 +347,125 @@ def test_admission_sheds_at_capacity():
         settle(futures)  # admitted requests all complete
     finally:
         sup.stop()
+
+
+# ------------------------------------------- restart-monotonic aggregation
+
+
+def test_restart_keeps_aggregated_counters_monotonic():
+    """The satellite fix: a restarted worker's telemetry counters restart
+    from zero, but stats() aggregates per-worker high-water marks — the
+    fleet's `served` is LIFETIME and never resets across incarnations."""
+    sup = make_supervisor(
+        workers=1,
+        chaos={"0": [{"match": "serving.worker.request", "kind": "kill",
+                      "calls": [6]}]},
+    ).start()
+    try:
+        sup.wait_ready()
+        settle([sup.submit([float(i)], deadline_s=30) for i in range(5)])
+        time.sleep(0.3)  # beats carry served=5 into the high-water mark
+        before = sup.stats()
+        assert before["served"] == 5
+        # Request 6 kills the worker pre-completion; it requeues onto the
+        # restarted incarnation, whose own counters restart from zero.
+        settle([sup.submit([float(i)], deadline_s=30) for i in range(5, 10)])
+        time.sleep(0.3)
+        after = sup.stats()
+        assert after["workers"]["0"]["incarnation"] >= 1
+        # incarnation-local counter really did reset...
+        assert after["workers"]["0"]["stats"]["served"] < 10
+        # ...but the aggregate is lifetime: 5 before the kill + 5 after.
+        assert after["served"] == 10
+        # fleet_counter_totals (the /metrics source) agrees
+        assert sup.fleet_counter_totals()["0"]["served"] == 10.0
+    finally:
+        sup.stop()
+
+
+# --------------------------------------------------- cross-process tracing
+
+
+def test_trace_context_crosses_the_pipe_and_fragments_return():
+    """Fleet tracing end to end over stub workers: the submit-time trace
+    context rides every dispatch line, the worker re-parents its spans
+    under it, and the fragments come back on heartbeats — the merged
+    trace shows ONE trace id across supervisor + both worker processes."""
+    from keystone_tpu.obs import spans
+
+    with spans.tracing_session("sup-trace", sync_timings=False) as session:
+        sup = WorkerSupervisor(
+            {"stub": {}},
+            SupervisorConfig(
+                workers=2, heartbeat_s=0.05, hang_timeout_s=5.0,
+                ready_timeout_s=15.0, monitor_interval_s=0.02,
+            ),
+            env={"KEYSTONE_FLEET_TRACE": "1"},
+        ).start()
+        try:
+            sup.wait_ready()
+            with spans.span("ingress"):
+                settle([sup.submit([1.0, float(i)]) for i in range(12)])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                fragments = sup.fleet.fragments()
+                worker_requests = [
+                    f for frags in fragments.values() for f in frags
+                    if f["n"] == "worker:request"
+                ]
+                if len(worker_requests) >= 12 and len(fragments) >= 2:
+                    break
+                time.sleep(0.05)
+            merged = sup.fleet.merge(local_session=session)
+        finally:
+            sup.stop()
+
+    # supervisor-side dispatch spans parent under the ingress span
+    dispatches = [s for s in session.spans() if s.name == "supervisor:dispatch"]
+    ingress = next(s for s in session.spans() if s.name == "ingress")
+    assert len(dispatches) == 12
+    assert all(s.trace_id == session.trace_id for s in dispatches)
+    assert all(s.parent_id == ingress.span_id for s in dispatches)
+    # worker fragments carry the SAME trace id, parented under a dispatch
+    dispatch_ids = {s.span_id for s in dispatches}
+    assert len(worker_requests) >= 12
+    assert all(f["t"] == session.trace_id for f in worker_requests)
+    assert all(f.get("p") in dispatch_ids for f in worker_requests)
+    # both worker processes shipped, and the merged Perfetto artifact has
+    # the single trace id across >= 3 pids (supervisor + 2 workers)
+    assert len(fragments) >= 2
+    pids = {
+        e["pid"] for e in merged["traceEvents"]
+        if e.get("ph") == "X" and e["args"].get("trace_id") == session.trace_id
+    }
+    assert len(pids) >= 3
+    assert session.trace_id in merged["otherData"]["trace_ids"]
+    # clock anchors arrived via the ready/heartbeat handshake
+    assert merged["otherData"]["clock_skew_s"]
+
+
+def test_tracing_off_adds_no_wire_field():
+    """With no session, submit captures no context and the control line
+    carries no trace field — tracing off is zero wire bytes."""
+    captured = []
+    sup = make_supervisor(workers=1).start()
+    try:
+        sup.wait_ready()
+        worker = sup._workers["0"]
+        real_stdin = worker.proc.stdin
+
+        class _Spy:
+            def write(self, line):
+                captured.append(line)
+                return real_stdin.write(line)
+
+            def flush(self):
+                return real_stdin.flush()
+
+        worker.proc.stdin = _Spy()
+        settle([sup.submit([1.0])])
+        worker.proc.stdin = real_stdin
+        requests = [json.loads(l) for l in captured if l.strip()]
+        assert requests and all("trace" not in r for r in requests)
+    finally:
+        sup.stop()
