@@ -1890,12 +1890,15 @@ def _leg_obs_before() -> dict:
 
     from keystone_tpu.obs import device as obs_device
 
+    from keystone_tpu.obs import cost as obs_cost
+
     session = obs_spans.active_session()
     return {
         "metrics": obs_metrics.get_registry().snapshot(),
         "compiles": compile_count(),
         "bytes_in_use": obs_device.memory_snapshot()["bytes_in_use"],
         "span_cursor": len(session) if session is not None else 0,
+        "ledger_cursor": obs_cost.get_ledger().cursor(),
     }
 
 
@@ -1930,6 +1933,21 @@ def _leg_obs_snapshot(before: dict) -> dict:
         trace_bytes = sum(
             len(json.dumps(obs_fleet.span_fragment(s, session))) for s in fresh
         )
+    # Cost-observatory window (docs/OBSERVABILITY.md "Cost observatory"):
+    # flop/byte totals and roofline split for the nodes this leg
+    # executed, plus the harvest-compile invariant (must stay 0 — cost
+    # analysis rides the jit trace cache). Zeros when the observatory is
+    # off (the default — enable with KEYSTONE_COST_OBS=1): harvesting
+    # re-traces chain/step programs whose trace-time side effects the
+    # exact-gated compile counts in these legs were pinned against.
+    from keystone_tpu.obs import cost as obs_cost
+
+    ledger = obs_cost.get_ledger().summary(
+        since=before.get("ledger_cursor", 0)
+    )
+    harvest_compiles = int(
+        moved.get("keystone_cost_harvest_compiles_total", 0)
+    )
     return {
         "xla_compiles": compile_count() - before["compiles"],
         # peak_bytes_in_use never resets between legs, so it is the
@@ -1940,6 +1958,15 @@ def _leg_obs_snapshot(before: dict) -> dict:
         "memory_source": mem["source"],
         "span_count": span_count,
         "trace_bytes": trace_bytes,
+        "cost": {
+            "enabled": obs_cost.cost_observatory_enabled(),
+            "ledger_nodes": ledger["nodes"],
+            "ledger_flops": ledger["flops"],
+            "ledger_bytes_accessed": ledger["bytes_accessed"],
+            "roofline": ledger["roofline"],
+            "drift_events": ledger["drift"],
+        },
+        "cost_harvest_compiles": harvest_compiles,
         "metrics_delta": moved,
     }
 

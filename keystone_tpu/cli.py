@@ -125,6 +125,47 @@ def add_refit_arguments(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def add_explain_arguments(parser: argparse.ArgumentParser) -> None:
+    """Flags for ``keystone-tpu explain`` — wired here (stdlib-only) so
+    --help/--list never import the workflow package (whose __init__
+    imports jax); ``workflow.explain.explain_from_args`` consumes the
+    parsed namespace at dispatch time."""
+    parser.add_argument(
+        "--pipeline", default="synthetic", metavar="PATH|synthetic",
+        help="FittedPipeline.save artifact to explain, or 'synthetic' "
+        "(featurize chain + block solve under the auto-cache optimizer)",
+    )
+    parser.add_argument(
+        "--rows", type=int, default=2048,
+        help="synthetic training rows (fit cost scales with this)",
+    )
+    parser.add_argument(
+        "--dim", type=int, default=64,
+        help="feature width: the synthetic pipeline's, or — for "
+        "--pipeline PATH — the loaded artifact's expected input width "
+        "(the eval batch is built at this width)",
+    )
+    parser.add_argument(
+        "--classes", type=int, default=4, help="synthetic label width",
+    )
+    parser.add_argument(
+        "--passes", type=int, default=3,
+        help="plan executions: pass 1 pays compiles (cold, never "
+        "drift-scored), later passes measure steady state",
+    )
+    parser.add_argument(
+        "--seed-drift", type=float, default=0.0, metavar="FACTOR",
+        help="corrupt stored autocache measurements by FACTOR× before "
+        "running (CI negative control: the drift sentinel must flag it)",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", default=None, help="write report JSON here")
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="print EXPLAIN_JSON: line instead of the human table",
+    )
+
+
 def add_tune_arguments(parser: argparse.ArgumentParser) -> None:
     """Flags for ``keystone-tpu tune`` — wired here (stdlib-only) so the
     CLI's --help/--list paths never import the workflow package (whose
@@ -358,6 +399,17 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_check_arguments(check_parser)
 
+    # Cost observatory (docs/OBSERVABILITY.md "Cost observatory"): run a
+    # plan under per-node roofline attribution and the predicted-vs-
+    # measured drift sentinel — the "why is this pipeline slow" report.
+    # Stdlib-only flag wiring, same rule as tune.
+    explain_parser = sub.add_parser(
+        "explain",
+        help="cost observatory: per-node predicted vs measured cost, "
+        "roofline placement, decision provenance, drift sentinel",
+    )
+    add_explain_arguments(explain_parser)
+
     # Offline autotuner (docs/AUTOTUNING.md): budgeted measured search
     # over the plan-knob space, winners persisted to the profile store
     # under the keys MeasuredKnobRule replays. Flag wiring lives HERE,
@@ -403,6 +455,10 @@ def main(argv: Optional[list] = None) -> int:
             "analysis + plan-time graph verification"
         )
         print(
+            f"{'explain':28s} cost observatory: predicted vs measured "
+            "per node, roofline placement, drift sentinel"
+        )
+        print(
             f"{'tune':28s} offline autotuner: measured knob search → "
             "profile-store winners"
         )
@@ -441,6 +497,13 @@ def main(argv: Optional[list] = None) -> int:
         from .lint.check import check_from_args
 
         return check_from_args(args)
+
+    if args.workload == "explain":
+        from .utils.compilation_cache import enable_persistent_cache
+        from .workflow.explain import explain_from_args
+
+        enable_persistent_cache()  # later passes/runs measure steady state
+        return explain_from_args(args)
 
     if args.workload == "tune":
         from .utils.compilation_cache import enable_persistent_cache

@@ -144,12 +144,27 @@ def check_from_args(args: argparse.Namespace) -> int:
             out["store"] = {"enabled": False}
             human.append("store: disabled (KEYSTONE_PROFILE_STORE=off)")
         else:
+            from ..obs.store import is_stale
+
             by_source = store.by_source()
             tuned_keys = sorted(
                 {
                     key
-                    for key, _shape, m in store.entries(any_env=True)
+                    for key, _shape, m in store.entries(
+                        any_env=True, include_stale=True
+                    )
                     if m.get("source") == "tune"
+                }
+            )
+            # Drift-marked entries (obs/cost.py sentinel): still stored
+            # for post-hoc inspection, no longer replayed by any rule.
+            stale_keys = sorted(
+                {
+                    key
+                    for key, _shape, m in store.entries(
+                        any_env=True, include_stale=True
+                    )
+                    if is_stale(m)
                 }
             )
             out["store"] = {
@@ -157,12 +172,15 @@ def check_from_args(args: argparse.Namespace) -> int:
                 **store.stats(),
                 "by_source": by_source,
                 "tuned_keys": tuned_keys,
+                "stale_keys": stale_keys,
             }
             human.append(
                 f"store[{store.path}]: {len(store)} entries, by source "
-                f"{by_source or '{}'}, {len(tuned_keys)} tuned keys"
+                f"{by_source or '{}'}, {len(tuned_keys)} tuned keys, "
+                f"{len(stale_keys)} stale"
             )
             human += ["  tuned: " + k for k in tuned_keys[:20]]
+            human += ["  stale: " + k for k in stale_keys[:20]]
 
     if args.lint is not None:
         import keystone_tpu

@@ -28,6 +28,11 @@ KV505     buffer donation (``donate_argnums``/``donate_argnames``) must
           carry a ``# keystone: owns-donated`` annotation asserting the
           donated buffers are owned copies — donating a caller-visible
           array deletes it out from under the caller.
+KV506     ``cost_analysis()`` is called only inside ``obs/cost.py`` —
+          its return shape differs per backend (None / list / dict with
+          missing keys) and an unguarded call site is a latent crash on
+          the next backend; the observatory's harvest helpers guard it
+          exactly once (docs/OBSERVABILITY.md "Cost observatory").
 ========  ============================================================
 
 Rules are pure ``ast`` + source-line checks (stdlib only, nothing is
@@ -83,7 +88,11 @@ LINT_CODES: Dict[str, str] = {
     "KV503": "metric name not declared in obs/names.py",
     "KV504": "probe site not registered in KNOWN_PROBE_SITES",
     "KV505": "buffer donation without ownership annotation",
+    "KV506": "cost_analysis() harvested outside obs/cost.py",
 }
+
+#: The one module allowed to call ``cost_analysis()`` (KV506).
+COST_ANALYSIS_HOME = os.path.join("obs", "cost.py")
 
 
 class Finding(Diagnostic):
@@ -451,12 +460,39 @@ def _check_donation(
             )
 
 
+def _check_cost_analysis(
+    tree: ast.Module, lines: Sequence[str], path: str, ctx: LintContext
+) -> Iterable[Finding]:
+    if path.endswith(COST_ANALYSIS_HOME):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else None
+        )
+        if name != "cost_analysis":
+            continue
+        yield Finding(
+            "KV506",
+            path,
+            node.lineno,
+            "`cost_analysis()` called outside obs/cost.py — its return "
+            "shape differs per backend (None / list / partial dict); go "
+            "through obs.cost.harvest_cost_facts so the guarding and the "
+            "zero-extra-compiles invariant live exactly once",
+        )
+
+
 RULES = (
     _check_env_reads,
     _check_host_sync,
     _check_metric_names,
     _check_probe_sites,
     _check_donation,
+    _check_cost_analysis,
 )
 
 

@@ -68,6 +68,10 @@ _EXACT_SUBSTRINGS = (
     # drifting workload publishes, skips, and rolls back EXACTLY the
     # same rounds every run — a changed count is a changed loop.
     "publishes", "rollbacks", "skips",
+    # Cost-observatory invariant (docs/OBSERVABILITY.md "Cost
+    # observatory"): harvesting rides the jit trace cache and must
+    # compile NOTHING — any nonzero count is a broken harvest path.
+    "harvest_compiles",
 )
 _SKIP_SUBSTRINGS = (
     # Environment-dependent measurements no two runs share: compile

@@ -39,6 +39,49 @@ def _json_safe(value: Any) -> Any:
 _STREAM_UPLOAD_TID = 900001
 _STREAM_COMPUTE_TID = 900002
 
+# Synthetic track for the cost observatory's per-node counters.
+_COST_LEDGER_TID = 900003
+
+
+def cost_ledger_events(
+    entries: Any, base_s: float, pid: int
+) -> List[Dict[str, Any]]:
+    """Perf-ledger entries (obs/cost.py) as Chrome ``ph:C`` counter
+    events on a ``cost-ledger`` track: achieved GFLOP/s, GB/s, and
+    measured-vs-predicted ratio sampled at each node's finalize time —
+    roofline placement over the session timeline, next to the node spans
+    that produced it. ``base_s`` is the session's perf_counter origin
+    (entries carry their own ``t_s`` anchor)."""
+    events: List[Dict[str, Any]] = []
+    for entry in entries or []:
+        ts = round((getattr(entry, "t_s", 0.0) - base_s) * 1e6, 3)
+        args: Dict[str, Any] = {}
+        if getattr(entry, "flops_per_s", None):
+            args["gflops_per_s"] = round(entry.flops_per_s / 1e9, 4)
+        if getattr(entry, "bytes_per_s", None):
+            args["gbytes_per_s"] = round(entry.bytes_per_s / 1e9, 4)
+        if getattr(entry, "ratio", None) is not None:
+            args["measured_vs_predicted"] = round(entry.ratio, 4)
+        if not args:
+            continue
+        events.append(
+            {
+                "name": "cost-ledger",
+                "cat": "cost",
+                "ph": "C",
+                "ts": ts,
+                "pid": pid,
+                "tid": _COST_LEDGER_TID,
+                "args": args,
+            }
+        )
+    if events:
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid,
+             "tid": _COST_LEDGER_TID, "args": {"name": "cost-ledger"}}
+        )
+    return events
+
 
 def stream_report_events(
     report: Any, base_s: float, pid: int
@@ -96,11 +139,15 @@ def stream_report_events(
 
 
 def chrome_trace(
-    session: TraceSession, stream_report: Any = None
+    session: TraceSession,
+    stream_report: Any = None,
+    cost_ledger: Any = None,
 ) -> Dict[str, Any]:
     """The session's spans as a Chrome trace-event JSON object; pass the
     last :class:`~keystone_tpu.workflow.streaming.StreamReport` to also
-    emit its per-chunk upload/compute slices (:func:`stream_report_events`)."""
+    emit its per-chunk upload/compute slices (:func:`stream_report_events`),
+    and a list of perf-ledger entries (``obs.cost.get_ledger().tail(n)``)
+    for the ``cost-ledger`` counter track (:func:`cost_ledger_events`)."""
     import os
 
     pid = os.getpid()
@@ -155,6 +202,7 @@ def chrome_trace(
             }
         )
     events.extend(stream_report_events(stream_report, session.started_s, pid))
+    events.extend(cost_ledger_events(cost_ledger, session.started_s, pid))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -168,10 +216,18 @@ def chrome_trace(
 
 
 def write_chrome_trace(
-    session: TraceSession, path: str, stream_report: Any = None
+    session: TraceSession,
+    path: str,
+    stream_report: Any = None,
+    cost_ledger: Any = None,
 ) -> str:
     with open(path, "w") as f:
-        json.dump(chrome_trace(session, stream_report=stream_report), f)
+        json.dump(
+            chrome_trace(
+                session, stream_report=stream_report, cost_ledger=cost_ledger
+            ),
+            f,
+        )
     return path
 
 

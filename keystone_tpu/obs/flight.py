@@ -187,6 +187,18 @@ class FlightRecorder:
                 for s in session.spans()[-self.capacity:]
             ]
             dropped = session.dropped
+        perf_ledger: List[Dict[str, Any]] = []
+        try:
+            # The cost observatory's recent per-node entries: a crash
+            # snapshot carries the perf picture (predicted vs measured,
+            # roofline placement) alongside the events that explain it.
+            from . import cost as _cost
+
+            perf_ledger = [
+                e.to_json() for e in _cost.get_ledger().tail(32)
+            ]
+        except Exception:
+            pass
         payload = {
             "flightrec": 1,
             "role": self.role,
@@ -196,6 +208,7 @@ class FlightRecorder:
             "detail": _json_safe_detail(detail or {}),
             "spans": span_tail,
             "ledger": ledger,
+            "perf_ledger": perf_ledger,
             "metric_snapshots": metric_ring,
             "metrics": get_registry().snapshot(),
             "marks": marks,

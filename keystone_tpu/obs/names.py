@@ -146,6 +146,13 @@ FLIGHT_RECORDS = "keystone_flight_records_total"
 FLIGHT_DUMPS = "keystone_flight_dumps_total"
 FLIGHT_DUMP_BYTES = "keystone_flight_dump_bytes"
 
+# ------------------------------------------------------------ cost observatory
+COST_LEDGER_ENTRIES = "keystone_cost_ledger_entries_total"
+COST_DRIFT_EVENTS = "keystone_cost_drift_events_total"
+COST_DRIFT_RATIO = "keystone_cost_drift_ratio"
+COST_HARVEST_COMPILES = "keystone_cost_harvest_compiles_total"
+COST_ROOFLINE_PEAK = "keystone_cost_roofline_peak"
+
 # ---------------------------------------------------------------------- memory
 MEMORY_IN_USE_BYTES = "keystone_memory_in_use_bytes"
 PEAK_MEMORY_BYTES = "keystone_peak_memory_bytes"
@@ -242,6 +249,11 @@ SCHEMA: Dict[str, Tuple] = {
     FLEET_REQUESTS: ("counter", "Fleet-aggregated requests served per worker id, monotonic across worker incarnations", ("worker",)),
     FLEET_FAILURES: ("counter", "Fleet-aggregated failed requests per worker id, monotonic across worker incarnations", ("worker",)),
     FLEET_WORKER_SERIES: ("gauge", "Fleet-summed worker-process registry series (heartbeat metric deltas, folded across incarnations), keyed by flat series name", ("series",)),
+    COST_LEDGER_ENTRIES: ("counter", "Perf-ledger entries recorded by the cost observatory, by roofline classification", ("roofline",)),
+    COST_DRIFT_EVENTS: ("counter", "Sustained cost-model drift events fired by the drift sentinel, by model", ("model",)),
+    COST_DRIFT_RATIO: ("gauge", "Latest measured-vs-predicted cost ratio observed per model (>1 = slower than predicted)", ("model",)),
+    COST_HARVEST_COMPILES: ("counter", "Backend compiles triggered by cost harvesting — must stay 0 (harvest rides the jit trace cache)", ()),
+    COST_ROOFLINE_PEAK: ("gauge", "Probe-calibrated roofline peaks for this process's backend, by resource (flops_per_s/bytes_per_s)", ("resource",)),
     FLIGHT_RECORDS: ("counter", "Entries appended to the flight-recorder ring buffers, by kind (ledger/metrics/mark)", ("kind",)),
     FLIGHT_DUMPS: ("counter", "Flight-recorder dump artifacts written, by trigger", ("trigger",)),
     FLIGHT_DUMP_BYTES: ("gauge", "Size of the last flight-recorder dump artifact written by this process", ()),

@@ -122,6 +122,14 @@ def run_profile(
     if annotations:
         device.set_device_annotations(True)
     os.makedirs(out_dir, exist_ok=True)
+    # The profile harness is an analysis run: the cost observatory rides
+    # along (per-node flop/byte facts + the cost-ledger counter track in
+    # the exported trace), restored to the prior override afterwards.
+    # Flipped inside the try below so no exception path can leak the
+    # forced-on observatory process-wide.
+    from . import cost as _cost
+
+    cost_override_before = _cost._enabled_override
 
     registry = metrics.get_registry()
     before = registry.snapshot()
@@ -148,6 +156,7 @@ def run_profile(
     env = PipelineEnv.get_or_create()
     optimizer_before = env._optimizer  # restore below: run_profile is a
     try:                               # library API, not a process owner
+        _cost.set_cost_observatory(True)
         with spans.tracing_session("profile") as session:
             with spans.span("profile", rows=rows):
                 if autocache:
@@ -173,6 +182,7 @@ def run_profile(
     finally:
         env._optimizer = optimizer_before
         device.set_device_annotations(annotations_before)
+        _cost.set_cost_observatory(cost_override_before)
 
     if store is not None:
         store.record(
@@ -188,6 +198,7 @@ def run_profile(
     trace_path = export.write_chrome_trace(
         session, os.path.join(out_dir, "profile_trace.json"),
         stream_report=last_stream_report(),
+        cost_ledger=_cost.get_ledger().tail(_cost.get_ledger().capacity),
     )
     prom_path = export.write_prometheus(
         os.path.join(out_dir, "profile_metrics.prom"), registry

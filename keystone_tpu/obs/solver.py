@@ -52,3 +52,23 @@ def rung_span(solver: str, rung: Any, index: int) -> Iterator[None]:
 def count_iteration(solver: str, n: int = 1, **attributes: Any) -> None:
     names.metric(names.SOLVER_ITERATIONS).inc(n, solver=solver)
     spans.add_span_event("solver:step", solver=solver, **attributes)
+
+
+def predicted_attrs(estimator: Any) -> dict:
+    """Span attributes for the cost prediction pinned on an estimator
+    (``predicted_cost``, an :class:`~keystone_tpu.obs.cost.Prediction`
+    from the solver ladder's argmin or MeasuredKnobRule's winner) — the
+    cost-observatory join surface on ``solver:fit`` spans: a solver span
+    in any trace names the model/key that predicted it, next to the wall
+    it actually took (docs/OBSERVABILITY.md "Cost observatory")."""
+    prediction = getattr(estimator, "predicted_cost", None)
+    if prediction is None:
+        return {}
+    out: dict = {"predicted_model": prediction.model}
+    if getattr(prediction, "seconds", None) is not None:
+        out["predicted_cost_ms"] = round(prediction.seconds * 1e3, 3)
+    if getattr(prediction, "rows_per_s", None):
+        out["predicted_rows_per_s"] = round(prediction.rows_per_s, 1)
+    if getattr(prediction, "key", ""):
+        out["predicted_key"] = prediction.key
+    return out

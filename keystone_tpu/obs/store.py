@@ -80,6 +80,19 @@ _DEFAULT_MAX_ENTRIES = 4096
 # loaded + slack without paying a rewrite per observation.
 _COMPACT_SLACK = 256
 
+#: Provenance prefix the cost-observatory drift sentinel writes onto an
+#: entry whose predictions stopped matching reality (obs/cost.py,
+#: docs/OBSERVABILITY.md "Cost observatory"). A ``stale:`` entry is
+#: skipped by ``lookup``/``entries`` (counted as a miss) so consumers —
+#: AutoCacheRule's warm start, MeasuredKnobRule's winners — re-measure
+#: instead of replaying it; the fresh measurement's ``record()``
+#: overwrites the mark.
+STALE_PREFIX = "stale:"
+
+
+def is_stale(measurements: Dict[str, Any]) -> bool:
+    return str(measurements.get("source", "")).startswith(STALE_PREFIX)
+
 
 # ------------------------------------------------------------- shape classes
 
@@ -382,13 +395,45 @@ class ProfileStore:
         except Exception as e:
             logger.warning("profile store compaction failed (%s)", e)
 
+    # --------------------------------------------------------------- staleness
+    def mark_stale(
+        self,
+        key: str,
+        shape: str,
+        backend: Optional[str] = None,
+        reason: str = "cost_drift",
+    ) -> bool:
+        """Stamp ``stale:`` provenance onto an entry the drift sentinel
+        caught mis-predicting: the measurements survive for post-hoc
+        inspection (``check --store`` shows ``stale:<source>``), but
+        ``lookup``/``entries`` stop serving them, so the consumer rules
+        re-measure. Returns True when an entry was newly marked."""
+        backend = backend or self.fingerprint()["backend"]
+        with self._lock:
+            rec = self._entries.get((key, shape, backend))
+        if rec is None:
+            return False
+        m = dict(rec.get("m", {}))
+        if is_stale(m):
+            return False  # already marked; one drift = one mark
+        m["source"] = STALE_PREFIX + str(m.get("source", "observed"))
+        m["stale_reason"] = reason
+        self.record(key, shape, backend, **m)
+        return True
+
     # ---------------------------------------------------------------- reads
     def lookup(
-        self, key: str, shape: str, backend: Optional[str] = None
+        self,
+        key: str,
+        shape: str,
+        backend: Optional[str] = None,
+        include_stale: bool = False,
     ) -> Optional[Dict[str, Any]]:
         """The newest valid measurements dict for (key, shape, backend),
         or None. Entries whose environment fingerprint no longer matches
-        are invalidated (counted), never returned."""
+        are invalidated (counted), never returned; ``stale:``-marked
+        entries read as misses (the drift sentinel's contract: consumers
+        must re-measure, not replay) unless ``include_stale``."""
         backend = backend or self.fingerprint()["backend"]
         fingerprint = self.fingerprint()
         # One critical section covers the fetch AND its stat counter:
@@ -405,6 +450,9 @@ class ProfileStore:
                 self.invalidations += 1
                 self.misses += 1
                 outcome = "invalidated"
+            elif not include_stale and is_stale(rec.get("m", {})):
+                self.misses += 1
+                outcome = "miss"
             else:
                 self.hits += 1
                 outcome = "hit"
@@ -426,14 +474,18 @@ class ProfileStore:
         rows: Optional[str] = None,
         backend: Optional[str] = None,
         any_env: bool = False,
+        include_stale: bool = False,
     ) -> Iterator[Tuple[str, str, Dict[str, Any]]]:
         """Iterate valid (key, shape, measurements) tuples filtered by key
         prefix, exact shape class, or coarse rows bucket — the knob rule's
         query surface. Fingerprint-stale entries are skipped silently
-        (invalidation is counted at lookup, the authoritative read).
-        ``any_env=True`` skips the fingerprint/backend filter — for
-        provenance REPORTING only (``check --store`` runs jax-free and
-        must still see what a tuned process wrote), never for replay."""
+        (invalidation is counted at lookup, the authoritative read), and
+        drift-marked ``stale:`` entries are skipped unless
+        ``include_stale`` (provenance reporting wants them; replay never
+        does). ``any_env=True`` skips the fingerprint/backend filter —
+        for provenance REPORTING only (``check --store`` runs jax-free
+        and must still see what a tuned process wrote), never for
+        replay."""
         if not any_env:
             backend = backend or self.fingerprint()["backend"]
             fp = self.fingerprint()
@@ -443,6 +495,8 @@ class ProfileStore:
             if not any_env and (
                 str(rec.get("b", "")) != backend or rec.get("fp") != fp
             ):
+                continue
+            if not include_stale and is_stale(rec.get("m", {})):
                 continue
             if key_prefix and not rec["k"].startswith(key_prefix):
                 continue

@@ -155,7 +155,10 @@ class BlockLeastSquaresEstimator(GramStreamStateMixin, LabelEstimator):
         import time as _time
 
         t_fit = _time.perf_counter()
-        with solver_obs.fit_span("block_ls_stream", epochs=self.num_iter):
+        with solver_obs.fit_span(
+            "block_ls_stream", epochs=self.num_iter,
+            **solver_obs.predicted_attrs(self),
+        ):
             carry, info = stream.fold(init, linalg.gram_stream_step)
             n = info["num_examples"] + (state.num_examples if state else 0)
             self._capture_state(
@@ -274,7 +277,8 @@ class BlockLeastSquaresEstimator(GramStreamStateMixin, LabelEstimator):
 
         t_fit = _time.perf_counter()
         with solver_obs.fit_span(
-            "block_ls", d=d, epochs=self.num_iter, streaming=stream
+            "block_ls", d=d, epochs=self.num_iter, streaming=stream,
+            **solver_obs.predicted_attrs(self),
         ):
             model = ladder.run(attempt)
         if ladder.reduced:

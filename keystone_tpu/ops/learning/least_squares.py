@@ -190,7 +190,9 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         import time as _time
 
         t_fit = _time.perf_counter()
-        with solver_obs.fit_span("least_squares"):
+        with solver_obs.fit_span(
+            "least_squares", **solver_obs.predicted_attrs(self)
+        ):
             model = ladder.run(attempt)
         # Meta-solver observation: the rung that finally held and what it
         # cost, keyed per shape class — the profile store's record of
@@ -265,7 +267,23 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
                 LinearMapEstimator(reg=self.reg),
             ),
         ]
-        return min(candidates, key=lambda c: c[0])[1]
+        cost_ms, chosen = min(candidates, key=lambda c: c[0])
+        # Cost-observatory provenance (obs/cost.py): the rung's predicted
+        # cost rides the chosen estimator into the perf ledger and the
+        # solver:fit span. The ladder's constants are RELATIVE (only the
+        # argmin matters; the reference fit them on its own cluster), so
+        # the prediction is displayed but never drift-scored
+        # (calibrated=False).
+        from ...obs.cost import Prediction
+
+        chosen.predicted_cost = Prediction(
+            model="solver_ladder",
+            key=f"solver:ladder:{type(chosen).__name__}",
+            shape=f"n{n}|{d}|k{k}",
+            seconds=float(cost_ms) / 1e3,
+            calibrated=False,
+        )
+        return chosen
 
 
 def _stream_width(stream, default: int) -> int:

@@ -197,7 +197,14 @@ def mode_jit(fn=None, **jit_kwargs):
             mode = solver_mode()
             if mode not in jitted:
                 jitted[mode] = jax.jit(fresh_callable(), **jit_kwargs)
-            return jitted[mode](*args, **kwargs)
+            jf = jitted[mode]
+            if not kwargs:
+                # Cost-observatory attribution (obs/cost.py): one
+                # thread-local read when no harvest frame is active.
+                from ..obs import cost as _cost
+
+                _cost.note_solver_call(f.__name__, jf, args)
+            return jf(*args, **kwargs)
 
         return wrapper
 
@@ -680,7 +687,13 @@ def block_coordinate_descent(
     if donate_xy:
         _quiet_unused_donation_warnings()
     fn = _bcd_fn(mesh, num_epochs, block_size, bool(donate_xy))
-    return fn(a, y, jnp.asarray(reg, dtype=a.dtype))
+    reg_arr = jnp.asarray(reg, dtype=a.dtype)
+    # Cost-observatory attribution (obs/cost.py): avals, not the arrays
+    # — a/y may be donated into the solve below.
+    from ..obs import cost as _cost
+
+    _cost.note_solver_call("solver_bcd", fn, (a, y, reg_arr))
+    return fn(a, y, reg_arr)
 
 
 @_mode_cached()

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Set, Tuple
 import numpy as np
 
 from ..data.dataset import ArrayDataset, Dataset, ObjectDataset
+from ..obs import cost as _cost
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs import store as _store
@@ -347,6 +348,7 @@ class AutoCacheRule(Rule):
                 _spans.add_span_event(
                     "autocache_profile_store", nodes=len(warm), full_n=full_n
                 )
+                self._note_predictions(graph, warm, digests, sc)
                 return warm
 
         samples: Dict[NodeId, List[SampleProfile]] = {}
@@ -390,7 +392,40 @@ class AutoCacheRule(Rule):
                     run_time_s=profiles[n].run_time_s,
                     size_bytes=profiles[n].size_bytes,
                 )
+        self._note_predictions(graph, profiles, digests, sc)
         return profiles
+
+    def _note_predictions(
+        self,
+        graph: Graph,
+        profiles: Dict[NodeId, Profile],
+        digests: Optional[Dict[NodeId, str]],
+        sc: str,
+    ) -> None:
+        """Publish each profiled node's predicted full-scale runtime into
+        the cost observatory's plan book (obs/cost.py) — the ledger
+        joins them to the measured walls ``timed_execute`` records, and
+        the drift sentinel scores them (a warm-started profile is the
+        canonical silent-staleness hazard: it skips re-measurement
+        entirely). Label-keyed best-effort attribution; no-op when the
+        observatory is off."""
+        if digests is None or not _cost.cost_observatory_enabled():
+            return
+        for node, profile in profiles.items():
+            digest = digests.get(node)
+            if digest is None:
+                continue
+            op = graph.get_operator(node)
+            _cost.note_plan_prediction(
+                str(getattr(op, "label", type(op).__name__)),
+                _cost.Prediction(
+                    model="autocache",
+                    key=f"autocache:{digest}",
+                    shape=sc,
+                    seconds=profile.run_time_s,
+                    calibrated=True,
+                ),
+            )
 
     # ------------------------------------------------------------- cost model
     def _estimate_runtime(

@@ -1,0 +1,360 @@
+"""``keystone-tpu explain`` — the "why is this pipeline slow" report.
+
+Runs a pipeline's optimized plan under the cost observatory
+(obs/cost.py) and prints it node by node: decision provenance (which
+rule drove the node — autocache profile, measured-knob winner, solver
+ladder rung, partition decision — and which stored entry/key), predicted
+cost vs measured wall, achieved FLOP/s / bytes/s, arithmetic intensity,
+and compute-bound/memory-bound roofline placement. The drift sentinel
+runs live: a stored cost model that no longer matches reality fires a
+``cost_drift`` event, marks the entry ``stale:``, and the report says
+so.
+
+Execution shape: the same plan is fitted ``--passes`` times (default 3)
+with the pipeline state reset between passes — pass 1 pays compiles
+(its walls are marked ``cold`` and never drift-score), later passes
+measure steady state. The report is built from the LAST pass's ledger
+window. Harvesting rides the jit trace cache — ``harvest_compiles`` in
+the JSON is the number of backend compiles cost analysis itself caused
+and must be 0 (scripts/explain_smoke.sh gates it).
+
+``--pipeline synthetic`` builds a small featurize→fit chain
+(SyntheticDense ×2 → BlockLeastSquaresEstimator) under the auto-caching
+optimizer so every decision layer is exercised; ``--pipeline PATH``
+loads a ``FittedPipeline.save`` artifact and explains its (re-fused)
+apply path instead. ``--seed-drift F`` corrupts the stored autocache
+measurements by ``F``× before running — the CI negative control: the
+sentinel must flag exactly the seeded corruption, then the stale mark
+must force a live re-measure (asserted by the smoke).
+
+Flag wiring lives in cli.py (stdlib-only, jax-free ``--help``); this
+module imports jax transitively and is loaded only at dispatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------- synthetic
+
+
+def _synthetic_fit_pipeline(rows: int, dim: int, classes: int, seed: int):
+    """data → SyntheticDense ×2 → BlockLeastSquaresEstimator, plus the
+    bound eval apply — one pipeline exercising the auto-cache profiler
+    (the block estimator's weight makes the featurized node a cache
+    candidate), fusion, the streaming planner (when ``rows`` clears the
+    chunk floor), measured knobs, and the partitioner."""
+    import numpy as np
+
+    from ..data.dataset import ArrayDataset
+    from ..ops.learning.block import BlockLeastSquaresEstimator
+    from ..serving.synthetic import SyntheticDense
+
+    rng = np.random.default_rng(seed)
+    scale = 1.0 / np.sqrt(dim)
+    w1 = (rng.standard_normal((dim, dim)) * scale).astype(np.float32)
+    w2 = (rng.standard_normal((dim, dim)) * scale).astype(np.float32)
+    x = rng.standard_normal((rows, dim)).astype(np.float32)
+    w_true = rng.standard_normal((dim, classes)).astype(np.float32)
+    y = (np.tanh(x @ w1) @ w_true + 0.01 * rng.standard_normal(
+        (rows, classes)
+    )).astype(np.float32)
+
+    feat = SyntheticDense([w1]).to_pipeline().then(SyntheticDense([w2]))
+    est = BlockLeastSquaresEstimator(
+        min(64, dim), num_iter=2, reg=1e-3
+    )
+    pipe = feat.then_label_estimator(est, ArrayDataset(x), ArrayDataset(y))
+    x_eval = ArrayDataset(x[: min(256, rows)])
+    return pipe, x_eval
+
+
+def _corrupt_store_predictions(factor: float) -> int:
+    """The seeded mis-prediction: scale ONE autocache entry's
+    coefficients AND its measured baseline by ``1/factor`` — the stored
+    model now claims that node is ``factor``× cheaper than the wall the
+    sentinel will measure. Exactly one entry (the one with the largest
+    measured baseline — the most consequential node), so the acceptance
+    invariant "exactly one drift event" is assertable. Returns the
+    number of entries corrupted (0 or 1)."""
+    from ..obs import store as _store
+    from ..obs.cost import DriftSentinel
+
+    store = _store.get_store()
+    if store is None or factor in (0, 1):
+        return 0
+    baseline_field = DriftSentinel.BASELINE_FIELD
+    candidates = [
+        (float(m.get(baseline_field, 0.0) or 0.0), key, shape, m)
+        for key, shape, m in store.entries(
+            key_prefix="autocache:", include_stale=True
+        )
+        if not _store.is_stale(m)
+    ]
+    if not candidates:
+        return 0
+    _, key, shape, m = max(candidates, key=lambda c: (c[0], c[1]))
+    m2 = dict(m)
+    for field in ("t0", "t1", "run_time_s", baseline_field):
+        if isinstance(m2.get(field), (int, float)):
+            m2[field] = float(m2[field]) / factor
+    store.record(key, shape, **m2)
+    return 1
+
+
+# --------------------------------------------------------------------- passes
+
+
+def _explain_optimizer():
+    """The auto-caching stack with explain-grade profiling scales: the
+    default (2, 4)-item samples are sub-millisecond on CPU — fine for
+    RELATIVE cache decisions, useless as absolute predictions (the
+    lstsq slope is noise and the clamp floors them at 0). Profiling a
+    few hundred rows costs milliseconds and yields extrapolations worth
+    printing next to measured walls."""
+    from .autocache import AutoCacheRule
+    from .rules import auto_caching_optimizer
+
+    stack = auto_caching_optimizer()
+    for batch in stack.batches:
+        for i, rule in enumerate(batch.rules):
+            if isinstance(rule, AutoCacheRule):
+                batch.rules[i] = AutoCacheRule(profile_scales=(128, 512))
+    return stack
+
+
+def _run_pass(pipe, x_eval, optimizer_factory):
+    """One optimize+fit+apply execution in a fresh pipeline env under a
+    synced tracing session; returns (ledger entries, executor)."""
+    from ..obs import cost as _cost
+    from ..obs import spans as _spans
+    from .executor import PipelineEnv
+
+    PipelineEnv.reset()
+    PipelineEnv.get_or_create().optimizer = optimizer_factory()
+    _cost.reset_plan_predictions()
+    cursor = _cost.get_ledger().cursor()
+    with _spans.tracing_session("explain", sync_timings=True):
+        with _spans.span("explain:pass"):
+            handle = pipe.apply(x_eval)
+            handle.get()
+    return _cost.get_ledger().entries(cursor), handle._executor
+
+
+def _provenance(entry, partition_by_label: Dict[str, Any]) -> Dict[str, Any]:
+    """The decision trail for one node: which model/rule claimed it
+    (and from which stored entry), plus the partitioner's recorded
+    decision/reason when one names this node."""
+    out: Dict[str, Any] = {}
+    if entry.predicted_model:
+        out["model"] = entry.predicted_model
+        if entry.predicted_key:
+            out["store_key"] = entry.predicted_key
+        if entry.predicted_shape:
+            out["shape_class"] = entry.predicted_shape
+    decision = partition_by_label.get(entry.node)
+    if decision is not None:
+        out["partition"] = {
+            "eligible": bool(getattr(decision, "eligible", False)),
+            "reason": str(getattr(decision, "reason", "")),
+            "shards": int(getattr(decision, "shards", 1) or 1),
+        }
+    if entry.kinds:
+        out["computations"] = list(entry.kinds)
+    return out
+
+
+def _render_human(report: Dict[str, Any]) -> str:
+    lines = [
+        f"explain: {report['pipeline']} — pass {report['passes']} of "
+        f"{report['passes']} (steady state), roofline "
+        f"{report['roofline']['backend'] if report.get('roofline') else '?'}"
+    ]
+    if report.get("roofline"):
+        r = report["roofline"]
+        lines.append(
+            f"  roofline[{r['source']}]: "
+            f"{r['peak_flops_per_s'] / 1e9:.1f} GFLOP/s, "
+            f"{r['peak_bytes_per_s'] / 1e9:.1f} GB/s, "
+            f"ridge {r['ridge_intensity']:.2f} flop/byte"
+        )
+    header = (
+        f"  {'node':40s} {'wall ms':>9s} {'pred ms':>9s} "
+        f"{'GFLOP/s':>8s} {'int.':>6s} {'bound':>14s}  provenance"
+    )
+    lines.append(header)
+    for node in report["nodes"]:
+        wall = node.get("seconds", 0.0) * 1e3
+        pred = node.get("predicted_s")
+        gflops = node.get("flops_per_s")
+        intensity = node.get("intensity")
+        prov = node.get("provenance", {})
+        prov_text = prov.get("model", "-")
+        if prov.get("store_key"):
+            prov_text += f" ← {prov['store_key'][:40]}"
+        if node.get("drift"):
+            prov_text += "  ** DRIFT **"
+        lines.append(
+            f"  {node['node'][:40]:40s} {wall:9.3f} "
+            f"{(pred * 1e3 if pred is not None else float('nan')):9.3f} "
+            f"{(gflops / 1e9 if gflops else float('nan')):8.2f} "
+            f"{(intensity if intensity is not None else float('nan')):6.2f} "
+            f"{node.get('roofline') or 'unmeasured':>14s}  {prov_text}"
+        )
+    for event in report.get("drift_events", []):
+        lines.append(
+            f"  DRIFT: {event['model']} mis-predicted {event['node']} "
+            f"(ratio {event['ratio']}, key {event['key']}"
+            f"{', marked stale' if event.get('stale_marked') else ''})"
+        )
+    lines.append(
+        f"  harvest_compiles={report['harvest_compiles']} "
+        f"stale_entries={report['store']['stale_entries']} "
+        f"drift_events={len(report.get('drift_events', []))}"
+    )
+    return "\n".join(lines)
+
+
+def explain_from_args(args: argparse.Namespace) -> int:
+    from ..obs import cost as _cost
+    from ..utils.compilation_cache import install_compile_counter
+
+    install_compile_counter()
+    override_before = _cost._enabled_override
+    _cost.set_cost_observatory(True)
+    _cost.record_all_nodes(True)
+    try:
+        return _explain(args)
+    finally:
+        # Embedders calling this in-process get their observatory state
+        # back; the CLI process just exits.
+        _cost.set_cost_observatory(override_before)
+        _cost.record_all_nodes(False)
+
+
+def _explain(args: argparse.Namespace) -> int:
+    from ..obs import cost as _cost
+    from ..obs import store as _store
+    from ..obs.metrics import get_registry
+    from ..obs import names as _names
+
+    # Roofline first: the probe's two tiny compiles are calibration,
+    # never attributable to harvesting (whose own compile budget is 0).
+    roofline = _cost.get_roofline()
+
+    if args.pipeline == "synthetic":
+        pipe, x_eval = _synthetic_fit_pipeline(
+            args.rows, args.dim, args.classes, args.seed
+        )
+    else:
+        from .pipeline import FittedPipeline
+
+        import numpy as np
+
+        fitted = FittedPipeline.load(args.pipeline).fused()
+        pipe = fitted
+        rng = np.random.default_rng(args.seed)
+        from ..data.dataset import ArrayDataset
+
+        x_eval = ArrayDataset(
+            rng.standard_normal((256, args.dim)).astype(np.float32)
+        )
+
+    seed_factor = (
+        args.seed_drift if args.seed_drift and args.seed_drift != 1.0 else 0
+    )
+    seeded = 0
+
+    registry = get_registry()
+    harvest_before = registry.snapshot().get(_names.COST_HARVEST_COMPILES, 0)
+    drift_before = list(_cost.get_drift_sentinel().events)
+
+    entries: List[Any] = []
+    executor = None
+    total_passes = max(1, args.passes)
+    index = 0
+    while index < total_passes:
+        entries, executor = _run_pass(pipe, x_eval, _explain_optimizer)
+        index += 1
+        if (
+            seed_factor
+            and not seeded
+            and _cost.get_drift_sentinel().seen_count()
+        ):
+            # Corrupt only once the sentinel has re-based baselines to
+            # THIS process's walls (cross-process ms-scale walls are
+            # load noise, and a cold first pass never observes), so the
+            # seeded mis-prediction is measured against in-process
+            # reality — then guarantee enough further passes for the
+            # sustain threshold to fire.
+            seeded = _corrupt_store_predictions(seed_factor)
+            total_passes = max(
+                total_passes, index + _cost.drift_sustain()
+            )
+
+    partition_by_label: Dict[str, Any] = {}
+    if executor is not None:
+        for decision in getattr(executor, "partition_decisions", []) or []:
+            label = getattr(decision, "node", None)
+            if label:
+                partition_by_label[str(label)] = decision
+
+    drift_events = [
+        e for e in _cost.get_drift_sentinel().events if e not in drift_before
+    ]
+    harvest_compiles = int(
+        registry.snapshot().get(_names.COST_HARVEST_COMPILES, 0)
+        - harvest_before
+    )
+
+    store = _store.get_store()
+    stale_keys: List[str] = []
+    if store is not None:
+        stale_keys = sorted(
+            {
+                key
+                for key, _shape, m in store.entries(
+                    any_env=True, include_stale=True
+                )
+                if _store.is_stale(m)
+            }
+        )
+
+    nodes = []
+    for entry in entries:
+        node = entry.to_json()
+        node["provenance"] = _provenance(entry, partition_by_label)
+        nodes.append(node)
+
+    report: Dict[str, Any] = {
+        "pipeline": args.pipeline,
+        "passes": index,
+        "roofline": roofline.to_json() if roofline else None,
+        "nodes": nodes,
+        "drift_events": drift_events,
+        "seeded_corruptions": seeded,
+        "harvest_compiles": harvest_compiles,
+        "store": {
+            "enabled": store is not None,
+            "stale_entries": len(stale_keys),
+            "stale_keys": stale_keys,
+        },
+    }
+    body = json.dumps(report)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body)
+    if args.as_json:
+        print("EXPLAIN_JSON:" + body)
+    else:
+        print(_render_human(report))
+    # Exit code mirrors the sentinel: an explain run that caught live
+    # drift should fail a CI step that expected a quiet model (the smoke
+    # inverts this for the seeded run).
+    return 2 if drift_events else 0

@@ -46,6 +46,7 @@ from contextlib import contextmanager
 from typing import List, Optional, Sequence, Tuple
 
 from ..envknobs import env_disabled
+from ..obs import cost as _cost
 from ..obs import names as _names
 from .graph import Graph, NodeId, SinkId
 from .operators import TransformerOperator
@@ -196,7 +197,15 @@ class FusedTransformerOperator(BatchTransformer):
         if self._eager_fallback:
             return self._chain(data)
         try:
-            return self._compiled()(data)
+            jitted = self._compiled()
+            result = jitted(data)
+            # Cost-observatory attribution (obs/cost.py): a single
+            # thread-local read when no harvest frame is active (the
+            # serving hot path); under an executor frame the fused
+            # chain's flop/byte facts are harvested through the jit
+            # trace cache at node finalize — zero extra compiles.
+            _cost.note_jit_call("fused_chain", jitted, (data,))
+            return result
         except _trace_error_types() as e:
             # A member that escaped the fusability gate (host-side value
             # branching, stale cached tracers) — degrade to the exact

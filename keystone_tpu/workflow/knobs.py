@@ -44,6 +44,7 @@ import logging
 from typing import Any, Dict, Optional, Tuple
 
 from ..envknobs import env_raw, env_set, env_str
+from ..obs import cost as _cost
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs import store as _store
@@ -91,7 +92,7 @@ def _best_entry(
 def _unanimous_winner(
     store, key_prefix: str, rows: str, field: str,
     knob: Optional[str] = None, sp=None,
-) -> Optional[Dict[str, Any]]:
+) -> Optional[Tuple[str, str, Dict[str, Any]]]:
     """Group matching entries by their FULL shape class (exact d, not
     just the rows bucket), take the best-wall entry per group, and return
     a winner only when every group agrees on ``field``. Absolute walls
@@ -105,7 +106,7 @@ def _unanimous_winner(
     recorded as a span event naming the contenders, so a tuning gap
     (more measurements needed before the override can apply) is visible
     instead of an invisible no-op."""
-    groups: Dict[str, Tuple[float, Dict[str, Any]]] = {}
+    groups: Dict[str, Tuple[float, str, Dict[str, Any]]] = {}
     for key, shape, m in sorted(
         store.entries(key_prefix=key_prefix, rows=rows)
     ):
@@ -114,17 +115,18 @@ def _unanimous_winner(
         wall = float(m["wall_s"])
         cur = groups.get(shape)
         if cur is None or wall < cur[0]:
-            groups[shape] = (wall, m)
+            groups[shape] = (wall, key, m)
     if not groups:
         return None
-    winners = {repr(m[field]) for _, m in groups.values()}
+    winners = {repr(m[field]) for _, _, m in groups.values()}
     if len(winners) != 1:
         _reject_knob(
             knob or field, "non_unanimous", sp=sp,
             contenders=sorted(winners), groups=len(groups), rows=rows,
         )
         return None  # the widths disagree: no defensible override
-    return next(iter(groups.values()))[1]
+    shape, (_, key, m) = next(iter(groups.items()))
+    return key, shape, m
 
 
 def _reject_knob(knob: str, reason: str, sp=None, **detail: Any) -> None:
@@ -204,6 +206,15 @@ class MeasuredKnobRule(Rule):
                 op.estimator, op.members,
                 chunk_rows=rows, prefetch=op.prefetch,
             )
+            # Cost-observatory join (obs/cost.py): the stored winner IS
+            # this plan's throughput prediction — measured under the
+            # exact (key, shape class) it will be compared at, so the
+            # drift sentinel may score it (calibrated=True).
+            tuned.predicted_cost = _cost.Prediction(
+                model="measured_knob", key=best[0], shape=shape,
+                rows_per_s=float(best[1]["rows_per_s"]), calibrated=True,
+                source=str(best[1].get("source", "observed")),
+            )
             graph = graph.set_operator(node, tuned)
             overrides.inc(knob="stream_chunk_rows")
             sp.set_attribute(f"stream_chunk_rows:{node}", rows)
@@ -244,11 +255,22 @@ class MeasuredKnobRule(Rule):
             )
             if best is None:
                 continue
+            best_key, best_shape, best = best
             best_block = int(best.get("block_size", 0))
             if best_block <= 0 or best_block == block:
                 continue
             tuned = copy.copy(target)
             tuned.block_size = best_block
+            # Displayed in the ledger/explain, never drift-scored: the
+            # winner's wall was measured at ITS feature width, and
+            # absolute walls across widths are incommensurable (the
+            # unanimity gate above is about the SETTING transferring,
+            # not the wall).
+            tuned.predicted_cost = _cost.Prediction(
+                model="measured_knob", key=best_key, shape=best_shape,
+                seconds=float(best["wall_s"]), calibrated=False,
+                source=str(best.get("source", "observed")),
+            )
             if isinstance(op, StreamingFitOperator):
                 new_op = StreamingFitOperator(
                     tuned, op.members,
@@ -293,6 +315,7 @@ class MeasuredKnobRule(Rule):
             )
             if best is None:
                 continue
+            _best_key, _best_shape, best = best
             precision = best.get("precision")
             if not precision:
                 continue

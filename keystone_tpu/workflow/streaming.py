@@ -61,6 +61,7 @@ from ..data.dataset import (
     default_ingest_workers,
     transfer_dtype,
 )
+from ..obs import cost as _cost
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs import store as _store
@@ -639,6 +640,16 @@ class ChunkStream:
             nonlocal carry
             x_dev, y_dev, mask_dev, _rows = staged_chunk
             probe("streaming.chunk")
+            if not report.chunks and _cost.current_frame() is not None:
+                # Cost-observatory note, once per fold: avals (not the
+                # arrays — the carry is donated into the step) so the
+                # per-chunk program's flop/byte facts harvest at node
+                # finalize through the jit trace cache (obs/cost.py).
+                avals = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    (carry, x_dev, y_dev, mask_dev),
+                )
+                _cost.note_jit_call("stream_step", step, avals=avals)
             report.dispatch_t.append(time.perf_counter() - t0)
             carry, probe_out = step(carry, x_dev, y_dev, mask_dev)
             chunks_c.inc()
@@ -705,6 +716,12 @@ class ChunkStream:
         # recorded nothing — its throughput would be a lie).
         if report.chunks == len(windows):
             self._record_observation(report, data_shape)
+        if report.compute_done_t:
+            # Achieved throughput to the enclosing harvest frame: a
+            # rows/s-denominated prediction (the measured-knob chunk
+            # winner) is drift-scored in its own unit (obs/cost.py).
+            wall = max(report.compute_done_t[-1], 1e-9)
+            _cost.note_stream_result(report.num_examples / wall, n)
 
         info = {
             "num_examples": n,

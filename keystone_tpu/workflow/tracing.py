@@ -33,6 +33,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
 
+from ..obs import cost as _cost
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs.device import device_annotation
@@ -143,16 +144,34 @@ def timed_execute(op, deps):
     A fused chain (workflow/fusion.py) appears as ONE ``node:Fused[...]``
     span carrying the member labels as an attribute — the per-member
     spans collapse along with the dispatches.
+
+    With the cost observatory enabled (obs/cost.py,
+    ``KEYSTONE_COST_OBS``) each forcing additionally runs inside a
+    harvest frame: operators note their jitted computations into it and
+    the frame finalizes into a perf-ledger entry — predicted cost,
+    measured wall, flop/byte facts, roofline placement — AFTER the wall
+    measurement, so first-shape harvesting never inflates node timings.
+    The entry's lowering digest lands on the span
+    (``lowering_digest``), joining spans to ProfileStore keys
+    deterministically — the fused-member-names attribute alone never
+    identified the executable.
     """
     tr = current_trace()
     session = _spans.active_session()
     expression = op.execute(deps)
-    if tr is None and session is None:
+    cost_on = _cost.cost_observatory_enabled()
+    if tr is None and session is None and not cost_on:
         return expression
-    sync = tr is not None or getattr(session, "sync_timings", True)
+    # Ledger-only runs (observatory on, no trace/session) keep async
+    # dispatch: seconds then measures dispatch, marked synced=False so a
+    # reader never mistakes it for work time.
+    sync = tr is not None or (
+        session is not None and getattr(session, "sync_timings", True)
+    )
     label = str(getattr(op, "label", type(op).__name__))
     members = getattr(op, "member_labels", None)
     partition = getattr(op, "partition", None)
+    frame = _cost.push_frame(label) if cost_on else None
     with _spans.span(f"node:{label}", op=type(op).__name__) as sp:
         if members is not None:
             sp.set_attribute("fused_members", ",".join(members))
@@ -164,16 +183,35 @@ def timed_execute(op, deps):
                 "mesh_shape", "x".join(str(s) for s in partition.mesh_shape)
             )
             sp.set_attribute("partition_spec", partition.spec)
-        with device_annotation(f"keystone/node/{label}"):
-            start = time.perf_counter()
-            value = expression.get()
-            if sync:
-                _force(value)
-            seconds = time.perf_counter() - start
+        try:
+            if frame is not None:
+                # Compile events during the forcing mark the wall as
+                # cold: compile-inflated timings never anchor or score
+                # the drift sentinel (obs/cost.py).
+                from ..utils.compilation_cache import compile_count
+
+                compiles_before = compile_count()
+            with device_annotation(f"keystone/node/{label}"):
+                start = time.perf_counter()
+                value = expression.get()
+                if sync:
+                    _force(value)
+                seconds = time.perf_counter() - start
+        finally:
+            if frame is not None:
+                frame.compiles = compile_count() - compiles_before
+                _cost.pop_frame(frame)
         sp.set_attribute("seconds", round(seconds, 6))
         if not sync:
             sp.set_attribute("synced", False)
+    if frame is not None:
+        # Post-measurement: resolves noted computations to flop/byte
+        # facts (jit trace-cache hits — zero backend compiles), joins
+        # the plan's prediction, drift-scores, lands the ledger entry,
+        # and back-fills the span's cost attributes.
+        _cost.finalize_node(label, seconds, sync, op=op, span=sp, frame=frame)
     if tr is not None:
         tr.record(label, seconds)
-    _node_seconds_hist().observe(seconds, op=label)
+    if tr is not None or session is not None:
+        _node_seconds_hist().observe(seconds, op=label)
     return expression

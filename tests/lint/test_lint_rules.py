@@ -187,7 +187,9 @@ def test_finding_render_and_json():
 
 
 def test_lint_codes_table():
-    assert set(LINT_CODES) == {"KV501", "KV502", "KV503", "KV504", "KV505"}
+    assert set(LINT_CODES) == {
+        "KV501", "KV502", "KV503", "KV504", "KV505", "KV506",
+    }
 
 
 def test_build_context_reads_real_registries():
@@ -249,3 +251,31 @@ def test_group_batch_reads_metadata_without_host_sync():
     reqs = [Request(payload=DeviceLeaf(), model="m") for _ in range(3)]
     groups = PipelineServer._group_batch(reqs)
     assert len(groups) == 1 and len(groups[0]) == 3
+
+
+# ------------------------------------------------------------------- KV506
+
+
+def test_cost_analysis_outside_home_flagged():
+    src = """
+    def harvest(compiled):
+        return compiled.cost_analysis()
+    """
+    assert codes(src) == ["KV506"]
+    # bare-name calls count too
+    assert codes("x = cost_analysis()\n") == ["KV506"]
+
+
+def test_cost_analysis_in_obs_cost_allowed():
+    src = "facts = lowered.cost_analysis()\n"
+    assert codes(src, path=os.path.join("pkg", "obs", "cost.py")) == []
+
+
+def test_cost_analysis_mention_without_call_ok():
+    # docstrings/comments/attribute references don't flag — only calls
+    src = '"""uses cost_analysis() downstream"""\nname = "cost_analysis"\n'
+    assert codes(src) == []
+
+
+def test_kv506_registered():
+    assert "KV506" in LINT_CODES

@@ -1,0 +1,446 @@
+"""Cost observatory (obs/cost.py): guarded harvest, roofline
+calibration + store warm-start, the perf ledger, harvest frames, and
+the drift sentinel's baseline/stale contract
+(docs/OBSERVABILITY.md "Cost observatory")."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.obs import cost, names
+from keystone_tpu.obs.metrics import get_registry
+from keystone_tpu.obs.store import ProfileStore, is_stale, set_store
+
+FP = {"jax": "test", "backend": "cpu", "device_kind": "virtual"}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory():
+    import os
+
+    env_before = os.environ.get("KEYSTONE_PROFILE_STORE")
+    cost.reset_cost_observatory()
+    cost.set_cost_observatory(True)
+    yield
+    if env_before is not None:
+        os.environ["KEYSTONE_PROFILE_STORE"] = env_before
+    else:
+        os.environ.pop("KEYSTONE_PROFILE_STORE", None)
+    cost.set_cost_observatory(None)
+    cost.reset_cost_observatory()
+    set_store(None)
+
+
+def _own_store(tmp_path, monkeypatch=None):
+    """Point the process store at a per-test file. ``get_store()``
+    re-resolves from KEYSTONE_PROFILE_STORE, so the env is the only
+    reliable isolation door."""
+    import os
+
+    path = str(tmp_path / "cost.jsonl")
+    if monkeypatch is not None:
+        monkeypatch.setenv("KEYSTONE_PROFILE_STORE", path)
+    else:
+        os.environ["KEYSTONE_PROFILE_STORE"] = path
+    from keystone_tpu.obs.store import get_store
+
+    return get_store()
+
+
+# ------------------------------------------------------------------- harvest
+
+
+def test_harvest_cost_facts_from_jitted_fn_zero_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    from keystone_tpu.utils.compilation_cache import (
+        compile_count,
+        install_compile_counter,
+    )
+
+    install_compile_counter()
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((32, 32), jnp.float32)
+    f(x)  # the signature has executed: lower() rides the trace cache
+    before = compile_count()
+    facts = cost.harvest_cost_facts(f, (x,))
+    assert compile_count() == before, "harvest must not compile"
+    assert facts is not None
+    assert facts.flops and facts.flops > 2 * 32**3 * 0.5
+    assert facts.bytes_accessed and facts.bytes_accessed > 0
+    assert facts.intensity == facts.flops / facts.bytes_accessed
+    assert len(facts.lowering_digest) == 16
+
+
+def test_harvest_guarded_against_broken_backends():
+    class Broken:
+        def cost_analysis(self):
+            raise RuntimeError("backend says no")
+
+    assert cost.harvest_cost_facts(Broken()) is None
+
+    class Lowered:
+        def cost_analysis(self):
+            return None
+
+        def as_text(self):
+            return "module {}"
+
+    facts = cost.harvest_cost_facts(Lowered())
+    assert facts is not None
+    assert facts.flops is None and facts.bytes_accessed is None
+    assert facts.intensity is None
+
+
+def test_normalize_cost_analysis_shapes():
+    norm = cost._normalize_cost_analysis
+    assert norm(None) == (None, None)
+    assert norm({"flops": 10.0, "bytes accessed": 4.0}) == (10.0, 4.0)
+    # list-of-dicts sums; missing/negative fields degrade to None
+    assert norm([{"flops": 1.0}, {"flops": 2.0}]) == (3.0, None)
+    assert norm([{"flops": -1.0}]) == (None, None)
+    assert norm("garbage") == (None, None)
+
+
+def test_facts_cache_hits_per_signature():
+    import jax
+    import jax.numpy as jnp
+
+    calls = []
+    real = cost.harvest_cost_facts
+
+    f = jax.jit(lambda x: x * 2.0)
+    x = jnp.ones((8,), jnp.float32)
+    f(x)
+    try:
+        cost.harvest_cost_facts = lambda fn, a=None: calls.append(1) or real(
+            fn, a
+        )
+        assert cost._cached_facts(f, (x,)) is not None
+        assert cost._cached_facts(f, (x,)) is not None
+        assert len(calls) == 1, "second lookup must hit the facts cache"
+    finally:
+        cost.harvest_cost_facts = real
+
+
+# ------------------------------------------------------------------ roofline
+
+
+def test_roofline_probe_and_store_warm_start(tmp_path):
+    store = _own_store(tmp_path)
+    roofline = cost.get_roofline()
+    assert roofline is not None
+    assert roofline.source == "probe"
+    assert roofline.peak_flops_per_s > 0 and roofline.peak_bytes_per_s > 0
+    # persisted: a fresh in-process resolve warm-starts from the store
+    cost.set_roofline(None)
+    again = cost.get_roofline()
+    assert again.source == "store"
+    assert again.peak_flops_per_s == pytest.approx(
+        roofline.peak_flops_per_s
+    )
+    assert store.lookup(
+        f"roofline:{roofline.backend}", cost.ROOFLINE_SHAPE
+    )
+
+
+def test_roofline_classify_and_predict():
+    r = cost.Roofline(peak_flops_per_s=1e9, peak_bytes_per_s=1e8)
+    assert r.ridge_intensity == 10.0
+    assert r.classify(20.0) == "compute-bound"
+    assert r.classify(5.0) == "memory-bound"
+    assert r.classify(None) is None
+    # roofline time = max(compute floor, memory floor)
+    assert r.predicted_seconds(1e9, 1e7) == pytest.approx(1.0)
+    assert r.predicted_seconds(1e7, 1e8) == pytest.approx(1.0)
+    assert r.predicted_seconds(None, None) is None
+
+
+# -------------------------------------------------------------------- ledger
+
+
+def test_ledger_ring_bounds_and_cursor():
+    ledger = cost.PerfLedger(capacity=4)
+    for i in range(10):
+        ledger.record(
+            cost.PerfLedgerEntry(
+                node=f"n{i}", seconds=0.1, synced=True, t_s=0.0, t_unix=0.0
+            )
+        )
+    assert ledger.cursor() == 10
+    assert [e.node for e in ledger.tail(2)] == ["n8", "n9"]
+    # entries(since) is ring-bounded: only the last 4 survive
+    assert [e.node for e in ledger.entries(5)] == ["n6", "n7", "n8", "n9"]
+    assert ledger.entries(10) == []
+    summary = ledger.summary(since=6)
+    assert summary["nodes"] == 4
+
+
+# -------------------------------------------------------- frames + finalize
+
+
+def test_note_jit_call_requires_frame():
+    cost.note_jit_call("x", object(), (1,))  # no frame: silently dropped
+    frame = cost.push_frame("node")
+    try:
+        cost.note_jit_call("x", object(), (1,))
+        assert len(frame.notes) == 1
+    finally:
+        cost.pop_frame(frame)
+    assert cost.current_frame() is None
+
+
+def test_finalize_node_joins_facts_prediction_and_span(tmp_path):
+    import jax
+    import jax.numpy as jnp
+
+    _own_store(tmp_path)
+    cost.set_roofline(
+        cost.Roofline(peak_flops_per_s=1e12, peak_bytes_per_s=1e11)
+    )
+    f = jax.jit(lambda x: x @ x)
+    x = jnp.ones((16, 16), jnp.float32)
+    f(x)
+
+    class Op:
+        predicted_cost = cost.Prediction(
+            model="solver_ladder", key="solver:ladder:X", seconds=0.5
+        )
+
+    class Span:
+        attrs = {}
+
+        def set_attribute(self, k, v):
+            self.attrs[k] = v
+
+    frame = cost.push_frame("node:test")
+    cost.note_jit_call("matmul", f, (x,))
+    cost.pop_frame(frame)
+    span = Span()
+    entry = cost.finalize_node(
+        "node:test", 1.0, True, op=Op(), span=span, frame=frame
+    )
+    assert entry is not None
+    assert entry.flops and entry.bytes_accessed
+    assert entry.roofline in ("compute-bound", "memory-bound")
+    assert entry.flops_per_s == pytest.approx(entry.flops / 1.0)
+    assert entry.predicted_model == "solver_ladder"
+    assert entry.predicted_s == 0.5
+    assert entry.ratio == pytest.approx(2.0)  # 1.0 measured vs 0.5 claimed
+    assert entry.lowering_digest
+    # the span carries the join surface, lowering digest included
+    assert span.attrs["lowering_digest"] == entry.lowering_digest
+    assert span.attrs["roofline"] == entry.roofline
+    assert span.attrs["predicted_model"] == "solver_ladder"
+
+
+def test_finalize_skips_unclaimed_nodes_unless_record_all():
+    frame = cost.push_frame("host-node")
+    cost.pop_frame(frame)
+    assert (
+        cost.finalize_node("host-node", 0.1, True, frame=frame) is None
+    )
+    cost.record_all_nodes(True)
+    frame = cost.push_frame("host-node")
+    cost.pop_frame(frame)
+    entry = cost.finalize_node("host-node", 0.1, True, frame=frame)
+    assert entry is not None and entry.flops is None
+
+
+def test_resolve_prediction_sums_fused_members():
+    cost.note_plan_prediction(
+        "A", cost.Prediction("autocache", key="autocache:a", shape="s",
+                             seconds=0.2, calibrated=True)
+    )
+    cost.note_plan_prediction(
+        "B", cost.Prediction("autocache", key="autocache:b", shape="s",
+                             seconds=0.3, calibrated=True)
+    )
+
+    class Fused:
+        member_labels = ("A", "B")
+
+    resolved = cost._resolve_prediction(Fused(), "Fused[A+B]")
+    assert resolved.seconds == pytest.approx(0.5)
+    assert resolved.key == "autocache:a,autocache:b"
+    assert resolved.calibrated
+
+
+# ------------------------------------------------------------------ sentinel
+
+
+def _calibrated(key="autocache:k1", shape="n2^10", seconds=0.01):
+    return cost.Prediction(
+        model="autocache", key=key, shape=shape, seconds=seconds,
+        calibrated=True,
+    )
+
+
+def test_sentinel_baselines_then_fires_and_marks_stale(tmp_path):
+    store = _own_store(tmp_path)
+    store.record("autocache:k1", "n2^10", t0=0.0, t1=1e-5)
+    sentinel = cost.get_drift_sentinel()
+    pred = _calibrated()
+    # 1st warm observation: baseline written, no judgment
+    assert sentinel.observe("node", pred, measured_s=0.1) is None
+    m = store.lookup("autocache:k1", "n2^10")
+    assert m[cost.DriftSentinel.BASELINE_FIELD] == pytest.approx(0.1)
+    # in-band: quiet (and the EMA nudges the baseline toward reality)
+    assert sentinel.observe("node", pred, measured_s=0.12) is None
+    # sustained 10x: first out-of-band is noise, second fires
+    assert sentinel.observe("node", pred, measured_s=1.1) is None
+    reg_before = get_registry().snapshot()
+    event = sentinel.observe("node", pred, measured_s=1.1)
+    assert event is not None
+    assert event["stale_marked"] is True
+    assert event["ratio"] > cost.drift_ratio_tolerance()
+    # the entry is stale: consumers re-measure instead of replaying
+    assert store.lookup("autocache:k1", "n2^10") is None
+    stale = store.lookup("autocache:k1", "n2^10", include_stale=True)
+    assert is_stale(stale) and stale["stale_reason"] == "cost_drift"
+    # metric + recovery-ledger event landed
+    moved = {
+        k: v - reg_before.get(k, 0)
+        for k, v in get_registry().snapshot().items()
+        if k.startswith("keystone_cost_drift_events")
+    }
+    assert any(v == 1 for v in moved.values()), moved
+    from keystone_tpu.reliability.recovery import get_recovery_log
+
+    kinds = [e.kind for e in get_recovery_log().events()]
+    assert "cost_drift" in kinds
+    # already stale: the sentinel goes quiet until a re-measure
+    assert sentinel.observe("node", pred, measured_s=1.1) is None
+    # fresh measurement re-records the entry → baseline restarts
+    store.record("autocache:k1", "n2^10", t0=0.0, t1=1e-5)
+    assert sentinel.observe("node", pred, measured_s=1.1) is None  # baseline
+    assert sentinel.observe("node", pred, measured_s=1.15) is None  # in band
+
+
+def test_sentinel_scores_rate_predictions_directly(tmp_path):
+    store = _own_store(tmp_path)
+    store.record("stream:c:cr512", "n2^12", chunk_rows=512, rows_per_s=1e5)
+    sentinel = cost.get_drift_sentinel()
+    pred = cost.Prediction(
+        model="measured_knob", key="stream:c:cr512", shape="n2^12",
+        rows_per_s=1e5, calibrated=True,
+    )
+    # achieved ~= claimed: quiet
+    assert sentinel.observe("s", pred, measured_rate=9e4) is None
+    # sustained 10x slower than the stored claim: fires on the 2nd
+    assert sentinel.observe("s", pred, measured_rate=1e4) is None
+    event = sentinel.observe("s", pred, measured_rate=1e4)
+    assert event is not None and event["stale_marked"]
+    assert store.lookup("stream:c:cr512", "n2^12") is None
+
+
+def test_sentinel_ignores_uncalibrated_compound_and_missing(tmp_path):
+    store = _own_store(tmp_path)
+    sentinel = cost.get_drift_sentinel()
+    relative = cost.Prediction("solver_ladder", key="solver:ladder:X",
+                               seconds=1e-6, calibrated=False)
+    for _ in range(4):
+        assert sentinel.observe("n", relative, measured_s=10.0) is None
+    compound = cost.Prediction(
+        "autocache", key="autocache:a,autocache:b", shape="s",
+        seconds=0.01, calibrated=True,
+    )
+    for _ in range(4):
+        assert sentinel.observe("n", compound, measured_s=10.0) is None
+    # no store entry behind the key: nothing to govern
+    missing = _calibrated(key="autocache:gone")
+    for _ in range(4):
+        assert sentinel.observe("n", missing, measured_s=10.0) is None
+    assert sentinel.events == []
+
+
+def test_observatory_disabled_is_inert(tmp_path):
+    cost.set_cost_observatory(False)
+    cost.note_plan_prediction("X", _calibrated())
+    assert cost.plan_prediction("X") is None
+    frame = cost.current_frame()
+    assert frame is None
+
+
+# ------------------------------------------------------- ledger-only tracing
+
+
+def test_timed_execute_ledger_only_records_entries(tmp_path):
+    """Observatory on, no span session: timed_execute still lands ledger
+    entries (unsynced) without touching the node-seconds histogram."""
+    import jax.numpy as jnp
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.serving.synthetic import SyntheticDense
+    from keystone_tpu.workflow.operators import DatasetOperator
+    from keystone_tpu.workflow.tracing import timed_execute
+
+    _own_store(tmp_path)
+    cost.record_all_nodes(True)
+    w = np.eye(4, dtype=np.float32)
+    op = SyntheticDense([w])
+    data = DatasetOperator(
+        ArrayDataset(jnp.ones((8, 4), jnp.float32))
+    ).execute([])
+    cursor = cost.get_ledger().cursor()
+    before = get_registry().snapshot()
+    from keystone_tpu.workflow.pipeline import BatchTransformer
+
+    class Wrap(BatchTransformer):
+        label = "wrap"
+
+        def apply_arrays(self, x):
+            return op.apply_arrays(x)
+
+    timed_execute(Wrap(), [data]).get()
+    entries = cost.get_ledger().entries(cursor)
+    assert [e.node for e in entries] == ["wrap"]
+    assert entries[0].synced is False
+    moved = {
+        k: v
+        for k, v in get_registry().snapshot().items()
+        if k.startswith(names.NODE_SECONDS) and v != before.get(k, 0)
+    }
+    assert not moved, "ledger-only runs must not feed the traced histogram"
+
+
+def test_sentinel_rebases_stored_baseline_on_first_process_sight(tmp_path):
+    """A baseline written by ANOTHER process is load noise at ms scale:
+    the first observation of a key in this process re-bases it to local
+    reality instead of scoring it — cross-process wall jumps never
+    false-fire; in-process drift still does."""
+    store = _own_store(tmp_path)
+    base = cost.DriftSentinel.BASELINE_FIELD
+    # "another process" recorded a 6x-slower baseline
+    store.record("autocache:k2", "n2^10", t0=0.0, t1=1e-5, **{base: 0.6})
+    sentinel = cost.get_drift_sentinel()
+    pred = _calibrated(key="autocache:k2")
+    # 6x faster than the stored baseline — rebased, not scored
+    for _ in range(3):
+        assert sentinel.observe("n", pred, measured_s=0.1) is None
+    assert store.lookup("autocache:k2", "n2^10")[base] == pytest.approx(
+        0.1, rel=0.2
+    )
+    # ...but in-process drift on the rebased baseline still fires
+    assert sentinel.observe("n", pred, measured_s=1.0) is None
+    assert sentinel.observe("n", pred, measured_s=1.0) is not None
+
+
+def test_partial_fused_coverage_is_never_calibrated():
+    """A fused chain with only SOME members in the plan book must not
+    produce a calibrated prediction: a partial sum understates the
+    chain's claim, and a single covered member would slip past the
+    sentinel's compound-key guard and score the whole chain's wall
+    against that one entry."""
+    cost.note_plan_prediction(
+        "A", cost.Prediction("autocache", key="autocache:a", shape="s",
+                             seconds=0.2, calibrated=True)
+    )
+
+    class Fused:
+        member_labels = ("A", "B")  # B never profiled
+
+    resolved = cost._resolve_prediction(Fused(), "Fused[A+B]")
+    assert resolved is not None
+    assert resolved.seconds == pytest.approx(0.2)
+    assert resolved.calibrated is False  # partial coverage: display only

@@ -202,3 +202,41 @@ def test_failing_device_yields_error_entry_not_omission(monkeypatch):
     # the payload reuses a passed snapshot instead of re-walking devices
     payload = device_obs_payload(snapshots=snaps)
     assert payload["devices"] is snaps
+
+
+def test_cost_ledger_counter_track():
+    """Perf-ledger entries export as ph:C counter events on a named
+    cost-ledger track (docs/OBSERVABILITY.md "Cost observatory")."""
+    import pytest
+
+    from keystone_tpu.obs import cost
+    from keystone_tpu.obs.export import chrome_trace, cost_ledger_events
+
+    entries = [
+        cost.PerfLedgerEntry(
+            node="n0", seconds=0.01, synced=True, t_s=100.5, t_unix=0.0,
+            flops_per_s=2e9, bytes_per_s=1e9, ratio=1.5,
+        ),
+        cost.PerfLedgerEntry(  # nothing measurable: no counter sample
+            node="n1", seconds=0.01, synced=False, t_s=100.6, t_unix=0.0,
+        ),
+    ]
+    events = cost_ledger_events(entries, base_s=100.0, pid=42)
+    counters = [e for e in events if e.get("ph") == "C"]
+    assert len(counters) == 1
+    c = counters[0]
+    assert c["ts"] == pytest.approx(0.5e6, rel=1e-3)
+    assert c["args"]["gflops_per_s"] == pytest.approx(2.0)
+    assert c["args"]["gbytes_per_s"] == pytest.approx(1.0)
+    assert c["args"]["measured_vs_predicted"] == 1.5
+    # the track is named for Perfetto
+    assert any(
+        e.get("ph") == "M" and e["args"]["name"] == "cost-ledger"
+        for e in events
+    )
+    # and chrome_trace threads it through end to end
+    with spans.tracing_session("t") as session:
+        with spans.span("x"):
+            pass
+    trace = chrome_trace(session, cost_ledger=entries)
+    assert any(e.get("ph") == "C" for e in trace["traceEvents"])

@@ -126,3 +126,36 @@ def test_hook_is_noop_without_recorder():
     # the ledger write always succeeds.
     flight.observe_ledger("fault", "x", {"a": 1})
     get_recovery_log().record("fault", "y")
+
+
+def test_dump_carries_perf_ledger_tail(tmp_path):
+    """A crash snapshot carries the cost observatory's perf picture:
+    the last perf-ledger entries ride every flightrec dump
+    (docs/OBSERVABILITY.md "Cost observatory")."""
+    from keystone_tpu.obs import cost
+
+    cost.reset_cost_observatory()
+    try:
+        ledger = cost.get_ledger()
+        for i in range(40):
+            ledger.record(
+                cost.PerfLedgerEntry(
+                    node=f"node-{i}", seconds=0.01 * i, synced=True,
+                    t_s=0.0, t_unix=0.0, flops=float(i),
+                    roofline="compute-bound",
+                    predicted_model="autocache", predicted_s=0.01,
+                )
+            )
+        recorder = install_flight_recorder("w1", out_dir=str(tmp_path))
+        path = recorder.dump("fault_probe", force=True)
+        artifact = json.loads(open(path).read())
+        perf = artifact["perf_ledger"]
+        # bounded tail (32), newest last, full entry schema
+        assert len(perf) == 32
+        assert perf[-1]["node"] == "node-39"
+        assert perf[0]["node"] == "node-8"
+        assert perf[-1]["roofline"] == "compute-bound"
+        assert perf[-1]["predicted_model"] == "autocache"
+        assert perf[-1]["flops"] == 39.0
+    finally:
+        cost.reset_cost_observatory()

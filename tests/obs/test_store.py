@@ -231,3 +231,76 @@ def test_compaction_fires_at_slack_not_max_entries(tmp_path):
     assert lines <= 41
     _, _, m = next(iter(st.entries(key_prefix="solver:")))
     assert m["wall_s"] == 0.1 + _COMPACT_SLACK + 39  # newest survived
+
+
+# ------------------------------------------------------------ stale marking
+
+
+def test_mark_stale_round_trip(tmp_path):
+    """The drift sentinel's provenance contract (obs/cost.py): a stale:
+    mark turns lookups into misses (consumers re-measure), survives for
+    inspection via include_stale, and is cleared by the next fresh
+    measurement."""
+    st = make_store(tmp_path)
+    st.record("stream:abc:cr512", "n2^12|8|float32",
+              chunk_rows=512, rows_per_s=1e5)
+    assert st.lookup("stream:abc:cr512", "n2^12|8|float32") is not None
+
+    assert st.mark_stale("stream:abc:cr512", "n2^12|8|float32") is True
+    # marking twice is a no-op (one drift = one mark)
+    assert st.mark_stale("stream:abc:cr512", "n2^12|8|float32") is False
+    # absent entries can't be marked
+    assert st.mark_stale("stream:gone", "n2^12|8|float32") is False
+
+    misses_before = st.stats()["misses"]
+    assert st.lookup("stream:abc:cr512", "n2^12|8|float32") is None
+    assert st.stats()["misses"] == misses_before + 1
+
+    m = st.lookup("stream:abc:cr512", "n2^12|8|float32", include_stale=True)
+    from keystone_tpu.obs.store import is_stale
+
+    assert is_stale(m)
+    assert m["source"] == "stale:observed"
+    assert m["stale_reason"] == "cost_drift"
+    # original measurements survive for post-hoc inspection
+    assert m["rows_per_s"] == 1e5
+
+    # entries() skips stale by default (the knob rule's query surface)
+    assert list(st.entries(key_prefix="stream:")) == []
+    assert len(list(st.entries(key_prefix="stream:", include_stale=True))) == 1
+    # by_source surfaces the mark for check --store
+    assert st.by_source().get("stale:observed") == 1
+
+    # a fresh measurement overwrites the mark entirely
+    st.record("stream:abc:cr512", "n2^12|8|float32",
+              chunk_rows=512, rows_per_s=2e5)
+    fresh = st.lookup("stream:abc:cr512", "n2^12|8|float32")
+    assert fresh is not None and fresh["rows_per_s"] == 2e5
+    assert not is_stale(fresh)
+
+
+def test_stale_mark_persists_across_processes(tmp_path):
+    """A drift mark written by one process must gate a FRESH process's
+    lookups — the mark is provenance in the file, not process state."""
+    st = make_store(tmp_path)
+    st.record("autocache:abc", "n2^12", t0=0.1, t1=1e-5)
+    assert st.mark_stale("autocache:abc", "n2^12") is True
+
+    code = """
+import json, sys
+from keystone_tpu.obs.store import ProfileStore
+fp = {"jax": "test", "backend": "cpu", "device_kind": "virtual"}
+st = ProfileStore(sys.argv[1], fingerprint=fp)
+print(json.dumps({
+    "lookup": st.lookup("autocache:abc", "n2^12"),
+    "raw": st.lookup("autocache:abc", "n2^12", include_stale=True),
+}))
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code, st.path],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload["lookup"] is None
+    assert payload["raw"]["source"] == "stale:observed"

@@ -1,0 +1,111 @@
+"""``keystone-tpu explain`` (workflow/explain.py): the in-process flow —
+per-node ledger report with predictions and provenance, the seeded
+corruption helper, and the explain-grade optimizer stack. The full
+3-run CLI drift cycle (seeded fire → stale → re-measure) is gated by
+scripts/explain_smoke.sh in tier-1 CI."""
+
+import argparse
+import json
+import os
+
+import pytest
+
+from keystone_tpu.obs import cost
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory(tmp_path):
+    env_before = os.environ.get("KEYSTONE_PROFILE_STORE")
+    os.environ["KEYSTONE_PROFILE_STORE"] = str(tmp_path / "ps.jsonl")
+    cost.reset_cost_observatory()
+    yield
+    if env_before is not None:
+        os.environ["KEYSTONE_PROFILE_STORE"] = env_before
+    else:
+        os.environ.pop("KEYSTONE_PROFILE_STORE", None)
+    cost.set_cost_observatory(None)
+    cost.reset_cost_observatory()
+    from keystone_tpu.obs.store import set_store
+
+    set_store(None)
+
+
+def _args(**overrides):
+    base = dict(
+        pipeline="synthetic", rows=512, dim=32, classes=3, passes=2,
+        seed_drift=0.0, seed=0, out=None, as_json=False,
+    )
+    base.update(overrides)
+    return argparse.Namespace(**base)
+
+
+def test_explain_optimizer_swaps_profile_scales():
+    from keystone_tpu.workflow.autocache import AutoCacheRule
+    from keystone_tpu.workflow.explain import _explain_optimizer
+
+    stack = _explain_optimizer()
+    rules = [
+        r for b in stack.batches for r in b.rules
+        if isinstance(r, AutoCacheRule)
+    ]
+    assert len(rules) == 1
+    assert rules[0].profile_scales == (128, 512)
+
+
+def test_corrupt_store_predictions_scales_exactly_one_entry():
+    from keystone_tpu.obs.store import get_store
+    from keystone_tpu.workflow.explain import _corrupt_store_predictions
+
+    store = get_store()
+    base = cost.DriftSentinel.BASELINE_FIELD
+    store.record("autocache:small", "n2^9", t0=0.1, t1=1e-5,
+                 **{base: 0.01})
+    store.record("autocache:big", "n2^9", t0=0.2, t1=2e-5,
+                 **{base: 0.5})
+    assert _corrupt_store_predictions(10.0) == 1
+    # the LARGEST baseline was the target; the other survives intact
+    big = store.lookup("autocache:big", "n2^9")
+    assert big[base] == pytest.approx(0.05)
+    assert big["t0"] == pytest.approx(0.02)
+    small = store.lookup("autocache:small", "n2^9")
+    assert small[base] == pytest.approx(0.01)
+    # factor 1 / empty prefix are no-ops
+    assert _corrupt_store_predictions(1) == 0
+
+
+def test_explain_synthetic_reports_every_plan_node(tmp_path):
+    """One in-process explain run: JSON report lands with a ledger entry
+    per executed plan node, predictions + provenance on the compiled
+    ones, a calibrated roofline, and zero harvest compiles."""
+    from keystone_tpu.workflow.explain import explain_from_args
+
+    out = str(tmp_path / "explain.json")
+    rc = explain_from_args(_args(out=out, as_json=True))
+    assert rc == 0  # no drift on a fresh store
+    report = json.loads(open(out).read())
+    assert report["harvest_compiles"] == 0
+    assert report["roofline"]["peak_flops_per_s"] > 0
+    assert report["drift_events"] == []
+
+    nodes = report["nodes"]
+    labels = [n["node"] for n in nodes]
+    # the whole plan is in the ledger: data, chain, estimator, apply
+    assert any(label.startswith("Dataset") for label in labels)
+    assert any("BlockLeastSquares" in label or "StreamFit" in label
+               for label in labels)
+    compiled = [n for n in nodes if n.get("flops")]
+    assert compiled, labels
+    for node in compiled:
+        assert node["seconds"] >= 0
+        assert node.get("predicted_s") is not None
+        assert node.get("intensity") is not None
+        assert node.get("roofline") in ("compute-bound", "memory-bound")
+        assert node.get("lowering_digest")
+        prov = node["provenance"]
+        assert prov.get("model") in (
+            "autocache", "measured_knob", "solver_ladder", "roofline",
+        )
+        assert prov.get("computations"), node
+    # observatory state was restored for the rest of the process
+    # (explain enables it for its own run only)
+    assert cost.get_ledger().cursor() >= len(nodes)

@@ -322,3 +322,98 @@ def test_solver_block_env_pins_block_size(tmp_path, monkeypatch):
         assert out.get_operator(node).estimator.block_size == 512
     finally:
         linalg.set_solver_mode_override(None)
+
+
+# ----------------------------------------------------- stale winners (drift)
+
+
+def test_stale_winner_skipped_then_rerecorded(tmp_path, monkeypatch):
+    """The drift sentinel's staleness contract (docs/OBSERVABILITY.md
+    "Cost observatory"): a stale: winner must not be replayed; a fresh
+    re-measurement of the same key re-arms the override."""
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    shape = record_stream_obs(st, data, best_rows=1024)
+
+    # the winning entry drifts: marked stale → no override
+    assert st.mark_stale(f"stream:{chain_class(())}:cr1024", shape)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    # the stale 1024 winner is skipped; the surviving (worse-throughput)
+    # 256 observation becomes the defensible best
+    assert out.get_operator(node).chunk_rows == 256
+
+    # a completed fold re-records the key fresh → winner re-arms
+    st.record(f"stream:{chain_class(())}:cr1024", shape,
+              chunk_rows=1024, rows_per_s=5e5)
+    out2, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    assert out2.get_operator(node).chunk_rows == 1024
+
+
+def test_override_pins_prediction_for_the_cost_observatory(
+    tmp_path, monkeypatch
+):
+    """Every measured override carries its stored claim as a
+    predicted_cost (obs.cost.Prediction) so the perf ledger can join it
+    against the measured wall — calibrated for chunk-rows (exact key +
+    shape class), displayed-only for solver knobs (walls across widths
+    are incommensurable)."""
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    shape = record_stream_obs(st, data, best_rows=1024)
+    out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+    pred = out.get_operator(node).predicted_cost
+    assert pred is not None
+    assert pred.model == "measured_knob"
+    assert pred.key == f"stream:{chain_class(())}:cr1024"
+    assert pred.shape == shape
+    assert pred.rows_per_s == 5e5
+    assert pred.calibrated is True
+
+
+def test_stale_winner_skipped_in_fresh_process(tmp_path, monkeypatch):
+    """The stale mark is file provenance: a FRESH process planning from
+    the same store must also skip the marked winner."""
+    import json as _json
+    import os as _os
+    import subprocess
+    import sys
+
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    g, node, data = stream_graph()
+    shape = record_stream_obs(st, data, best_rows=1024)
+    assert st.mark_stale(f"stream:{chain_class(())}:cr1024", shape)
+
+    code = """
+import json, sys
+import numpy as np
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs.store import ProfileStore
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.knobs import MeasuredKnobRule
+from keystone_tpu.workflow.operators import DatasetOperator
+from keystone_tpu.workflow.streaming import StreamingFitOperator
+
+fp = {"jax": "test", "backend": "cpu", "device_kind": "virtual"}
+st = ProfileStore(sys.argv[1], fingerprint=fp)
+data = ArrayDataset(np.ones((4096, 8), dtype=np.float32))
+est = BlockLeastSquaresEstimator(512, num_iter=1, reg=1e-3)
+g = Graph()
+g, d = g.add_node(DatasetOperator(data), [])
+g, s = g.add_node(StreamingFitOperator(est, ()), [d])
+g, _ = g.add_sink(s)
+out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+print(json.dumps({"chunk_rows": out.get_operator(s).chunk_rows}))
+"""
+    env = {**_os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("KEYSTONE_STREAM_CHUNK_ROWS", None)
+    result = subprocess.run(
+        [sys.executable, "-c", code, st.path],
+        capture_output=True, text=True, check=True, env=env,
+    )
+    payload = _json.loads(result.stdout.strip().splitlines()[-1])
+    # the stale 1024 winner is skipped in the fresh process too
+    assert payload["chunk_rows"] == 256
