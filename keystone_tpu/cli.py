@@ -125,6 +125,53 @@ def add_refit_arguments(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def add_fit_arguments(parser: argparse.ArgumentParser) -> None:
+    """Flags for ``keystone-tpu fit`` — wired here (stdlib-only) so the
+    CLI's --help/--list paths never import the workflow package (whose
+    __init__ imports jax); ``workflow.fitcmd.fit_from_args`` consumes
+    the parsed namespace at dispatch time."""
+    parser.add_argument(
+        "--rows", type=int, default=1024, help="synthetic training rows",
+    )
+    parser.add_argument(
+        "--dim", type=int, default=16, help="synthetic feature width",
+    )
+    parser.add_argument(
+        "--classes", type=int, default=3, help="synthetic label width",
+    )
+    parser.add_argument(
+        "--chunk-rows", type=int, default=128,
+        help="streamed chunk rows (pinned so resume cursors align "
+        "across processes)",
+    )
+    parser.add_argument(
+        "--ckpt-chunks", type=int, default=None,
+        help="chunks between mid-fit checkpoint commits "
+        "(default KEYSTONE_STREAM_CKPT_CHUNKS; 0 disables)",
+    )
+    parser.add_argument("--reg", type=float, default=1e-3)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--store-dir", required=True,
+        help="checkpoint-store directory (resume entries + fitted "
+        "prefixes live here)",
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="write fitted predictions on the fixed probe batch here "
+        "(.npz; the smoke's parity artifact)",
+    )
+    parser.add_argument(
+        "--expect-resume", action="store_true",
+        help="exit 2 unless this fit resumed from a persisted cursor",
+    )
+    parser.add_argument(
+        "--drift-data", type=float, default=0.0,
+        help="perturb the training matrix by this constant (same shape, "
+        "different content — the seeded KV306 stale-resume case)",
+    )
+
+
 def add_explain_arguments(parser: argparse.ArgumentParser) -> None:
     """Flags for ``keystone-tpu explain`` — wired here (stdlib-only) so
     --help/--list never import the workflow package (whose __init__
@@ -434,6 +481,18 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_refit_arguments(refit_parser)
 
+    # Durable fits (docs/RELIABILITY.md "Durable fits"): one streamed
+    # fit with mid-fit cursor checkpoints; killed runs resume in a
+    # fresh process via the same command. The engine under
+    # scripts/elastic_smoke.sh. Stdlib-only flag wiring, same rule as
+    # tune.
+    fit_parser = sub.add_parser(
+        "fit",
+        help="durable streamed fit: mid-stream checkpoints, crash "
+        "resume (--expect-resume), KV306 stale-entry refusal",
+    )
+    add_fit_arguments(fit_parser)
+
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=getattr(logging, args.log_level.upper(), logging.INFO),
@@ -465,6 +524,10 @@ def main(argv: Optional[list] = None) -> int:
         print(
             f"{'refit':28s} continuous-refit loop: incremental retrain + "
             "shadow eval + auto-rollback"
+        )
+        print(
+            f"{'fit':28s} durable streamed fit: mid-stream checkpoints + "
+            "crash resume + KV306 stale-entry refusal"
         )
         return 0
 
@@ -518,6 +581,13 @@ def main(argv: Optional[list] = None) -> int:
 
         enable_persistent_cache()  # warm folds/warmups across runs
         return refit_from_args(args)
+
+    if args.workload == "fit":
+        from .utils.compilation_cache import enable_persistent_cache
+        from .workflow.fitcmd import fit_from_args
+
+        enable_persistent_cache()  # resumed processes re-use warm steps
+        return fit_from_args(args)
 
     if args.workload == "profile":
         from .obs.profile import profile_from_args

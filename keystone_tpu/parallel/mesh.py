@@ -109,6 +109,18 @@ def make_hybrid_mesh(
                 (REPLICA_AXIS, DATA_AXIS))
 
 
+def mesh_without(mesh: Mesh, shard_index: int) -> Mesh:
+    """The shrunken mesh after losing the device backing row-shard
+    ``shard_index``: a 1-D ``data`` mesh over the surviving devices (a
+    hybrid mesh flattens — after a loss the replica grouping is stale
+    anyway). The elastic streamed fold re-plans on this
+    (docs/RELIABILITY.md "Durable fits")."""
+    devices = [d for i, d in enumerate(mesh.devices.flat) if i != shard_index]
+    if not devices:
+        raise ValueError("cannot shrink a mesh below one device")
+    return make_mesh(devices=devices)
+
+
 def distributed_init(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

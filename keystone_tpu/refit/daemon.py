@@ -32,6 +32,12 @@ daemon thread; a crashing round lands in the recovery ledger
 ``max_consecutive_failures`` rounds fail back to back
 (``refit_daemon_failed``) — a poisoned feed must not spin forever.
 
+Durability (docs/REFIT.md "Durable rounds"): with a store attached,
+each round journals its drained rows + pre-fold state before folding
+and advances the phase as it commits, so a kill anywhere mid-round
+replays from the journal — exactly once — instead of losing rows the
+tap no longer holds.
+
 Chaos surface (docs/RELIABILITY.md): ``refit.fold`` faults the
 incremental fold, ``refit.candidate`` intercepts the candidate AFTER
 shadow eval and before publish (a ``corrupt`` spec here is the seeded
@@ -115,6 +121,10 @@ class RefitConfig:
     #: supervised-loop restart budget: this many back-to-back failed
     #: rounds stops the daemon loudly.
     max_consecutive_failures: int = 5
+    #: round-journal replay budget: a journaled batch that fails this
+    #: many replays is DISCARDED (refit_journal_discard) — a poisoned
+    #: drain must cost one batch, never wedge the daemon forever.
+    max_journal_replays: int = 3
     #: persisted-state key in the checkpoint store.
     state_key: str = "refit-state"
 
@@ -190,6 +200,30 @@ class RefitDaemon:
     def _run_once_locked(self) -> str:
         self._rounds += 1
         round_index = self._rounds
+        journal = self._load_journal()
+        if journal is not None:
+            # A previous round died mid-flight (kill between drain and
+            # outcome). Its rows left the tap when they were drained —
+            # the journal, not the tap, is where they survive. The
+            # replay budget is persisted BEFORE the attempt (a crash
+            # mid-replay counts), so a batch whose replay fails
+            # deterministically is dropped after max_journal_replays
+            # instead of wedging every future round (and every restarted
+            # process) on the same poison.
+            attempts = int(journal.get("attempts", 0)) + 1
+            if attempts > self.config.max_journal_replays:
+                self._clear_journal()
+                get_recovery_log().record(
+                    "refit_journal_discard",
+                    self.config.name,
+                    attempts=attempts - 1,
+                    rows=int(journal["x"].shape[0]),
+                    round=round_index,
+                )
+            else:
+                journal["attempts"] = attempts
+                self._save_journal(journal)
+                return self._resume_from_journal(journal, round_index)
         depth = self.tap.depth()
         if depth < self.config.min_rows:
             get_recovery_log().record(
@@ -206,19 +240,120 @@ class RefitDaemon:
         if drained is None:  # raced another drainer
             return self._outcome("skipped_nodata", round_index, rows=0)
         x, y = drained
+        return self._round_body(x, y, round_index)
+
+    # -------------------------------------------------------- round journal
+    #
+    # Durable refit rounds (docs/REFIT.md, docs/RELIABILITY.md "Durable
+    # fits"): the drained rows plus the PRE-fold state are journaled in
+    # the checkpoint store before the fold runs, and the journal's phase
+    # advances to "folded" only after the folded state is persisted — so
+    # a SIGKILL anywhere inside a round replays it exactly once from the
+    # journal instead of losing the drained rows, and a kill between the
+    # state save and the phase advance rewinds to the pre-fold snapshot
+    # (re-folding the same rows into already-extended statistics would
+    # double-count them).
+
+    def _journal_key(self) -> str:
+        import hashlib
+
+        return hashlib.sha1(
+            f"keystone-refit-journal:{self.config.name}".encode()
+        ).hexdigest()
+
+    def _save_journal(self, payload: Dict[str, Any]) -> None:
+        if self.store is not None:
+            self.store.save(None, payload, digest=self._journal_key())
+
+    def _load_journal(self) -> Optional[Dict[str, Any]]:
+        if self.store is None:
+            return None
+        from ..reliability.checkpoint import _MISS
+
+        value = self.store.lookup(None, digest=self._journal_key())
+        if value is _MISS or not isinstance(value, dict):
+            return None
+        return value if value.get("phase") in ("drained", "folded") else None
+
+    def _clear_journal(self) -> None:
+        if self.store is not None:
+            self.store.delete(self._journal_key())
+
+    def _resume_from_journal(
+        self, journal: Dict[str, Any], round_index: int
+    ) -> str:
+        phase = str(journal.get("phase"))
+        get_recovery_log().record(
+            "refit_journal_resume",
+            self.config.name,
+            phase=phase,
+            journaled_round=int(journal.get("round", 0)),
+            round=round_index,
+            rows=int(journal["x"].shape[0]),
+        )
+        _names.metric(_names.DURABLE_RESUMES).inc(kind="refit_journal")
+        if phase == "drained":
+            # The fold may have half-applied (or fully applied but died
+            # before the phase advanced): rewind to the journaled
+            # pre-fold snapshot so the re-fold is exactly once.
+            self._state = journal.get("state_before")
+        return self._round_body(
+            journal["x"], journal["y"], round_index,
+            skip_fold=(phase == "folded"),
+            attempts=int(journal.get("attempts", 0)),
+        )
+
+    def _round_body(
+        self, x: np.ndarray, y: np.ndarray, round_index: int,
+        skip_fold: bool = False, attempts: int = 0,
+    ) -> str:
         n = x.shape[0]
         eval_n = max(min(int(n * self.config.eval_fraction), n - 1), 1)
         train_x, train_y = x[: n - eval_n], y[: n - eval_n]
         eval_x, eval_y = x[n - eval_n :], y[n - eval_n :]
 
+        # The journal commits BEFORE anything in the round can die: from
+        # here on, a kill replays these rows from the store instead of
+        # losing them with the drain.
+        # attempts > 0 means this IS a journal replay: the store already
+        # holds a byte-identical payload (saved with the bumped counter
+        # moments ago), so only fresh rounds pay the drained-batch write.
+        if not skip_fold and self.store is not None and attempts == 0:
+            self._save_journal(
+                {
+                    "phase": "drained",
+                    "round": round_index,
+                    "x": x,
+                    "y": y,
+                    "state_before": self._state,
+                    "attempts": attempts,
+                }
+            )
+
         # ---------------------------------------------------- incremental fold
         with _spans.span("refit:fold", rows=int(train_x.shape[0])):
             probe("refit.fold")
             t_fold = time.perf_counter()
-            candidate = self._fold(train_x, train_y)
-            self._state = self.estimator.export_stream_state()
-            if self.store is not None and self._state is not None:
-                save_stream_state(self.store, self.config.state_key, self._state)
+            if skip_fold:
+                # Journal says the fold already committed: rebuild the
+                # candidate from the persisted statistics alone.
+                candidate = self.estimator.finish_from_state(self._state)
+            else:
+                candidate = self._fold(train_x, train_y)
+                self._state = self.estimator.export_stream_state()
+                if self.store is not None and self._state is not None:
+                    save_stream_state(
+                        self.store, self.config.state_key, self._state
+                    )
+                    self._save_journal(
+                        {
+                            "phase": "folded",
+                            "round": round_index,
+                            "x": x,
+                            "y": y,
+                            "attempts": attempts,
+                        }
+                    )
             fold_s = time.perf_counter() - t_fold
         self._m_fold_s.observe(fold_s)
         self._m_state_rows.set(self.state_rows())
@@ -351,6 +486,9 @@ class RefitDaemon:
         return "rolled_back"
 
     def _outcome(self, outcome: str, round_index: int, **detail) -> str:
+        # The round reached a decision: retire its journal (a no-op when
+        # none was written — skips journal before the fold phase).
+        self._clear_journal()
         self._m_rounds.inc(outcome=outcome)
         self.outcomes.append(
             {"round": round_index, "outcome": outcome, **detail}

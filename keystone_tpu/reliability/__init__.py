@@ -13,6 +13,11 @@ makes failure handling explicit and test-injectable:
                        OOM, annotate results with what was given up.
 - :mod:`checkpoint`  — persist fitted prefix state; a killed run resumes
                        past already-fit estimators in a fresh process.
+- :mod:`durable`     — the mid-STREAM resume contract: `ResumeEntry` /
+                       `StreamCursor` snapshots committed every K chunks
+                       of a `fit_stream`, plus `ShardLossError` — the
+                       shard-loss elasticity signal (docs/RELIABILITY.md
+                       "Durable fits").
 - :mod:`faultinject` — deterministic fault injection for tests.
 - :mod:`recovery`    — the process-wide ledger of how a run survived.
 
@@ -24,6 +29,16 @@ See docs/RELIABILITY.md for semantics and examples.
 
 from .checkpoint import CheckpointStore, enable_checkpointing, prefix_digest
 from .degrade import DegradationLadder, LadderExhausted, halving_rungs
+from .durable import (
+    DurableFold,
+    ResumeEntry,
+    ShardLossError,
+    StreamCursor,
+    clear_resume_entry,
+    load_resume_entry,
+    resume_key,
+    save_resume_entry,
+)
 from .errors import (
     CLASSIFICATION_TABLE,
     CorruptRecordError,
@@ -53,6 +68,7 @@ __all__ = [
     "Deadline",
     "DeadlineExceeded",
     "DegradationLadder",
+    "DurableFold",
     "ErrorClass",
     "FaultInjector",
     "FaultSpec",
@@ -60,9 +76,16 @@ __all__ = [
     "InjectedTransient",
     "LadderExhausted",
     "RecoveryLog",
+    "ResumeEntry",
     "RetryPolicy",
+    "ShardLossError",
+    "StreamCursor",
     "classify_error",
+    "clear_resume_entry",
     "enable_checkpointing",
+    "load_resume_entry",
+    "resume_key",
+    "save_resume_entry",
     "get_recovery_log",
     "halving_rungs",
     "injected",

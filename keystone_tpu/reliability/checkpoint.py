@@ -225,6 +225,16 @@ class CheckpointStore:
         _store_counters()[2].inc()
         return True
 
+    def delete(self, digest: str) -> bool:
+        """Remove an entry by digest (used by the durable-fit layer to
+        retire resume entries and round journals once the work they
+        describe completed). Missing entries are a no-op."""
+        try:
+            os.unlink(self._entry(digest))
+            return True
+        except OSError:
+            return False
+
     def get_or_compute(
         self, prefix: Any, thunk: Callable[[], Any], label: str = "node"
     ) -> Any:

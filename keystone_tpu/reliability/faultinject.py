@@ -214,6 +214,9 @@ KNOWN_PROBE_SITES = frozenset(
         "serving.worker.request",      # serving/worker.py: request handling
         "serving.worker.heartbeat",    # serving/worker.py: heartbeat wire
         "streaming.chunk",             # workflow/streaming.py: per-chunk dispatch
+        "parallel.shard_loss",         # workflow/streaming.py: sharded chunk plan —
+                                       # a fault here models a device lost from the
+                                       # mesh; the elastic fold recovers, never raises
         "refit.fold",                  # refit/daemon.py: incremental fold
         "refit.candidate",             # refit/daemon.py: candidate, post-eval
         "refit.publish",               # refit/publish.py: registry/fleet swap

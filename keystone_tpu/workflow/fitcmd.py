@@ -1,0 +1,136 @@
+"""``keystone-tpu fit``: one durable streamed fit, end to end.
+
+The CLI face of the durable-fit layer (docs/RELIABILITY.md "Durable
+fits") and the engine under ``scripts/elastic_smoke.sh``: build a
+deterministic synthetic ``featurize-chain → LinearMapEstimator``
+pipeline, attach a :class:`~keystone_tpu.reliability.checkpoint.
+CheckpointStore`, and fit through the planned streaming path with
+mid-fit cursor checkpoints armed.
+
+The durability loop the smoke drives across PROCESSES:
+
+1. run with ``KEYSTONE_FAULT_SPECS`` carrying a ``kill`` at
+   ``streaming.chunk`` call k — a real SIGKILL mid-stream; the store
+   holds the last committed cursor;
+2. re-run the same command in a fresh process — the re-planned pipeline
+   finds the resume entry, validates fingerprints (KV306), seeds the
+   fold, and re-ingests only chunks past the cursor;
+3. ``--expect-resume`` asserts step 2 actually resumed (exit 2 when it
+   silently refit from scratch), and ``--out`` writes the fitted
+   predictions on a fixed probe batch so the smoke can check parity
+   against an uninterrupted reference numerically.
+
+``--drift-data`` perturbs the training matrix while keeping its shape —
+the seeded KV306 case: same resume key, different content digest, and
+under ``KEYSTONE_VERIFY=strict`` the refusal exits non-zero.
+
+Everything is deterministic in ``--seed``; the probe batch is drawn
+from its own fixed stream so every invocation scores the same rows.
+Prints one ``FIT_STATS:`` JSON line (the smoke-script contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+from ..workflow.pipeline import BatchTransformer
+
+
+class FitDemoScaler(BatchTransformer):
+    """A real (content-fingerprinted) featurize-chain member: affine
+    rescale. Module-level so its prefix/chain digests are process-stable
+    — the property crash-resume keys on."""
+
+    def __init__(self, scale: float = 1.0, shift: float = 0.0):
+        self.scale = float(scale)
+        self.shift = float(shift)
+
+    def apply_arrays(self, x):
+        return x * self.scale + self.shift
+
+
+def fit_from_args(args) -> int:
+    """Run the durable synthetic fit; see module docstring."""
+    from ..data.dataset import ArrayDataset
+    from ..ops.learning.linear import LinearMapEstimator
+    from ..reliability import enable_checkpointing, faultinject
+    from ..reliability.recovery import get_recovery_log
+    from ..workflow.streaming import last_stream_report
+
+    # Chunk geometry is a plan knob: pin it for every process of the
+    # smoke so resume cursors align (the entry point owns its env, same
+    # as --device-count owns XLA_FLAGS).
+    os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = str(args.chunk_rows)
+    if args.ckpt_chunks is not None:
+        os.environ["KEYSTONE_STREAM_CKPT_CHUNKS"] = str(args.ckpt_chunks)
+    # Chaos crosses the process boundary through the environment — the
+    # same door the serving workers use.
+    faultinject.install_from_env()
+
+    rng = np.random.default_rng(args.seed)
+    x = rng.normal(size=(args.rows, args.dim)).astype(np.float32)
+    w = rng.normal(size=(args.dim, args.classes)).astype(np.float32)
+    y = (x @ w + 0.01 * rng.normal(size=(args.rows, args.classes))).astype(
+        np.float32
+    )
+    if args.drift_data:
+        # Same shape, same dtype, different CONTENT: the stale-resume
+        # hazard KV306 exists for.
+        x = x + np.float32(args.drift_data)
+    probe = np.random.default_rng(12345).normal(
+        size=(64, args.dim)
+    ).astype(np.float32)
+
+    enable_checkpointing(args.store_dir)
+    pipeline = (
+        FitDemoScaler(scale=2.0, shift=0.5)
+        .to_pipeline()
+        .then_label_estimator(
+            LinearMapEstimator(reg=args.reg),
+            ArrayDataset(x),
+            ArrayDataset(y),
+        )
+    )
+    fitted = pipeline.fit()
+    preds = np.asarray(fitted.apply_batch(ArrayDataset(probe)).data)
+    if args.out:
+        np.savez(args.out, preds=preds)
+
+    report = last_stream_report()
+    ledger = get_recovery_log()
+    stats: Dict[str, Any] = {
+        "rows": args.rows,
+        "dim": args.dim,
+        "chunk_rows": args.chunk_rows,
+        "streamed": report is not None,
+        "preds_norm": float(np.linalg.norm(preds)),
+    }
+    if report is not None:
+        stats.update(
+            chunks=report.chunks,
+            chunks_total=-(-args.rows // report.chunk_rows),
+            shards=report.shards,
+            checkpoints=report.checkpoints,
+            resumed_from_chunk=report.resumed_from_chunk,
+            reingested_chunks=report.reingested_chunks,
+            shard_losses=report.shard_losses,
+        )
+    stats["ledger_kinds"] = sorted(
+        {
+            e.kind
+            for e in ledger.events()
+            if e.kind.startswith(("stream_", "shard_", "resume_", "checkpoint_"))
+        }
+    )
+    print("FIT_STATS:" + json.dumps(stats))
+
+    if args.expect_resume and (
+        report is None or report.resumed_from_chunk is None
+    ):
+        print("fit: --expect-resume set but the fit did not resume")
+        return 2
+    return 0

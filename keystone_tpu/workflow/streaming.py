@@ -227,6 +227,14 @@ class StreamReport:
     shards: int = 1
     mesh_shape: Tuple[int, ...] = ()
     collective_bytes: int = 0
+    #: Durable-fit evidence (docs/RELIABILITY.md "Durable fits"):
+    #: mid-stream checkpoints committed, the absolute chunk a crashed
+    #: fit resumed from (None = fresh), chunks re-ingested by resume or
+    #: shard-loss recovery, and device losses absorbed mid-stream.
+    checkpoints: int = 0
+    resumed_from_chunk: Optional[int] = None
+    reingested_chunks: int = 0
+    shard_losses: int = 0
     #: perf_counter at fold start — the event lists below are offsets
     #: from this, so exporters can place chunk slices on a session
     #: timeline (obs/export.py Perfetto view).
@@ -420,6 +428,23 @@ def _tree_nbytes(tree) -> int:
     )
 
 
+def _stack_carry(carry, shards: int, sharding):
+    """Per-device carry blocks: a leading ``(shards,)`` axis sharded over
+    the row axes. Shard 0 seeds the estimator's initial carry (or a
+    salvaged shard-loss merge), the rest start zero — exact for the
+    additive accumulation the fit_stream protocol is (final carry =
+    seed + Σ partials, summed once at finish)."""
+    import jax
+    import jax.numpy as jnp
+
+    def stack(a):
+        a = jnp.asarray(a)
+        z = jnp.zeros((shards,) + tuple(a.shape), a.dtype)
+        return jax.device_put(z.at[0].set(a), sharding)
+
+    return jax.tree_util.tree_map(stack, carry)
+
+
 def _labels_host(labels: Dataset):
     """Labels as one host (n, k) float-ready matrix. Labels are O(n·k) —
     'the full feature matrix never materializes' is about features; a
@@ -486,6 +511,10 @@ class ChunkStream:
         if self.partition is not None:
             s = self.partition.shards
             self.chunk_rows = -(-self.chunk_rows // s) * s
+        #: Durability plan (reliability/durable.py DurableFold), armed by
+        #: the streaming operator when a checkpoint store is attached.
+        #: None = today's fold, byte for byte.
+        self.durable = None
 
     def feature_aval(self):
         """Shape/dtype of one FEATURIZED chunk (shape-only trace of the
@@ -534,24 +563,20 @@ class ChunkStream:
         carry = init_fn(feat_aval, y_spec)
 
         part = self.partition
+        durable = self.durable
         sharding = None
-        if part is not None:
-            import jax.numpy as jnp
+        # Shard-loss recovery must be able to re-add the fold's seed when
+        # the device holding carry block 0 dies: keep the PRE-STACK device
+        # carry alive (stack() copies, nothing donates it) and fetch it to
+        # host only if that loss actually happens.
+        seed_carry_dev = carry if part is not None else None
+        attempt_seed_host = None
 
+        if part is not None:
             from ..parallel.partitioner import NamedShardingCache
 
             sharding = NamedShardingCache.get(part.mesh, part.mesh_axes)
-
-            # Per-device carry blocks: a leading (shards,) axis sharded
-            # over the row axes. Shard 0 seeds the estimator's initial
-            # carry, the rest start zero — exact for the additive
-            # accumulation the fit_stream protocol is (final carry =
-            # init + Σ partials, summed once at finish).
-            def stack(a):
-                z = jnp.zeros((part.shards,) + tuple(a.shape), a.dtype)
-                return jax.device_put(z.at[0].set(a), sharding)
-
-            carry = jax.tree_util.tree_map(stack, carry)
+            carry = _stack_carry(carry, part.shards, sharding)
 
         _quiet_unused_donation_warnings()  # carries are donated each step
         step, traces = _shared_step_jit(self.members, step_fn, part)
@@ -563,24 +588,9 @@ class ChunkStream:
         windows = [
             (s, min(s + chunk_rows, n)) for s in range(0, n, chunk_rows)
         ]
-
-        def prepare(window):
-            start, stop = window
-            # fetch_rows runs inside the prefetch workers — this is the
-            # decode/stack work being overlapped with device compute.
-            x = data.fetch_rows(start, stop)
-            x = jax.tree_util.tree_map(
-                lambda a: _pad_narrow(a, chunk_rows), x
-            )
-            rows = stop - start
-            y = y_host[start:stop]
-            if rows < chunk_rows:  # tail chunk: pad to the compiled shape
-                y = np.concatenate(
-                    [y, np.zeros((chunk_rows - rows,) + y.shape[1:], y.dtype)]
-                )
-            mask = np.zeros((chunk_rows, 1), np.float32)
-            mask[:rows] = 1.0
-            return x, y, mask, rows
+        start_chunk = (
+            min(durable.start_chunk, len(windows)) if durable is not None else 0
+        )
 
         report = StreamReport(
             chunk_rows=chunk_rows,
@@ -589,21 +599,66 @@ class ChunkStream:
             shards=part.shards if part is not None else 1,
             mesh_shape=tuple(part.mesh_shape) if part is not None else (),
         )
+        if start_chunk:
+            # Crash-resume: chunks before the cursor live in the seeded
+            # carry already — only the suffix is re-ingested.
+            report.resumed_from_chunk = start_chunk
+            report.reingested_chunks = len(windows) - start_chunk
+            _names.metric(_names.DURABLE_REINGESTED_CHUNKS).inc(
+                report.reingested_chunks
+            )
         data_shape = _store.dataset_shape_class(data)
         chunks_c = _names.metric(_names.STREAM_CHUNKS)
         bytes_c = _names.metric(_names.STREAM_BYTES)
         from ..data.ingest import PrefetchQueue
+        from ..reliability.durable import ShardLossError, shard_loss_index
 
-        queue = PrefetchQueue(
-            iter(windows),
-            prepare,
-            depth=self.prefetch,
-            workers=min(self.workers, self.prefetch),
-            size_of=lambda c: _tree_nbytes(c[0]) + c[1].nbytes,
-        )
+        def make_prepare(padded_rows):
+            def prepare(window):
+                start, stop = window
+                # fetch_rows runs inside the prefetch workers — this is
+                # the decode/stack work being overlapped with device
+                # compute.
+                x = data.fetch_rows(start, stop)
+                x = jax.tree_util.tree_map(
+                    lambda a: _pad_narrow(a, padded_rows), x
+                )
+                rows = stop - start
+                y = y_host[start:stop]
+                if rows < padded_rows:  # tail chunk: pad to compiled shape
+                    y = np.concatenate(
+                        [y, np.zeros((padded_rows - rows,) + y.shape[1:], y.dtype)]
+                    )
+                mask = np.zeros((padded_rows, 1), np.float32)
+                mask[:rows] = 1.0
+                return x, y, mask, rows
+
+            return prepare
+
         in_hand_peak = 0
+        queue_stall_s = 0.0
+        queue_peak = 0
         t0 = time.perf_counter()
         report.t0_s = t0
+
+        # ---- durable/elastic bookkeeping --------------------------------
+        # rows_folded: ABSOLUTE logical rows fully dispatched (a resumed
+        # fold starts at the cursor's count) — what a committed cursor
+        # records. dispatched indexes attempt_windows (the ordered
+        # PrefetchQueue guarantees windows dispatch in source order);
+        # folded_log keeps each window's fold-time geometry so shard-loss
+        # salvage can slice exactly the lost device's rows back out.
+        rows_folded = durable.resume_rows if durable is not None else 0
+        dispatched = 0
+        last_committed = -1
+        # Recovery windows break the canonical chunk-prefix ordering a
+        # cursor describes, so after a shard loss mid-fit checkpoints
+        # suspend for the remainder of the fold (docs/RELIABILITY.md).
+        ckpt_suspended = False
+        folded_log: List[Tuple[int, int, int, int]] = []
+        attempt_windows: List[Tuple[int, int]] = windows[start_chunk:]
+        steady_accum = 0
+        attempt_base: Optional[int] = None
 
         # The loop below IS stream_pipelined — the same engine that runs
         # the flagship's per-bucket encode — with the carry threaded and
@@ -636,9 +691,62 @@ class ChunkStream:
             bytes_c.inc(nbytes)
             return dev
 
+        def commit_checkpoint():
+            # Commit-before-continue barrier: the carry is host-fetched
+            # (device_get blocks until the last dispatch retired) and the
+            # atomic store write completes BEFORE the next chunk's
+            # dispatch may donate the buffer — a persisted carry is never
+            # stale (the linalg.donation_safe discipline applied to
+            # persistence).  # keystone: allow-sync
+            host = jax.device_get(carry)
+            if part is not None:
+                # Per-shard partials merge via the additive contract into
+                # a mesh-INDEPENDENT snapshot: resume may re-plan on any
+                # mesh shape. Operates on the already-fetched HOST tree,
+                # never a device array.  # keystone: allow-sync
+                host = jax.tree_util.tree_map(
+                    lambda a: np.asarray(a).sum(axis=0), host
+                )
+            ok = durable.commit(
+                tuple(
+                    np.asarray(a)  # host leaves  # keystone: allow-sync
+                    for a in jax.tree_util.tree_leaves(host)
+                ),
+                chunk_index=start_chunk + dispatched,
+                rows_consumed=rows_folded,
+                chunk_rows=chunk_rows,
+                mesh_shape=tuple(part.mesh_shape) if part is not None else (),
+                shards=part.shards if part is not None else 1,
+            )
+            if ok:
+                report.checkpoints += 1
+
         def compute(staged_chunk, _chunk):
-            nonlocal carry
+            nonlocal carry, dispatched, rows_folded, last_committed
             x_dev, y_dev, mask_dev, _rows = staged_chunk
+            if (
+                durable is not None
+                and durable.ckpt_every > 0
+                and not ckpt_suspended
+                and dispatched > 0
+                and dispatched % durable.ckpt_every == 0
+                and dispatched != last_committed
+            ):
+                last_committed = dispatched
+                commit_checkpoint()
+            if part is not None:
+                try:
+                    probe("parallel.shard_loss")
+                except Exception as exc:
+                    # Any injected fault at this site models the runtime
+                    # observing a device gone from the mesh before this
+                    # chunk could dispatch — the elastic recovery below
+                    # owns it.
+                    raise ShardLossError(
+                        shard_loss_index(part.shards),
+                        start_chunk + dispatched,
+                        part.shards,
+                    ) from exc
             probe("streaming.chunk")
             if not report.chunks and _cost.current_frame() is not None:
                 # Cost-observatory note, once per fold: avals (not the
@@ -656,6 +764,12 @@ class ChunkStream:
             report.chunks += 1
             if report.chunks == 1:
                 report.compiles_first_chunk = len(traces)
+            w = attempt_windows[dispatched]
+            folded_log.append(
+                (w[0], w[1], part.shards if part is not None else 1, chunk_rows)
+            )
+            dispatched += 1
+            rows_folded += _rows
             return probe_out
 
         def consume(probe_out, _chunk):
@@ -670,10 +784,55 @@ class ChunkStream:
                 "stream:fold", chunks=len(windows), chunk_rows=chunk_rows,
                 shards=report.shards,
             ):
-                stream_pipelined(
-                    queue, stage=stage, compute=compute, consume=consume,
-                    prefetch=1,
-                )
+                while True:
+                    queue = PrefetchQueue(
+                        iter(attempt_windows),
+                        make_prepare(chunk_rows),
+                        depth=self.prefetch,
+                        workers=min(self.workers, self.prefetch),
+                        size_of=lambda c: _tree_nbytes(c[0]) + c[1].nbytes,
+                    )
+                    try:
+                        stream_pipelined(
+                            queue, stage=stage, compute=compute,
+                            consume=consume, prefetch=1,
+                        )
+                    except ShardLossError as loss:
+                        # Join this attempt's prefetch workers BEFORE
+                        # salvage (and before ANY exception leaves the
+                        # fold — the finally below covers the abort
+                        # paths): an abandoned fold must never leak
+                        # decode threads.
+                        queue.close()
+                        if report.chunks:
+                            prev_base = (
+                                report.compiles_first_chunk
+                                if attempt_base is None
+                                else attempt_base
+                            )
+                            steady_accum += len(traces) - prev_base
+                        (
+                            part, sharding, carry, step, traces,
+                            attempt_windows, chunk_rows, attempt_seed_host,
+                        ) = self._salvage_shard_loss(
+                            loss, carry, part, step_fn, seed_carry_dev,
+                            attempt_seed_host, folded_log, attempt_windows,
+                            dispatched, chunk_rows, report,
+                        )
+                        # A loss before ANY chunk folded means the next
+                        # attempt's first chunk IS the fold's first chunk
+                        # — leave the baseline to compiles_first_chunk or
+                        # its compiles would double-count as steady-state.
+                        attempt_base = len(traces) if report.chunks else None
+                        folded_log = []
+                        dispatched = 0
+                        ckpt_suspended = True
+                        continue
+                    finally:
+                        queue.close()
+                        queue_stall_s += queue.stall_s
+                        queue_peak = max(queue_peak, queue.peak_live_bytes)
+                    break
                 if part is not None:
                     # THE cross-shard collective of the whole fit: sum
                     # the per-device partial statistics once, at finish
@@ -700,35 +859,191 @@ class ChunkStream:
                             "fit_stream", n, len(windows) * chunk_rows
                         )
         finally:
-            queue.close()
-            report.stall_s = queue.stall_s
-            report.host_buffer_peak_bytes = (
-                queue.peak_live_bytes + in_hand_peak
+            report.stall_s = queue_stall_s
+            report.host_buffer_peak_bytes = queue_peak + in_hand_peak
+            prev_base = (
+                report.compiles_first_chunk
+                if attempt_base is None
+                else attempt_base
             )
             report.compiles_steady_state = (
-                len(traces) - report.compiles_first_chunk
+                steady_accum + len(traces) - prev_base
             )
             _publish_report(report)
+
+        if durable is not None:
+            # The fit completed: a resume entry pointing into its middle
+            # must not outlive it.
+            durable.complete()
 
         # A COMPLETED fold is a knob observation: remember what this
         # chunk size achieved on this data shape, so MeasuredKnobRule can
         # prefer the best recorded chunk_rows next plan (a failed fold
-        # recorded nothing — its throughput would be a lie).
-        if report.chunks == len(windows):
+        # recorded nothing — its throughput would be a lie; a resumed or
+        # shard-loss-recovered fold measured recovery, not steady state).
+        if (
+            report.chunks == len(windows)
+            and report.resumed_from_chunk is None
+            and not report.shard_losses
+        ):
             self._record_observation(report, data_shape)
-        if report.compute_done_t:
+        if (
+            report.compute_done_t
+            and report.resumed_from_chunk is None
+            and not report.shard_losses
+        ):
             # Achieved throughput to the enclosing harvest frame: a
             # rows/s-denominated prediction (the measured-knob chunk
             # winner) is drift-scored in its own unit (obs/cost.py).
+            # Resumed/recovered folds measured recovery, not steady
+            # state — feeding suffix-only walls against full-dataset
+            # rows would inflate rows/s and mis-score the drift
+            # sentinel (same guard as _record_observation).
             wall = max(report.compute_done_t[-1], 1e-9)
             _cost.note_stream_result(report.num_examples / wall, n)
 
         info = {
-            "num_examples": n,
+            # Rows THIS fold absorbed: a resumed fold re-ingests only the
+            # suffix past the cursor — the cursor's rows already live in
+            # the seeding state, and estimators add state.num_examples.
+            "num_examples": n - (
+                durable.resume_rows if durable is not None else 0
+            ),
             "chunks": report.chunks,
             "report": report,
         }
         return carry, info
+
+    def _salvage_shard_loss(
+        self,
+        loss,
+        carry,
+        part,
+        step_fn,
+        seed_carry_dev,
+        attempt_seed_host,
+        folded_log,
+        attempt_windows,
+        dispatched,
+        chunk_rows,
+        report,
+    ):
+        """Absorb a mid-stream device loss and hand back the context for
+        the next fold attempt.
+
+        The lost device's carry block is gone; everything else survives:
+        the other shards' partials merge via the additive state contract
+        into one host carry, and — when the dead shard was block 0, which
+        carries the fold's SEED (the estimator's init or a resume state)
+        — the host-side seed copy is added back. The rows only the lost
+        shard had folded (its row slice of every chunk dispatched this
+        attempt, per ``folded_log``'s geometry) become recovery windows,
+        re-ingested ahead of the untouched remainder. The Partitioner is
+        re-consulted on the shrunken mesh; an ineligible decision (down
+        to one device) continues single-device — elasticity is never an
+        error (docs/RELIABILITY.md "Durable fits").
+        """
+        import jax
+        import numpy as np
+
+        from ..parallel.mesh import mesh_without
+        from ..parallel.partitioner import (
+            NamedShardingCache,
+            Partitioner,
+            record_decision,
+        )
+        from ..reliability.recovery import get_recovery_log
+
+        label = f"fit_stream[{len(self.members)}ops]"
+        lost, old_shards = loss.lost_shard, part.shards
+        get_recovery_log().record(
+            "shard_loss",
+            label,
+            lost_shard=lost,
+            shards=old_shards,
+            chunk_index=loss.chunk_index,
+        )
+        _names.metric(_names.DURABLE_SHARD_LOSSES).inc()
+        report.shard_losses += 1
+
+        # Surviving per-shard partials, merged once on host (O(d²) — the
+        # same additive algebra the finish-time reduce runs).
+        # keystone: allow-sync
+        host_blocks = jax.device_get(carry)
+
+        def merge(a):
+            # Already device_get above — host data.  # keystone: allow-sync
+            a = np.asarray(a)
+            keep = [a[i] for i in range(old_shards) if i != lost]
+            return np.sum(np.stack(keep), axis=0)
+
+        surviving = jax.tree_util.tree_map(merge, host_blocks)
+        if lost == 0:
+            # Block 0 carried the fold's seed; it survives on the host.
+            if attempt_seed_host is None:
+                # keystone: allow-sync
+                attempt_seed_host = jax.device_get(seed_carry_dev)
+            surviving = jax.tree_util.tree_map(
+                lambda s, a: np.asarray(s) + np.asarray(a),
+                surviving,
+                attempt_seed_host,
+            )
+
+        # Rows only the lost shard had absorbed: shard i held padded rows
+        # [i·rps, (i+1)·rps) of each chunk, so the lost LOGICAL rows of a
+        # window (s, e) are the contiguous [s+lost·rps, min(s+(lost+1)·rps, e)).
+        recovery: List[Tuple[int, int]] = []
+        for (s, e, shards_f, cr_f) in folded_log:
+            rps = cr_f // shards_f
+            lo = s + lost * rps
+            hi = min(s + (lost + 1) * rps, e)
+            if lo < hi:
+                recovery.append((lo, hi))
+        remaining = list(attempt_windows[dispatched:])
+
+        decision = Partitioner(mesh=mesh_without(part.mesh, lost)).decide_stream(
+            label, chunk_rows, rows=self.num_examples, record=False
+        )
+        # Metrics yes, plan report no: the report is documented as "the
+        # last PLAN's decisions" and a mid-fold re-decision is runtime.
+        record_decision(decision, to_report=False)
+
+        if decision.eligible:
+            new_part = decision
+            new_chunk_rows = decision.chunk_rows or chunk_rows
+            sharding = NamedShardingCache.get(new_part.mesh, new_part.mesh_axes)
+            carry = _stack_carry(surviving, new_part.shards, sharding)
+        else:
+            import jax.numpy as jnp
+
+            new_part, sharding = None, None
+            new_chunk_rows = chunk_rows
+            carry = jax.tree_util.tree_map(jnp.asarray, surviving)
+        step, traces = _shared_step_jit(self.members, step_fn, new_part)
+        report.shards = new_part.shards if new_part is not None else 1
+        report.mesh_shape = (
+            tuple(new_part.mesh_shape) if new_part is not None else ()
+        )
+        report.reingested_chunks += len(recovery)
+        _names.metric(_names.DURABLE_REINGESTED_CHUNKS).inc(len(recovery))
+        _names.metric(_names.DURABLE_RESUMES).inc(kind="shard")
+        get_recovery_log().record(
+            "shard_resume",
+            label,
+            shards=report.shards,
+            recovery_chunks=len(recovery),
+            remaining_chunks=len(remaining),
+        )
+        return (
+            new_part,
+            sharding,
+            carry,
+            step,
+            traces,
+            recovery + remaining,
+            new_chunk_rows,
+            surviving,
+        )
 
     def _record_observation(self, report: StreamReport, data_shape: str) -> None:
         store = _store.get_store()
@@ -869,6 +1184,31 @@ class StreamingFitOperator(EstimatorOperator):
                         prefetch=self.prefetch,
                         partition=self.partition,
                     )
+                    # Durable fits (docs/RELIABILITY.md): with a
+                    # checkpoint store attached, arm mid-fit cursor
+                    # checkpoints and look for a resume entry a killed
+                    # predecessor left behind. A valid entry seeds the
+                    # fold (fit_stream's state contract) and the fold
+                    # re-ingests only chunks past the cursor; a stale
+                    # one is refused (KV306 — VerificationError in
+                    # strict mode, which must propagate, not fall back).
+                    resume_state = None
+                    from .executor import PipelineEnv
+
+                    store = PipelineEnv.get_or_create().checkpoint
+                    if store is not None:
+                        from ..reliability.durable import arm_durable_fold
+
+                        stream.durable, resume_state = arm_durable_fold(
+                            stream, self.estimator, store
+                        )
+                    if resume_state is not None:
+                        span.set_attribute(
+                            "resumed_from_chunk", stream.durable.start_chunk
+                        )
+                        return self.estimator.fit_stream(
+                            stream, state=resume_state
+                        )
                     return self.estimator.fit_stream(stream)
                 except StreamingFallback as e:
                     logger.info(

@@ -27,6 +27,11 @@ KV305     error     a refit-published candidate's apply spec or bucket
                     set disagrees with the incumbent's warmed buckets
                     (the steady-state-recompile hazard on the publish
                     path; :func:`verify_refit_publish`)
+KV306     error     a persisted mid-stream resume entry's fingerprints
+                    (dataset/labels content digest, featurize-chain
+                    digest, featurized width/dtype) disagree with the
+                    re-planned pipeline — seeding a fold from it would
+                    silently corrupt the fit (:func:`verify_stream_resume`)
 KV401     error     dependency cycle in the graph
 KV402     info      node not statically analyzable (no ``out_spec``,
                     not eval_shape-able) — propagation continues unknown
@@ -99,6 +104,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "KV303": (WARNING, "streamed-fit Gram state exceeds memory budget"),
     "KV304": (ERROR, "sharded per-device residency exceeds memory budget"),
     "KV305": (ERROR, "refit candidate disagrees with incumbent warm state"),
+    "KV306": (ERROR, "stale stream-resume entry refused"),
     "KV401": (ERROR, "dependency cycle"),
     "KV402": (INFO, "node not statically analyzable"),
 }
@@ -1185,6 +1191,59 @@ def verify_refit_publish(
                     incumbent_spec=str(inc_out[1]),
                 )
 
+    report.seconds = time.perf_counter() - t0
+    _publish(report, context)
+    return report
+
+
+def verify_stream_resume(
+    cursor: Any,
+    current: Dict[str, Any],
+    context: str = "stream-resume",
+) -> VerifyReport:
+    """The durable-fit face of stale-state corruption (docs/RELIABILITY.md
+    "Durable fits", docs/VERIFICATION.md KV306).
+
+    A mid-stream resume entry seeds a fold with sufficient statistics
+    captured over a PREFIX of the dataset — sound only when the fresh
+    process's re-planned pipeline reproduces the exact same features for
+    the exact same rows. The resume key is deliberately coarse (it names
+    the logical fit, so re-planned pipelines FIND their entry); this
+    check is the content-level gate: any disagreement between the
+    cursor's fingerprints and the re-planned pipeline's — dataset or
+    labels content digest, featurize-chain digest (weights included),
+    featurized width or dtype — refuses the entry. Stale resume must be
+    a loud refusal and a from-scratch re-ingest, never a silently
+    corrupted fit. Pure host-side comparison, zero device execution.
+
+    ``cursor`` is a :class:`~keystone_tpu.reliability.durable.StreamCursor`;
+    ``current`` maps the same fingerprint field names to the re-planned
+    pipeline's values.
+    """
+    t0 = time.perf_counter()
+    report = VerifyReport(context=context)
+    interp = _Interpreter(Graph(), report.diagnostics, probe_objects=False)
+    checks = (
+        ("dataset_digest", "dataset content digest"),
+        ("labels_digest", "labels content digest"),
+        ("chain_digest", "featurize-chain digest"),
+        ("feature_width", "featurized width"),
+        ("feature_dtype", "featurized dtype"),
+    )
+    for field_name, title in checks:
+        have = getattr(cursor, field_name)
+        want = current.get(field_name)
+        if have != want:
+            interp.diag(
+                "KV306",
+                f"resume entry's {title} ({str(have)[:16]}) disagrees with "
+                f"the re-planned pipeline's ({str(want)[:16]}) — seeding "
+                "the fold from this entry would silently corrupt the fit; "
+                "the entry is refused and the fit re-ingests from scratch",
+                field=field_name,
+                entry=str(have)[:16],
+                planned=str(want)[:16],
+            )
     report.seconds = time.perf_counter() - t0
     _publish(report, context)
     return report

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Chaos-matrix sweep (docs/RELIABILITY.md): drive EVERY registered probe
+# site (reliability/faultinject.py KNOWN_PROBE_SITES) through a
+# deterministic FaultSpec and assert the recovery contract per site —
+# a recovery-ledger event lands, and no invariant breaks (zero dropped
+# requests on serving sites, parity on the recoverable fit sites, zero
+# leaked keystone threads everywhere).
+#
+# The matrix lives in tests/reliability/test_chaos_matrix.py (marked
+# `slow` — too heavy for the tier-1 lane, run here and on demand). The
+# test FAILS when a probe site has no matrix entry, so new chaos surface
+# cannot land unexercised — the gap this sweep exists to close.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+timeout -k 10 1200 python -m pytest \
+  tests/reliability/test_chaos_matrix.py -q -m slow \
+  -p no:cacheprovider -p no:randomly "$@"
+
+echo "chaos_sweep_smoke OK"

@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# Durable-elastic-fit smoke (docs/RELIABILITY.md "Durable fits"): the
+# kill-at-any-chunk-boundary contract, across REAL processes:
+#
+#   1. an uninterrupted reference fit writes probe predictions;
+#   2. the same fit is SIGKILLed mid-stream (a real `kill` fault at
+#      streaming.chunk call K via the fault harness env door) — the
+#      store holds the last committed cursor (checkpoint every 2 chunks);
+#   3. a fresh process re-plans the same pipeline, finds the resume
+#      entry, seeds the fold, and re-ingests EXACTLY total−cursor
+#      chunks (--expect-resume exits 2 on a silent from-scratch refit);
+#   4. parity: resumed predictions match the uninterrupted reference to
+#      rel_err ≤ 1e-6 — on the 8-virtual-device sharded mesh AND on one
+#      device (the cursor snapshot is mesh-independent);
+#   5. the seeded KV306 case: a resume entry whose dataset content
+#      digest disagrees with the re-planned pipeline is REFUSED —
+#      KEYSTONE_VERIFY=strict exits 1 naming KV306, and warn mode
+#      re-ingests from scratch with a resume_refused ledger event.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+WORK=$(mktemp -d /tmp/elastic_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+KILL5='[{"match":"streaming.chunk","kind":"kill","calls":[5]}]'
+
+run_leg () {  # run_leg <name> <device-count-flags...>
+  local name="$1"; shift
+  local flags=("$@")
+
+  echo "== elastic leg: $name =="
+  env "${flags[@]}" timeout -k 10 180 python -m keystone_tpu fit \
+    --store-dir "$WORK/$name-ref" --out "$WORK/$name-ref.npz" \
+    | tee "$WORK/$name-ref.log" | grep -a FIT_STATS >/dev/null
+
+  # SIGKILL at chunk 5 of 8 (checkpoints at 2 and 4) — rc must be a kill.
+  set +e
+  env "${flags[@]}" KEYSTONE_FAULT_SPECS="$KILL5" timeout -k 10 180 \
+    python -m keystone_tpu fit --store-dir "$WORK/$name-dur" \
+    --ckpt-chunks 2 >/dev/null 2>&1
+  rc=$?
+  set -e
+  [ "$rc" -ne 0 ] || { echo "FAIL($name): killed run exited 0"; exit 1; }
+
+  env "${flags[@]}" timeout -k 10 180 python -m keystone_tpu fit \
+    --store-dir "$WORK/$name-dur" --ckpt-chunks 2 \
+    --out "$WORK/$name-res.npz" --expect-resume \
+    | tee "$WORK/$name-res.log" | grep -a FIT_STATS > "$WORK/$name-res.json"
+
+  timeout -k 10 60 python - "$WORK" "$name" <<'EOF'
+import json, sys
+import numpy as np
+
+work, name = sys.argv[1], sys.argv[2]
+stats = json.loads(
+    open(f"{work}/{name}-res.json").read().split("FIT_STATS:", 1)[1]
+)
+total = stats["chunks_total"]
+assert stats["resumed_from_chunk"] == 4, stats
+assert stats["reingested_chunks"] == total - 4 == stats["chunks"], stats
+assert "stream_resume" in stats["ledger_kinds"], stats
+ref = np.load(f"{work}/{name}-ref.npz")["preds"]
+res = np.load(f"{work}/{name}-res.npz")["preds"]
+err = float(np.linalg.norm(ref - res) / np.linalg.norm(ref))
+assert err <= 1e-6, f"{name}: resume parity {err} > 1e-6"
+print(f"{name}: resumed_from=4 reingested={stats['reingested_chunks']}/{total} "
+      f"shards={stats['shards']} parity_rel_err={err:.2e}")
+EOF
+}
+
+run_leg sharded XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+run_leg onedev XLA_FLAGS="${XLA_FLAGS:-}"
+
+# ---- seeded KV306: stale resume entry refused, strict mode exits 1 ----
+echo "== elastic leg: kv306 =="
+set +e
+env XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  KEYSTONE_FAULT_SPECS="$KILL5" timeout -k 10 180 \
+  python -m keystone_tpu fit --store-dir "$WORK/kv" --ckpt-chunks 2 \
+  >/dev/null 2>&1
+env XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  KEYSTONE_VERIFY=strict timeout -k 10 180 \
+  python -m keystone_tpu fit --store-dir "$WORK/kv" --ckpt-chunks 2 \
+  --drift-data 0.5 > "$WORK/kv306.log" 2>&1
+rc=$?
+set -e
+[ "$rc" -eq 1 ] || { echo "FAIL: KV306 strict refusal exited $rc (want 1)"; exit 1; }
+grep -aq "KV306" "$WORK/kv306.log" || { echo "FAIL: no KV306 in refusal output"; exit 1; }
+echo "kv306: stale resume refused under strict (exit 1)"
+
+echo "elastic_smoke OK"

@@ -1,0 +1,470 @@
+"""The chaos-matrix sweep: every registered probe site, faulted.
+
+``KNOWN_PROBE_SITES`` (reliability/faultinject.py) is the package's
+whole chaos surface — and before this sweep only hand-picked sites were
+exercised, so a new ``probe()`` call could land with no test ever aiming
+a fault at it. This matrix closes the gap structurally:
+
+- every site carries a deterministic driver (workload + FaultSpec +
+  recovery assertions); ``test_matrix_covers_every_probe_site`` fails
+  the moment a site is registered without one;
+- every driver asserts the site's recovery CONTRACT — the ledger kinds
+  that prove the fault was absorbed, plus the site-specific invariant
+  (zero dropped requests on serving sites, parity on the recoverable
+  fit sites, a completed fit on degradable solver sites);
+- the shared harness asserts the cross-cutting invariant: no keystone
+  thread outlives its driver (a faulted path must join what it spawned).
+
+Marked ``slow`` (multi-process serving drivers, several fits):
+scripts/chaos_sweep_smoke.sh is the CI face; tier-1 excludes it.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.reliability import faultinject
+from keystone_tpu.reliability.faultinject import (
+    KNOWN_PROBE_SITES,
+    FaultSpec,
+    injected,
+)
+from keystone_tpu.reliability.recovery import get_recovery_log
+
+pytestmark = pytest.mark.slow
+
+D, K = 8, 3
+_rng = np.random.default_rng(11)
+X = _rng.normal(size=(512, D)).astype(np.float32)
+W = _rng.normal(size=(D, K)).astype(np.float32)
+Y = (X @ W + 0.01 * _rng.normal(size=(512, K))).astype(np.float32)
+
+
+def _keystone_threads():
+    return sorted(
+        t.name
+        for t in threading.enumerate()
+        if t.is_alive() and t.name.startswith("keystone-")
+    )
+
+
+def _ledger_has(kind, label=None):
+    return any(
+        e.kind == kind and (label is None or label in e.label)
+        for e in get_recovery_log().events()
+    )
+
+
+def _stream_fit(**fit_kwargs):
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+    from keystone_tpu.workflow.pipeline import BatchTransformer
+
+    class Scale(BatchTransformer):
+        def __init__(self, c):
+            self.c = float(c)
+
+        def apply_arrays(self, a):
+            return a * self.c
+
+    pipeline = Scale(2.0).to_pipeline().then_label_estimator(
+        LinearMapEstimator(reg=1e-3), ArrayDataset(X), ArrayDataset(Y)
+    )
+    return pipeline.fit(**fit_kwargs)
+
+
+def _preds(fitted):
+    from keystone_tpu.data.dataset import ArrayDataset
+
+    return np.asarray(fitted.apply_batch(ArrayDataset(X[:32])).data)
+
+
+# ------------------------------------------------------------- the drivers
+
+
+def drive_streaming_chunk():
+    """A fault inside the chunk dispatch aborts the fold loudly; the
+    invariant is hygiene: the abandoned fold joins its prefetch workers
+    and a clean re-run succeeds."""
+    import os
+
+    os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = "64"
+    with injected(
+        FaultSpec(match="streaming.chunk", kind="transient", calls=(2,))
+    ):
+        with pytest.raises(ConnectionError):
+            _stream_fit()
+    assert _ledger_has("fault", "streaming.chunk")
+    assert not [n for n in _keystone_threads() if "prefetch" in n]
+    from keystone_tpu.workflow.executor import PipelineEnv
+
+    PipelineEnv.reset()
+    assert _preds(_stream_fit()).shape == (32, K)
+
+
+def drive_shard_loss():
+    """A device lost mid-stream is ABSORBED: the fit completes on the
+    surviving shards with parity vs the single-device reference."""
+    import os
+
+    from keystone_tpu.parallel.partitioner import partition_disabled
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.streaming import last_stream_report
+
+    os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = "64"
+    PipelineEnv.reset()
+    with partition_disabled():
+        ref = _preds(_stream_fit())
+    PipelineEnv.reset()
+    with injected(
+        FaultSpec(match="parallel.shard_loss", kind="transient", calls=(3,))
+    ):
+        out = _preds(_stream_fit())
+    report = last_stream_report()
+    assert report.shard_losses == 1 and report.shards == 7
+    err = float(np.linalg.norm(out - ref) / np.linalg.norm(ref))
+    assert err <= 1e-5, err
+    assert _ledger_has("shard_loss") and _ledger_has("shard_resume")
+
+
+def drive_ingest_decode():
+    """A transient inside the decode pool surfaces loudly; the same
+    archive then loads cleanly (fault did not poison the loader)."""
+    PIL = pytest.importorskip("PIL.Image")
+    import io
+    import tarfile
+    import tempfile
+
+    from keystone_tpu.data.loaders.archive import load_image_archives
+
+    path = tempfile.mktemp(suffix=".tar")
+    with tarfile.open(path, "w") as tar:
+        for i in range(4):
+            img = np.full((16, 16, 3), i * 40, np.uint8)
+            buf = io.BytesIO()
+            PIL.fromarray(img).save(buf, format="JPEG")
+            info = tarfile.TarInfo(name=f"cls{i % 2}/img{i}.JPEG")
+            info.size = len(buf.getvalue())
+            tar.addfile(info, io.BytesIO(buf.getvalue()))
+    with injected(
+        FaultSpec(match="ingest.decode_batch", kind="transient", calls=(1,))
+    ):
+        with pytest.raises(ConnectionError):
+            load_image_archives(path, label_fn=lambda n: n.split("/")[0])
+    assert _ledger_has("fault", "ingest.decode_batch")
+    ds = load_image_archives(path, label_fn=lambda n: n.split("/")[0])
+    assert len(ds) == 4
+
+
+def drive_serving_apply():
+    """A transient under a live batch is retried per policy: every
+    request answers, zero failures — the 0-dropped-requests invariant."""
+    from keystone_tpu.reliability.retry import RetryPolicy
+    from keystone_tpu.serving.config import ServingConfig
+    from keystone_tpu.serving.server import PipelineServer
+    from keystone_tpu.serving.synthetic import (
+        synthetic_fitted_pipeline,
+        synthetic_requests,
+    )
+
+    fp = synthetic_fitted_pipeline(d=D)
+    policy = RetryPolicy(max_attempts=3, base_delay_s=0.01, max_delay_s=0.02)
+    with injected(
+        FaultSpec(match="serving.apply", kind="transient", calls=(1,))
+    ):
+        with PipelineServer(
+            fp,
+            config=ServingConfig(
+                max_batch=8, max_wait_ms=10.0, queue_depth=64,
+                retry_policy=policy,
+            ),
+        ) as server:
+            futures = server.submit_many(synthetic_requests(8, d=D))
+            results = [f.result(timeout=60) for f in futures]
+            stats = server.stats()
+    assert len(results) == 8
+    assert stats["failures"] == 0 and stats["retries"] >= 1
+    assert _ledger_has("fault", "serving.apply")
+
+
+def _stub_supervisor(chaos):
+    from keystone_tpu.serving.supervisor import (
+        SupervisorConfig,
+        WorkerSupervisor,
+    )
+
+    env = {
+        f"KEYSTONE_FAULT_SPECS_WORKER_{wid}": json.dumps(specs)
+        for wid, specs in chaos.items()
+    }
+    return WorkerSupervisor(
+        {"stub": {"delay_ms": 2}},
+        SupervisorConfig(
+            workers=2,
+            heartbeat_s=0.05,
+            hang_timeout_s=0.8,
+            ready_timeout_s=30.0,
+            monitor_interval_s=0.02,
+        ),
+        env=env,
+    )
+
+
+def drive_worker_request_kill():
+    """SIGKILL inside a worker's request path: in-flight work requeues
+    onto the healthy sibling — zero dropped requests."""
+    sup = _stub_supervisor(
+        {"0": [{"match": "serving.worker.request", "kind": "kill", "calls": [4]}]}
+    ).start()
+    try:
+        sup.wait_ready()
+        futures = [sup.submit([float(i)], deadline_s=60) for i in range(32)]
+        results = [f.result(timeout=60) for f in futures]
+        assert [r[0] for r in results] == [2.0 * i for i in range(32)]
+        assert _ledger_has("worker_crash")
+    finally:
+        sup.stop()
+
+
+def drive_worker_heartbeat_corrupt():
+    """Garbled heartbeats must read as a dead worker: hang-detected,
+    recycled, and the fleet serves again."""
+    sup = _stub_supervisor(
+        {"0": [{"match": "serving.worker.heartbeat", "kind": "corrupt",
+                "first_n": 10000}]}
+    ).start()
+    try:
+        deadline = time.monotonic() + 30
+        while not get_recovery_log().events("worker_crash"):
+            assert time.monotonic() < deadline, "corrupt channel undetected"
+            time.sleep(0.05)
+        sup.wait_ready(timeout_s=30)
+        assert sup.submit([2.0], deadline_s=60).result(timeout=60) == [4.0]
+    finally:
+        sup.stop()
+
+
+def _refit_rig(tmp_store):
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+    from keystone_tpu.refit.daemon import RefitConfig, RefitDaemon
+    from keystone_tpu.refit.shadow import ShadowEvaluator
+    from keystone_tpu.refit.tap import TrafficTap
+    from keystone_tpu.reliability.checkpoint import CheckpointStore
+    from keystone_tpu.workflow.streaming import ChunkStream
+
+    class StubPublisher:
+        def __init__(self, model):
+            self.model = model
+            self.rollbacks = 0
+
+        def current_model(self):
+            return self.model
+
+        def publish(self, candidate, round_index=0):
+            faultinject.probe("refit.publish")
+            self.model = candidate
+
+            class Ticket:
+                version = f"v{round_index}"
+
+            return Ticket()
+
+        def apply_live(self, x):
+            return np.asarray(self.model.apply_arrays(x))
+
+        def rollback(self, ticket, reason=""):
+            self.rollbacks += 1
+            get_recovery_log().record("refit_rollback", "chaos", reason=reason)
+
+        def settle(self):
+            pass
+
+    est = LinearMapEstimator(reg=1e-2)
+    x0 = _rng.normal(size=(512, D)).astype(np.float32)
+    y0 = np.eye(K, dtype=np.float32)[np.argmax(x0 @ W, axis=1)]
+    model = est.fit_stream(
+        ChunkStream(ArrayDataset(x0), ArrayDataset(y0), (), chunk_rows=128)
+    )
+    store = CheckpointStore(str(tmp_store))
+    tap = TrafficTap(capacity_rows=8192)
+    daemon = RefitDaemon(
+        est,
+        tap,
+        StubPublisher(model),
+        store=store,
+        shadow=ShadowEvaluator(margin=0.5),
+        config=RefitConfig(name="chaos", min_rows=64, chunk_rows=128),
+        state=est.export_stream_state(),
+    )
+    x1 = _rng.normal(size=(512, D)).astype(np.float32)
+    y1 = np.eye(K, dtype=np.float32)[np.argmax(x1 @ W, axis=1)]
+    tap.feed(x1, y1)
+    return daemon, tap
+
+
+def drive_refit_fold(tmp_path):
+    """A fault inside the fold loses nothing: the drained rows resume
+    from the round journal on the next round."""
+    daemon, tap = _refit_rig(tmp_path / "fold")
+    before = daemon.state_rows()
+    with injected(FaultSpec(match="refit.fold", kind="transient", calls=(1,))):
+        with pytest.raises(ConnectionError):
+            daemon.run_once()
+    assert tap.depth() == 0  # rows left the tap with the drain...
+    assert daemon.run_once() == "published"  # ...and the journal has them
+    assert daemon.state_rows() == before + 384
+    assert _ledger_has("fault", "refit.fold")
+    assert _ledger_has("refit_journal_resume")
+
+
+def drive_refit_candidate(tmp_path):
+    """A candidate corrupted AFTER shadow eval (the eval blind spot) is
+    caught by the watch window and rolled back."""
+
+    def negate(model):
+        from keystone_tpu.ops.learning.linear import LinearMapper
+
+        return LinearMapper(
+            -np.asarray(model.weights),
+            intercept=model.intercept,
+            feature_mean=model.feature_mean,
+        )
+
+    daemon, _ = _refit_rig(tmp_path / "candidate")
+    with injected(
+        FaultSpec(
+            match="refit.candidate", kind="corrupt", calls=(1,), corrupt=negate
+        )
+    ):
+        outcome = daemon.run_once()
+    assert outcome == "rolled_back"
+    assert daemon.publisher.rollbacks == 1
+    assert _ledger_has("fault", "refit.candidate")
+
+
+def drive_refit_publish(tmp_path):
+    """A fault inside the swap itself retries from the journal's folded
+    phase: no re-fold (exactly once), publish lands on round 2."""
+    daemon, _ = _refit_rig(tmp_path / "publish")
+    before = daemon.state_rows()
+    with injected(
+        FaultSpec(match="refit.publish", kind="transient", calls=(1,))
+    ):
+        with pytest.raises(ConnectionError):
+            daemon.run_once()
+    folded = daemon.state_rows()
+    assert folded == before + 384
+    assert daemon.run_once() == "published"
+    assert daemon.state_rows() == folded  # journal skipped the re-fold
+    assert _ledger_has("fault", "refit.publish")
+
+
+def _solver_data(n=96, d=24):
+    from keystone_tpu.data.dataset import ArrayDataset
+
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, K)).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    return ArrayDataset(x), ArrayDataset(y)
+
+
+def drive_least_squares_oom():
+    """OOM in the preferred rung falls down the degradation ladder; the
+    fit still completes."""
+    from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+
+    data, labels = _solver_data()
+    with injected(
+        FaultSpec(match="LeastSquaresEstimator.solve", kind="oom", calls=(1,))
+    ):
+        model = LeastSquaresEstimator(reg=1e-3).fit(data, labels)
+    assert model.degradation["rung"] == "block"
+    assert _ledger_has("degrade")
+
+
+def drive_block_solver_oom():
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+
+    data, labels = _solver_data()
+    with injected(
+        FaultSpec(
+            match="BlockLeastSquaresEstimator.solve", kind="oom", calls=(1,)
+        )
+    ):
+        model = BlockLeastSquaresEstimator(16, num_iter=1, reg=1e-3).fit(
+            data, labels
+        )
+    assert model.degradation is not None
+    assert _ledger_has("degrade")
+
+
+def drive_krr_oom():
+    from keystone_tpu.ops.learning.kernel import (
+        GaussianKernelGenerator,
+        KernelRidgeRegression,
+    )
+
+    data, labels = _solver_data(n=64, d=8)
+    with injected(
+        FaultSpec(match="KernelRidgeRegression.solve", kind="oom", calls=(1,))
+    ):
+        model = KernelRidgeRegression(
+            GaussianKernelGenerator(0.1), reg=1e-2, block_size=32,
+            num_epochs=1,
+        ).fit(data, labels)
+    assert model.degradation is not None
+    assert _ledger_has("degrade")
+
+
+#: site → driver. The sweep fails when KNOWN_PROBE_SITES grows past it.
+MATRIX = {
+    "streaming.chunk": drive_streaming_chunk,
+    "parallel.shard_loss": drive_shard_loss,
+    "ingest.decode_batch": drive_ingest_decode,
+    "serving.apply": drive_serving_apply,
+    "serving.worker.request": drive_worker_request_kill,
+    "serving.worker.heartbeat": drive_worker_heartbeat_corrupt,
+    "refit.fold": drive_refit_fold,
+    "refit.candidate": drive_refit_candidate,
+    "refit.publish": drive_refit_publish,
+    "LeastSquaresEstimator.solve": drive_least_squares_oom,
+    "BlockLeastSquaresEstimator.solve": drive_block_solver_oom,
+    "KernelRidgeRegression.solve": drive_krr_oom,
+}
+
+#: drivers that accept a tmp_path for a checkpoint store
+_NEEDS_TMP = {"refit.fold", "refit.candidate", "refit.publish"}
+
+
+def test_matrix_covers_every_probe_site():
+    missing = set(KNOWN_PROBE_SITES) - set(MATRIX)
+    stale = set(MATRIX) - set(KNOWN_PROBE_SITES)
+    assert not missing, (
+        f"probe sites with no chaos-matrix driver: {sorted(missing)} — "
+        "register a driver in tests/reliability/test_chaos_matrix.py"
+    )
+    assert not stale, f"matrix entries for unregistered sites: {sorted(stale)}"
+
+
+@pytest.mark.parametrize("site", sorted(KNOWN_PROBE_SITES))
+def test_fault_at_site_is_recovered(site, tmp_path):
+    driver = MATRIX[site]
+    before = _keystone_threads()
+    if site in _NEEDS_TMP:
+        driver(tmp_path)
+    else:
+        driver()
+    # Cross-cutting invariant: the driver (and the faulted machinery it
+    # exercised) joined everything it spawned.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leaked = [n for n in _keystone_threads() if n not in before]
+        if not leaked:
+            break
+        time.sleep(0.1)
+    assert not leaked, f"threads leaked by the {site} driver: {leaked}"
