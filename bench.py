@@ -1245,6 +1245,242 @@ def _bench_serving_multiworker(small: bool) -> dict:
     return out
 
 
+_BOOT_COLD_SCRIPT = r"""
+import json, os, sys, time
+
+mode = sys.argv[1]
+cfg = json.loads(sys.argv[2])
+d, depth, buckets = cfg["d"], cfg["depth"], cfg["buckets"]
+
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.utils.compilation_cache import compile_count
+
+x = np.ones((buckets[-1], d), np.float32)
+
+# The first request is a SINGLE row on both sides — the request a fresh
+# worker actually answers first. The asymmetry under test is what each
+# path must do before it may answer it: classic traces and compiles
+# every bucket (PipelineServer.warmup's contract — a ready worker is a
+# fully-warmed worker), the boot image just deserializes.
+t0 = time.perf_counter()
+if mode == "classic":
+    from keystone_tpu.serving.registry import ModelRegistry
+    from keystone_tpu.serving.worker import _load_spec
+    from keystone_tpu.utils.aot import warm_buckets
+
+    registry = ModelRegistry()
+    example = _load_spec(registry, "default", {"synthetic": cfg["spec"]})
+    apply = registry.resolve("default").batch_apply
+    warm_buckets(apply, example, buckets)
+    y = apply(ArrayDataset(x[:1], num_examples=1))
+else:
+    from keystone_tpu.serving.bootimage import load_boot_image
+
+    image = load_boot_image(cfg["image"])
+    apply = image.apply_batch
+    y = apply(ArrayDataset(x[:1], num_examples=1))
+first_request_s = time.perf_counter() - t0
+
+# Steady state: every bucket again (partial occupancy, the warmed serve
+# path) — the monitored-compile delta must be zero for the boot path
+# (the exact invariant the fleet smoke gates).
+base = compile_count()
+for b in buckets:
+    apply(ArrayDataset(x[:b], num_examples=max(b - 1, 1)))
+print("LEG_JSON:" + json.dumps({
+    "first_request_s": round(first_request_s, 4),
+    "compiles_steady_state": compile_count() - base,
+    "y0": float(np.asarray(y.data)[0, 0]),
+}))
+"""
+
+
+def _bench_serving_autoscale(small: bool) -> dict:
+    """Elastic serving fleet (docs/SERVING.md "Elastic fleet"): the two
+    halves of the autoscaling story, each against its own substrate.
+
+    **Boot images** — cold first-request latency of a fresh worker, via
+    the serialized AOT artifact (serving/bootimage.py) vs the classic
+    warm-everything path, each measured in its OWN subprocess against an
+    EMPTY persistent XLA cache (jax import excluded; the clock starts
+    after imports and stops when the first request is answered).
+    Headline ``boot_speedup`` with a >=10x gate (``boot_speedup_ok``);
+    ``compiles_steady_state`` on the boot path is exact-gated at 0, and
+    a tampered manifest must refuse with KV307 and fall back to the
+    classic path (``kv307_refused_ok`` / ``kv307_fallback_ok``).
+
+    **Autoscaler** — a seeded bursty arrival trace (serving/loadgen.py)
+    replayed against a 1-worker stub fleet with the closed-loop
+    autoscaler live: the burst drives a scale-up, the quiet tail drives
+    the fleet back down, and the exact-gated invariant is ``dropped`` ==
+    0 across the whole elastic cycle (``scale_cycle_ok`` pins that both
+    directions actually fired; the raw event counts are reported as
+    evidence, not gated — burst phasing vs machine speed moves them)."""
+    import shutil
+    import tempfile
+
+    from keystone_tpu.serving.bootimage import BootImageRefused, build_boot_image
+
+    d, depth = (256, 20)
+    buckets = [1, 2, 4, 8, 16, 32, 64, 128, 256]
+    spec = {"d": d, "depth": depth, "seed": 0}
+    out: dict = {"d": d, "depth": depth, "buckets": len(buckets)}
+
+    work = tempfile.mkdtemp(prefix="keystone-autoscale-bench-")
+    try:
+        image_dir = os.path.join(work, "image")
+        t0 = time.perf_counter()
+        build_boot_image(
+            {"synthetic": spec}, image_dir, buckets=tuple(buckets)
+        )
+        out["image_build_s"] = round(time.perf_counter() - t0, 3)
+
+        def cold_run_once(mode: str, trial: int) -> dict:
+            cfg = {"d": d, "depth": depth, "buckets": buckets,
+                   "spec": spec, "image": image_dir}
+            env = dict(
+                os.environ,
+                JAX_PLATFORMS="cpu",
+                # Fresh cache per trial: every child pays the full cold
+                # path, no cross-trial persistent-cache hits.
+                KEYSTONE_COMPILATION_CACHE=os.path.join(
+                    work, f"cold-cache-{mode}-{trial}"
+                ),
+            )
+            # XLA_FLAGS passes through untouched: the child must see the
+            # same device topology the image was built under (a topology
+            # drift is KV307's job to catch, not the bench's to create).
+            proc = subprocess.run(
+                [sys.executable, "-c", _BOOT_COLD_SCRIPT, mode,
+                 json.dumps(cfg)],
+                capture_output=True, text=True, timeout=900, env=env,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"{mode} cold-boot child failed:\n{proc.stderr[-2000:]}"
+                )
+            line = [l for l in proc.stdout.splitlines()
+                    if l.startswith("LEG_JSON:")][-1]
+            return json.loads(line[len("LEG_JSON:"):])
+
+        def cold_run(mode: str) -> dict:
+            # Min-of-2: the first child spawned after heavy parent CPU
+            # (image build, earlier legs) eats kernel writeback on a
+            # loaded box and can read 2-3x slow; sub-second walls need
+            # the same min-of-N treatment the blocksparse leg uses.
+            runs = [cold_run_once(mode, t) for t in range(2)]
+            return min(runs, key=lambda r: r["first_request_s"])
+
+        classic = cold_run("classic")
+        boot = cold_run("boot")
+        out["classic_first_request_s"] = classic["first_request_s"]
+        out["boot_first_request_s"] = boot["first_request_s"]
+        out["boot_speedup"] = round(
+            classic["first_request_s"] / max(boot["first_request_s"], 1e-9), 1
+        )
+        out["boot_speedup_ok"] = bool(out["boot_speedup"] >= 10.0)
+        out["compiles_steady_state"] = boot["compiles_steady_state"]
+        out["boot_parity_ok"] = bool(
+            abs(classic["y0"] - boot["y0"])
+            <= 1e-4 * max(abs(classic["y0"]), 1.0)
+        )
+
+        # Seeded KV307 refusal: a stale image must refuse loudly and the
+        # classic path must still come up behind it.
+        stale = os.path.join(work, "stale-image")
+        shutil.copytree(image_dir, stale)
+        manifest_path = os.path.join(stale, "manifest.json")
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        manifest["jax_version"] = "0.0.0-stale"
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f)
+        from keystone_tpu.serving.bootimage import load_boot_image
+
+        try:
+            load_boot_image(stale)
+            out["kv307_refused_ok"] = False
+        except BootImageRefused as exc:
+            out["kv307_refused_ok"] = bool(
+                any(diag.code == "KV307" for diag in exc.report.errors())
+            )
+        from keystone_tpu.serving.registry import ModelRegistry
+        from keystone_tpu.serving.worker import _load_spec
+
+        fallback = ModelRegistry()
+        out["kv307_fallback_ok"] = bool(
+            _load_spec(fallback, "default", {"synthetic": spec}) is not None
+        )
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    # ---------------------------------------------------- elastic cycle
+    from keystone_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+    from keystone_tpu.serving.loadgen import bursty_offsets, run_load
+    from keystone_tpu.serving.supervisor import (
+        SupervisorConfig,
+        WorkerSupervisor,
+    )
+
+    duration = 6.0 if small else 10.0
+    offsets = bursty_offsets(
+        duration, base_rps=15.0, burst_rps=320.0,
+        burst_len_s=1.5, quiet_len_s=1.5, seed=1,
+    )
+    out["offered"] = len(offsets)
+    sup = WorkerSupervisor(
+        {"stub": {"delay_ms": 5}},
+        SupervisorConfig(
+            workers=1, heartbeat_s=0.05, hang_timeout_s=10.0,
+            ready_timeout_s=60.0, monitor_interval_s=0.02,
+            queue_depth=4096, worker_queue_depth=2048,
+        ),
+    ).start()
+    scaler = None
+    try:
+        sup.wait_ready()
+        scaler = Autoscaler(
+            sup,
+            AutoscalerConfig(
+                target_p99_ms=60.0, min_workers=1, max_workers=3,
+                backlog_per_worker=4.0, pressure_s=0.25, idle_s=1.0,
+                cooldown_s=1.0, min_served=8, check_interval_s=0.05,
+            ),
+        ).start()
+        report = run_load(
+            lambda x, deadline_s=None: sup.submit(x, deadline_s=deadline_s),
+            offsets,
+            payload=lambda i: [float(i % 5)],
+            deadline_s=60.0,
+        )
+        # The quiet tail after the last burst drives the scale-down;
+        # give the idle window room to elapse.
+        deadline = time.monotonic() + 20.0
+        while (
+            scaler.stats()["scale_downs"] < 1
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        stats = scaler.stats()
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        sup.stop()
+    out["completed"] = report.completed
+    out["dropped"] = report.dropped
+    out["load_errors"] = report.errors
+    out["rps"] = round(report.rps, 1)
+    out["load_p99_ms"] = round(report.p(99), 2)
+    out["scale_ups"] = stats["scale_ups"]
+    out["scale_downs"] = stats["scale_downs"]
+    out["scale_cycle_ok"] = bool(
+        stats["scale_ups"] >= 1 and stats["scale_downs"] >= 1
+    )
+    return out
+
+
 def _bench_refit(small: bool) -> dict:
     """Continuous refit (docs/REFIT.md): the drifting-workload closed
     loop — live traffic served while a supervised daemon taps it, folds
@@ -1849,6 +2085,7 @@ def _workload_registry() -> dict:
         "refit": _bench_refit,
         "serving": _bench_serving,
         "serving_multiworker": _bench_serving_multiworker,
+        "serving_autoscale": _bench_serving_autoscale,
         "ingest": _bench_ingest,
         "imagenet_fv": _bench_imagenet_fv,
         "imagenet_native": _bench_imagenet_native,

@@ -131,6 +131,18 @@ SERVING_SLO_TARGET_MS = "keystone_serving_slo_target_ms"
 SERVING_SLO_RUNG = "keystone_serving_slo_rung"
 SERVING_SLO_TRANSITIONS = "keystone_serving_slo_transitions_total"
 
+# --------------------------------------------------- elastic fleet / autoscale
+SERVING_SCALE_EVENTS = "keystone_serving_scale_events_total"
+SERVING_SCALE_TARGET_WORKERS = "keystone_serving_scale_target_workers"
+SERVING_SCALE_WORKERS_DRAINING = "keystone_serving_scale_workers_draining"
+SERVING_SCALE_DRAIN_SECONDS = "keystone_serving_scale_drain_seconds"
+
+# ------------------------------------------------------------------ boot image
+BOOTIMAGE_BUILDS = "keystone_bootimage_builds_total"
+BOOTIMAGE_LOADS = "keystone_bootimage_loads_total"
+BOOTIMAGE_BUILD_SECONDS = "keystone_bootimage_build_seconds"
+BOOTIMAGE_LOAD_SECONDS = "keystone_bootimage_load_seconds"
+
 # ------------------------------------------------------------ continuous refit
 REFIT_ROUNDS = "keystone_refit_rounds_total"
 REFIT_PUBLISHES = "keystone_refit_publishes_total"
@@ -248,6 +260,14 @@ SCHEMA: Dict[str, Tuple] = {
     SERVING_SLO_TARGET_MS: ("gauge", "SLO controller p99 target", ()),
     SERVING_SLO_RUNG: ("gauge", "Admission ladder rung index pinned by the SLO controller", ()),
     SERVING_SLO_TRANSITIONS: ("counter", "SLO-driven admission ladder transitions", ("direction",)),
+    SERVING_SCALE_EVENTS: ("counter", "Autoscaler fleet scale events, by direction (up/down)", ("direction",)),
+    SERVING_SCALE_TARGET_WORKERS: ("gauge", "Worker count the autoscaler is currently steering toward", ()),
+    SERVING_SCALE_WORKERS_DRAINING: ("gauge", "Workers currently draining ahead of scale-down removal", ()),
+    SERVING_SCALE_DRAIN_SECONDS: ("histogram", "Drain duration from scale-down decision to worker retirement", ()),
+    BOOTIMAGE_BUILDS: ("counter", "Boot images built (exported bucket executables + fitted weights)", ()),
+    BOOTIMAGE_LOADS: ("counter", "Boot-image load attempts, by status (loaded/refused)", ("status",)),
+    BOOTIMAGE_BUILD_SECONDS: ("histogram", "Whole boot-image builds (export + cache population + parity gate)", ()),
+    BOOTIMAGE_LOAD_SECONDS: ("histogram", "Boot-image loads (verify + deserialize, before first request)", ()),
     REFIT_ROUNDS: ("counter", "Refit daemon rounds, by outcome (published/skipped_nodata/skipped_eval/rolled_back/error)", ("outcome",)),
     REFIT_PUBLISHES: ("counter", "Candidate models published by the refit controller", ()),
     REFIT_ROLLBACKS: ("counter", "Automatic rollbacks triggered by the post-publish watch window", ()),

@@ -27,6 +27,15 @@ investments into one subsystem:
                       target instead of queue depth.
 - :mod:`frontend`   — stdlib HTTP JSON front door over the supervisor;
                       the stdin CLI is just another client.
+- :mod:`autoscaler` — closes the loop between SLO pressure and fleet
+                      size: sustained p99/backlog pressure adds workers,
+                      sustained idle drains them (zero dropped in-flight).
+- :mod:`loadgen`    — seeded diurnal/bursty/heavy-tail arrival processes
+                      and a replay harness for the autoscale bench/smoke.
+- :mod:`bootimage`  — versioned boot artifacts: AOT-serialized bucket
+                      executables + fitted weights, so a fresh worker
+                      answers its first request without compiling
+                      (imports jax lazily inside build/load).
 - :mod:`synthetic`  — synthetic fitted pipelines for bench/smoke tests
                       (imports jax; resolved lazily below).
 
@@ -38,8 +47,16 @@ See docs/SERVING.md for architecture and knobs.
 """
 
 from .admission import DEFAULT_RUNGS, AdmissionController, AdmissionRung
+from .autoscaler import Autoscaler, AutoscalerConfig
 from .batcher import MicroBatcher
 from .frontend import ServingFrontend
+from .loadgen import (
+    LoadReport,
+    bursty_offsets,
+    diurnal_offsets,
+    heavy_tail_offsets,
+    run_load,
+)
 from .slo import SLO_RUNGS, SLOController
 from .supervisor import HashRing, SupervisorConfig, WorkerSupervisor
 from .config import (
@@ -61,13 +78,26 @@ _LAZY = {
     "SyntheticDense": "keystone_tpu.serving.synthetic",
     "synthetic_fitted_pipeline": "keystone_tpu.serving.synthetic",
     "synthetic_requests": "keystone_tpu.serving.synthetic",
+    # bootimage is stdlib at import time, but its build/load paths pull
+    # jax; lazy keeps `import keystone_tpu.serving` honest about cost.
+    "BootImageError": "keystone_tpu.serving.bootimage",
+    "BootImageModel": "keystone_tpu.serving.bootimage",
+    "BootImageRefused": "keystone_tpu.serving.bootimage",
+    "build_boot_image": "keystone_tpu.serving.bootimage",
+    "load_boot_image": "keystone_tpu.serving.bootimage",
 }
 
 __all__ = [
     "AdmissionController",
     "AdmissionRung",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "BootImageError",
+    "BootImageModel",
+    "BootImageRefused",
     "DEFAULT_RUNGS",
     "HashRing",
+    "LoadReport",
     "MicroBatcher",
     "SLOController",
     "SLO_RUNGS",
@@ -87,8 +117,14 @@ __all__ = [
     "SyntheticDense",
     "UnknownModel",
     "bucket_for",
+    "build_boot_image",
+    "bursty_offsets",
     "default_bucket_sizes",
+    "diurnal_offsets",
+    "heavy_tail_offsets",
+    "load_boot_image",
     "percentile",
+    "run_load",
     "synthetic_fitted_pipeline",
     "synthetic_requests",
 ]

@@ -139,15 +139,33 @@ class ServingFrontend:
 
     # ----------------------------------------------------------------- routes
     def _health(self) -> Tuple[int, Dict[str, Any]]:
+        """Fleet health with scale events represented DISTINCTLY from
+        failures: a worker that is ``booting`` (new/spawning) or
+        ``draining`` (retiring gracefully, off the ring) is normal
+        elastic-fleet motion — ``status: "scaling"``, still 200 — while a
+        dead/failed worker degrades the fleet. Only zero ready workers
+        answers 503."""
         stats = self.supervisor.stats()
         workers = {
             wid: w["state"] for wid, w in stats.get("workers", {}).items()
         }
         alive = stats["supervisor"]["alive"]
-        status = "ok" if alive == len(workers) else ("degraded" if alive else "down")
+        booting = sum(1 for s in workers.values() if s in ("new", "spawning"))
+        draining = sum(1 for s in workers.values() if s == "draining")
+        unhealthy = len(workers) - alive - booting - draining
+        if not alive:
+            status = "down"
+        elif unhealthy:
+            status = "degraded"
+        elif booting or draining:
+            status = "scaling"
+        else:
+            status = "ok"
         return (200 if alive else 503), {
             "status": status,
             "alive": alive,
+            "booting": booting,
+            "draining": draining,
             "workers": workers,
         }
 
@@ -306,6 +324,7 @@ def serve_multiworker_from_args(args) -> int:
         max_wait_ms=args.max_wait_ms,
         worker_queue_depth=args.queue_depth,
         slo_target_p99_ms=args.slo_p99_ms,
+        boot_image=getattr(args, "boot_image", None),
     )
     # --deadline-ms means the same thing it means in-process: the default
     # per-request budget for requests that don't carry their own.
@@ -313,6 +332,25 @@ def serve_multiworker_from_args(args) -> int:
         args.deadline_ms / 1e3 if getattr(args, "deadline_ms", None) else None
     )
     supervisor = WorkerSupervisor(spec, config).start()
+    # --autoscale closes the loop between SLO pressure and fleet size
+    # (docs/SERVING.md "Elastic fleet"): the supervisor starts at
+    # --workers and the autoscaler moves it within [--min-workers,
+    # --max-workers].
+    autoscaler = None
+    if getattr(args, "autoscale", False):
+        from .autoscaler import Autoscaler, AutoscalerConfig
+
+        autoscaler = Autoscaler(
+            supervisor,
+            AutoscalerConfig(
+                target_p99_ms=args.slo_p99_ms
+                if args.slo_p99_ms is not None
+                else AutoscalerConfig.target_p99_ms,
+                min_workers=getattr(args, "min_workers", None) or 1,
+                max_workers=getattr(args, "max_workers", None)
+                or max(4, args.workers),
+            ),
+        ).start()
     frontend = None
     out_lock = threading.Lock()
 
@@ -376,6 +414,8 @@ def serve_multiworker_from_args(args) -> int:
     finally:
         if frontend is not None:
             frontend.stop()
+        if autoscaler is not None:
+            autoscaler.stop()
         if trace_out:
             # Merge BEFORE stop: fragments ship on heartbeats, and the
             # last beats land while workers are still alive.
@@ -401,6 +441,8 @@ def serve_multiworker_from_args(args) -> int:
         # ride the stats line so smoke scripts can assert recovery
         # happened without scraping logs.
         payload["recovery"] = get_recovery_log().summary()
+        if autoscaler is not None:
+            payload["autoscaler"] = autoscaler.stats()
         with out_lock:
             print("SERVE_STATS:" + json.dumps(payload), flush=True)
     return 0

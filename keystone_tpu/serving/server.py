@@ -470,16 +470,45 @@ def add_serve_arguments(parser) -> None:
         help="enable the SLO controller: drive admission from observed "
              "p99 against this target (multi-worker path)",
     )
+    parser.add_argument(
+        "--boot-image", default=None, metavar="DIR",
+        help="boot workers from a serving boot image (build_boot_image): "
+             "AOT-serialized bucket executables + fitted weights, first "
+             "request answered with zero fresh XLA compiles; a stale "
+             "image is refused (KV307) and the worker falls back to the "
+             "classic warm path",
+    )
+    parser.add_argument(
+        "--autoscale", action="store_true",
+        help="close the loop between SLO pressure and fleet size: scale "
+             "worker processes up under sustained p99/backlog pressure "
+             "and down on sustained idle (docs/SERVING.md)",
+    )
+    parser.add_argument(
+        "--min-workers", type=int, default=None,
+        help="autoscale floor (default 1)",
+    )
+    parser.add_argument(
+        "--max-workers", type=int, default=None,
+        help="autoscale ceiling (default max(4, --workers))",
+    )
 
 
 def serve_from_args(args) -> int:
     """Run the stdin/JSON front-end: one request per line
     (``{"id": ..., "x": [...]}`` or a bare array), one response line per
     request as it completes, then a final ``SERVE_STATS:{...}`` line."""
-    if args.workers > 1 or args.listen:
+    if (
+        args.workers > 1
+        or args.listen
+        or getattr(args, "autoscale", False)
+        or getattr(args, "boot_image", None)
+    ):
         # The supervised out-of-process runtime: N worker processes, a
         # crash-recovering supervisor, optional HTTP front-end. The
-        # single-worker in-process path below stays the default.
+        # single-worker in-process path below stays the default;
+        # autoscaling and boot images are fleet features, so either flag
+        # routes here too.
         from .frontend import serve_multiworker_from_args
 
         return serve_multiworker_from_args(args)

@@ -58,7 +58,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs.fleet import MONOTONIC_WORKER_COUNTERS, FleetTraceCollector
-from ..obs.flight import install_flight_recorder
+from ..obs.flight import get_flight_recorder, install_flight_recorder
 from ..reliability.recovery import get_recovery_log
 from ..reliability.retry import Deadline, RetryPolicy
 from .admission import AdmissionController
@@ -124,6 +124,10 @@ class SupervisorConfig:
     slo_target_p99_ms— enable the SLO controller at this target.
     max_batch / max_wait_ms / worker_queue_depth — forwarded to each
                        worker's ``ServingConfig``.
+    boot_image       — boot-image directory forwarded to every worker
+                       (``--boot-image``): spawned workers load AOT warm
+                       state instead of paying classic warm-up, falling
+                       back on a KV307 refusal.
     """
 
     workers: int = 2
@@ -144,6 +148,7 @@ class SupervisorConfig:
     worker_queue_depth: int = 64
     monitor_interval_s: float = 0.05
     drain_timeout_s: float = 30.0
+    boot_image: Optional[str] = None
 
 
 @dataclass
@@ -170,7 +175,11 @@ class _Worker:
     def __init__(self, worker_id: str):
         self.id = worker_id
         self.proc: Optional[subprocess.Popen] = None
-        self.state = "new"  # new | spawning | ready | dead | failed
+        # new | spawning | ready | draining | dead | failed. ``draining``
+        # is the scale-down limbo: out of the ring, refusing new work,
+        # finishing its in-flight — then retired (removed), not restarted.
+        self.state = "new"
+        self.drain_started = 0.0
         self.incarnation = -1
         self.restarts = 0
         self.restart_at = 0.0
@@ -222,6 +231,14 @@ class WorkerSupervisor:
         self._workers: Dict[str, _Worker] = {
             str(i): _Worker(str(i)) for i in range(self.config.workers)
         }
+        #: Next id handed out by add_worker — ids are never recycled, so
+        #: a retired worker's ledger/metrics history stays unambiguous.
+        self._next_worker_id = self.config.workers
+        #: Retired workers' folded lifetime counters + restart counts:
+        #: scale-down removes the _Worker handle, but the fleet /metrics
+        #: series and stats() aggregates must stay monotonic.
+        self._retired: Dict[str, Dict[str, float]] = {}
+        self._retired_restarts = 0
         self._ring = HashRing(list(self._workers))
         self._pending: "deque[_Pending]" = deque()
         self._request_ids = iter(range(1, 2**62))
@@ -255,6 +272,9 @@ class WorkerSupervisor:
         self._m_alive = _names.metric(_names.SERVING_WORKERS_ALIVE)
         self._m_beats = _names.metric(_names.SERVING_WORKER_HEARTBEATS)
         self._m_sheds = _names.metric(_names.SERVING_SHEDS)
+        self._m_scale_events = _names.metric(_names.SERVING_SCALE_EVENTS)
+        self._m_draining = _names.metric(_names.SERVING_SCALE_WORKERS_DRAINING)
+        self._m_drain_seconds = _names.metric(_names.SERVING_SCALE_DRAIN_SECONDS)
 
     # ---------------------------------------------------------------- control
     def _default_worker_cmd(self, worker_id: str) -> List[str]:
@@ -267,13 +287,17 @@ class WorkerSupervisor:
             "--max-batch", str(self.config.max_batch),
             "--max-wait-ms", str(self.config.max_wait_ms),
             "--queue-depth", str(self.config.worker_queue_depth),
-        ]
+        ] + (
+            ["--boot-image", self.config.boot_image]
+            if self.config.boot_image
+            else []
+        )
 
     def start(self) -> "WorkerSupervisor":
         if self._started:
             raise RuntimeError("supervisor already started")
         self._started = True
-        for worker in self._workers.values():
+        for worker in list(self._workers.values()):
             self._spawn(worker)
         self._monitor = threading.Thread(
             target=self._monitor_loop, name="keystone-supervisor", daemon=True
@@ -288,22 +312,30 @@ class WorkerSupervisor:
         self.stop()
 
     def wait_ready(self, n: Optional[int] = None, timeout_s: float = None) -> int:
-        """Block until ``n`` workers (default: all) are ready; returns the
-        ready count. Raises TimeoutError past ``timeout_s`` (default:
-        the config's ready timeout)."""
-        want = self.config.workers if n is None else n
+        """Block until ``n`` workers (default: every current non-draining,
+        non-failed member) are ready; returns the ready count. Raises
+        TimeoutError past ``timeout_s`` (default: the config's ready
+        timeout)."""
         deadline = Deadline(
             timeout_s if timeout_s is not None else self.config.ready_timeout_s
         )
         while True:
-            ready = sum(1 for w in self._workers.values() if w.state == "ready")
+            members = list(self._workers.values())
+            # Recomputed every pass: the autoscaler changes membership
+            # while callers wait.
+            want = (
+                sum(1 for w in members if w.state not in ("draining", "failed"))
+                if n is None
+                else n
+            )
+            ready = sum(1 for w in members if w.state == "ready")
             if ready >= want:
                 return ready
             if deadline.expired():
-                states = {w.id: w.state for w in self._workers.values()}
+                states = {w.id: w.state for w in members}
                 tails = {
                     w.id: list(w.stderr_tail)[-3:]
-                    for w in self._workers.values() if w.state != "ready"
+                    for w in members if w.state != "ready"
                 }
                 raise TimeoutError(
                     f"{ready}/{want} workers ready; states={states} stderr={tails}"
@@ -326,9 +358,9 @@ class WorkerSupervisor:
                     break
                 time.sleep(0.02)
         self._stop.set()
-        for worker in self._workers.values():
+        for worker in list(self._workers.values()):
             self._shutdown_worker(worker)
-        for worker in self._workers.values():
+        for worker in list(self._workers.values()):
             # Join the reader so each worker's exit stats line (final
             # counters) is folded in before stats() snapshots.
             if worker.reader_thread is not None:
@@ -421,6 +453,168 @@ class WorkerSupervisor:
             name=f"keystone-supervisor-err-{worker.id}",
             daemon=True,
         ).start()
+
+    # ----------------------------------------------------------- elastic fleet
+    def _rebuild_ring_locked(self) -> None:
+        """Rebuild the ring over current non-draining members (caller
+        holds the lock). A draining worker leaves the ring the instant
+        the drain starts, so new affinity keys resolve to their NEW owner
+        immediately — a key is never split across old and new owner
+        mid-drain (the old owner only finishes work it already holds)."""
+        members = [
+            worker_id
+            for worker_id, w in self._workers.items()
+            if w.state != "draining"
+        ]
+        self._ring = HashRing(members or list(self._workers))
+
+    def add_worker(self, reason: str = "scale_up") -> str:
+        """Scale up: add one worker to the fleet and spawn it. The new
+        member joins the ring immediately (routing skips it until it
+        reaches ``ready``, so booting never stalls traffic). Returns the
+        new worker id."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed()
+            worker_id = str(self._next_worker_id)
+            self._next_worker_id += 1
+            worker = _Worker(worker_id)
+            self._workers[worker_id] = worker
+            self._rebuild_ring_locked()
+        if self._started:
+            self._spawn(worker)
+        get_recovery_log().record(
+            "scale_up",
+            f"worker:{worker_id}",
+            reason=reason,
+            workers=len(self._workers),
+        )
+        self._m_scale_events.inc(direction="up")
+        recorder = get_flight_recorder()
+        if recorder is not None:
+            recorder.mark(
+                "scale_up", worker=worker_id, workers=len(self._workers)
+            )
+        return worker_id
+
+    def remove_worker(
+        self, worker_id: Optional[str] = None, reason: str = "scale_down"
+    ) -> Optional[str]:
+        """Scale down: pick a ready worker (default: the newest), mark it
+        ``draining``, and rebuild the ring without it. The monitor
+        retires it once its in-flight drains (or the drain times out, or
+        it dies — stranded work is requeued either way: zero dropped).
+        Returns the draining worker's id, or None when no worker can be
+        spared (never drains the last capable member)."""
+        with self._lock:
+            capable = [
+                w
+                for w in self._workers.values()
+                if w.state in ("new", "spawning", "ready")
+            ]
+            if worker_id is not None:
+                target = self._workers.get(worker_id)
+                if target is None or target.state != "ready":
+                    return None
+            else:
+                ready = sorted(
+                    (w for w in self._workers.values() if w.state == "ready"),
+                    key=lambda w: (int(w.id) if w.id.isdigit() else 0, w.id),
+                )
+                target = ready[-1] if ready else None
+            if target is None or len(capable) <= 1:
+                return None
+            target.state = "draining"
+            target.drain_started = time.monotonic()
+            inflight = len(target.inflight)
+            self._rebuild_ring_locked()
+            draining = sum(
+                1 for w in self._workers.values() if w.state == "draining"
+            )
+        get_recovery_log().record(
+            "scale_down",
+            f"worker:{target.id}",
+            reason=reason,
+            inflight=inflight,
+            workers=len(self._workers),
+        )
+        self._m_scale_events.inc(direction="down")
+        self._m_draining.set(draining)
+        self._publish_alive()
+        recorder = get_flight_recorder()
+        if recorder is not None:
+            recorder.mark("scale_down", worker=target.id, inflight=inflight)
+        return target.id
+
+    def _retire_worker(self, worker: _Worker, crashed: bool) -> None:
+        """Finish a drain: stop the process (gracefully unless it already
+        crashed/hung), fold its lifetime counters into the retired set,
+        remove it from the fleet, and requeue anything still stranded in
+        its in-flight map. The one exit path for ``draining`` workers —
+        they are never restarted."""
+        if crashed:
+            proc = worker.proc
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+            get_recovery_log().record(
+                "worker_crash",
+                f"worker:{worker.id}",
+                reason="crash",
+                incarnation=worker.incarnation,
+                exit_code=worker.proc.poll() if worker.proc else None,
+                inflight=len(worker.inflight),
+                pid=worker.pid,
+            )
+        else:
+            self._shutdown_worker(worker)
+        if worker.reader_thread is not None:
+            # Fold the exit stats line (final counters) before retiring.
+            worker.reader_thread.join(2.0)
+        drain_s = (
+            time.monotonic() - worker.drain_started
+            if worker.drain_started
+            else 0.0
+        )
+        with self._lock:
+            stranded = [
+                p for p in worker.inflight.values() if not p.future.done()
+            ]
+            worker.inflight.clear()
+            totals = self._retired.setdefault(worker.id, {})
+            for counter in MONOTONIC_WORKER_COUNTERS:
+                value = worker.counter_base.get(
+                    counter, 0.0
+                ) + worker.counter_hw.get(counter, 0.0)
+                if value:
+                    totals[counter] = totals.get(counter, 0.0) + value
+            self._retired_restarts += worker.restarts
+            self._workers.pop(worker.id, None)
+            self._rebuild_ring_locked()
+            draining = sum(
+                1 for w in self._workers.values() if w.state == "draining"
+            )
+        for pending in stranded:
+            pending.requeues += 1
+            with self._lock:
+                self.requeued += 1
+            self._m_requeued.inc()
+            self._route_or_park(pending, exclude=worker.id)
+        get_recovery_log().record(
+            "worker_retired",
+            f"worker:{worker.id}",
+            crashed=crashed,
+            drain_s=round(drain_s, 3),
+            requeued=len(stranded),
+            workers=len(self._workers),
+        )
+        self._m_drain_seconds.observe(drain_s)
+        self._m_draining.set(draining)
+        self._publish_alive()
+        recorder = get_flight_recorder()
+        if recorder is not None:
+            recorder.mark(
+                "worker_retired", worker=worker.id, crashed=crashed
+            )
 
     # ----------------------------------------------------------------- reader
     def _reader_loop(
@@ -548,7 +742,8 @@ class WorkerSupervisor:
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
             now = time.monotonic()
-            for worker in self._workers.values():
+            # Snapshot: scale events mutate membership mid-iteration.
+            for worker in list(self._workers.values()):
                 if worker.state in ("spawning", "ready"):
                     if not worker.alive:
                         self._declare_dead(worker, "crash")
@@ -562,6 +757,22 @@ class WorkerSupervisor:
                         and now - worker.spawn_at > self.config.ready_timeout_s
                     ):
                         self._declare_dead(worker, "hang")
+                elif worker.state == "draining":
+                    # A draining worker only finishes what it holds. Dead
+                    # or hung mid-drain: retire as a crash (stranded work
+                    # requeued — still zero dropped). Otherwise retire
+                    # gracefully once the in-flight empties or the drain
+                    # budget expires.
+                    if not worker.alive:
+                        self._retire_worker(worker, crashed=True)
+                    elif now - worker.last_beat > self.config.hang_timeout_s:
+                        self._retire_worker(worker, crashed=True)
+                    elif (
+                        not worker.inflight
+                        or now - worker.drain_started
+                        > self.config.drain_timeout_s
+                    ):
+                        self._retire_worker(worker, crashed=False)
                 elif worker.state == "dead" and now >= worker.restart_at:
                     self._spawn(worker)
             self._expire_pending()
@@ -569,7 +780,7 @@ class WorkerSupervisor:
             if self.slo is not None:
                 snapshots = {
                     w.id: w.stats
-                    for w in self._workers.values()
+                    for w in list(self._workers.values())
                     if w.state == "ready" and w.stats
                 }
                 if snapshots:
@@ -623,7 +834,7 @@ class WorkerSupervisor:
                 self.requeued += 1
             self._m_requeued.inc()
             self._route_or_park(pending, exclude=worker.id)
-        if all(w.state == "failed" for w in self._workers.values()):
+        if all(w.state == "failed" for w in list(self._workers.values())):
             with self._lock:
                 orphans = self._drain_outstanding_locked()
             for pending in orphans:
@@ -636,7 +847,7 @@ class WorkerSupervisor:
 
     def _publish_alive(self) -> None:
         self._m_alive.set(
-            sum(1 for w in self._workers.values() if w.state == "ready")
+            sum(1 for w in list(self._workers.values()) if w.state == "ready")
         )
 
     # ----------------------------------------------------------------- submit
@@ -864,7 +1075,7 @@ class WorkerSupervisor:
         resolved (registry contract); each worker re-warms before the ack,
         so post-settle steady state does zero compiles."""
         msg = {"kind": "swap", "name": name or self.config.model_name, "spec": spec}
-        targets = [w for w in self._workers.values() if w.state == "ready"]
+        targets = [w for w in list(self._workers.values()) if w.state == "ready"]
         acks: Dict[str, Dict[str, Any]] = {}
         for worker in targets:
             with self._lock:
@@ -902,7 +1113,7 @@ class WorkerSupervisor:
         current high-water): monotonic across restarts by construction —
         the series the fleet /metrics exposition publishes."""
         with self._lock:
-            return {
+            totals = {
                 w.id: {
                     counter: w.counter_base.get(counter, 0.0)
                     + w.counter_hw.get(counter, 0.0)
@@ -910,6 +1121,16 @@ class WorkerSupervisor:
                 }
                 for w in self._workers.values()
             }
+            # Retired (scaled-down) workers keep their series: a counter
+            # that vanished mid-scrape would read as a reset.
+            for worker_id, folded in self._retired.items():
+                row = totals.setdefault(
+                    worker_id,
+                    {c: 0.0 for c in MONOTONIC_WORKER_COUNTERS},
+                )
+                for counter, value in folded.items():
+                    row[counter] = row.get(counter, 0.0) + value
+            return totals
 
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, Any]:
@@ -938,11 +1159,20 @@ class WorkerSupervisor:
                 for w in self._workers.values()
             }
             pending = len(self._pending)
+            retired = {
+                worker_id: dict(folded)
+                for worker_id, folded in self._retired.items()
+            }
+            retired_restarts = self._retired_restarts
         aggregate: Dict[str, Any] = {}
         for counter in MONOTONIC_WORKER_COUNTERS:
             values = [
                 w["lifetime"].get(counter) for w in workers.values()
                 if isinstance(w["lifetime"].get(counter), (int, float))
+            ] + [
+                folded[counter]
+                for folded in retired.values()
+                if counter in folded
             ]
             if values:
                 aggregate[counter] = int(sum(values))
@@ -984,7 +1214,17 @@ class WorkerSupervisor:
             "supervisor": {
                 "alive": sum(1 for w in workers.values() if w["state"] == "ready"),
                 "configured": self.config.workers,
-                "restarts": sum(w["restarts"] for w in workers.values()),
+                "workers": len(workers),
+                "booting": sum(
+                    1 for w in workers.values()
+                    if w["state"] in ("new", "spawning")
+                ),
+                "draining": sum(
+                    1 for w in workers.values() if w["state"] == "draining"
+                ),
+                "retired": len(retired),
+                "restarts": retired_restarts
+                + sum(w["restarts"] for w in workers.values()),
                 "requeued": self.requeued,
                 "pending": pending,
                 "admission": self.admission.stats(),

@@ -233,7 +233,29 @@ class ServerBackend:
 
         self.name = args.model_name
         self.registry = ModelRegistry()
-        self._example = _load_spec(self.registry, self.name, spec)
+        # Boot-image door: load AOT-serialized warm state instead of
+        # paying classic warm-up. A KV307 refusal (stale/mismatched
+        # image) falls through to the classic path — slower first
+        # request, never garbage; the refusal is already in the ledger.
+        self.boot_image = None
+        if getattr(args, "boot_image", None):
+            import numpy as np
+
+            from .bootimage import BootImageRefused, load_boot_image
+
+            try:
+                image = load_boot_image(args.boot_image)
+                self.registry.publish(
+                    self.name, image, source=f"bootimage:{args.boot_image}"
+                )
+                shape = tuple(image.manifest["example"]["shape"])
+                dtype = np.dtype(image.manifest["example"]["dtype"])
+                self._example = np.zeros(shape, dtype)
+                self.boot_image = "loaded"
+            except BootImageRefused:
+                self.boot_image = "refused"
+        if self.boot_image != "loaded":
+            self._example = _load_spec(self.registry, self.name, spec)
         config = ServingConfig(
             max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms,
@@ -374,6 +396,12 @@ def add_worker_arguments(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
     parser.add_argument("--queue-depth", type=int, default=64)
+    parser.add_argument(
+        "--boot-image",
+        default=None,
+        help="boot-image directory (serving/bootimage.py): load AOT "
+        "warm state instead of classic warm-up; falls back on refusal",
+    )
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -402,6 +430,7 @@ def main(argv: Optional[list] = None) -> int:
             "worker": args.worker_id,
             "pid": os.getpid(),
             "mode": backend.mode,
+            "boot_image": getattr(backend, "boot_image", None),
             "init_s": round(time.monotonic() - t0, 3),
             # Clock anchor for the fleet trace's alignment handshake.
             "clock": {"unix": time.time(), "perf": time.perf_counter()},

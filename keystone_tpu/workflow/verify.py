@@ -32,6 +32,12 @@ KV306     error     a persisted mid-stream resume entry's fingerprints
                     digest, featurized width/dtype) disagree with the
                     re-planned pipeline — seeding a fold from it would
                     silently corrupt the fit (:func:`verify_stream_resume`)
+KV307     error     a serving boot image's environment fingerprints
+                    (format version, jax version, backend, device kind,
+                    weights digest) disagree with the loading worker's —
+                    serving through its executables could return garbage;
+                    the image is refused and the worker falls back to the
+                    classic warm path (:func:`verify_boot_image`)
 KV401     error     dependency cycle in the graph
 KV402     info      node not statically analyzable (no ``out_spec``,
                     not eval_shape-able) — propagation continues unknown
@@ -105,6 +111,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "KV304": (ERROR, "sharded per-device residency exceeds memory budget"),
     "KV305": (ERROR, "refit candidate disagrees with incumbent warm state"),
     "KV306": (ERROR, "stale stream-resume entry refused"),
+    "KV307": (ERROR, "stale boot image refused"),
     "KV401": (ERROR, "dependency cycle"),
     "KV402": (INFO, "node not statically analyzable"),
 }
@@ -1243,6 +1250,63 @@ def verify_stream_resume(
                 field=field_name,
                 entry=str(have)[:16],
                 planned=str(want)[:16],
+            )
+    report.seconds = time.perf_counter() - t0
+    _publish(report, context)
+    return report
+
+
+#: manifest/environment fields verify_boot_image compares, with the human
+#: titles its diagnostics use. serving/bootimage.py builds both sides.
+BOOT_IMAGE_FINGERPRINTS: Tuple[Tuple[str, str], ...] = (
+    ("format_version", "artifact format version"),
+    ("jax_version", "jax version"),
+    ("backend", "jax backend"),
+    ("device_kind", "device kind"),
+    ("weights_digest", "fitted-weights digest"),
+)
+
+
+def verify_boot_image(
+    manifest: Dict[str, Any],
+    current: Dict[str, Any],
+    context: str = "boot-image",
+) -> VerifyReport:
+    """The serving face of stale-state corruption (docs/SERVING.md
+    "Elastic fleet", docs/VERIFICATION.md KV307).
+
+    A boot image carries AOT-serialized bucket executables plus the
+    fitted weights they were exported from — sound to serve through only
+    when the loading worker's environment matches the builder's: same
+    artifact format, same jax version (export/deserialize compatibility),
+    same backend and device kind (the serialized executables ride the
+    persistent compilation cache, which is environment-keyed exactly like
+    ProfileStore entries), and the same weights digest (an image whose
+    executables baked different weights than ``model.pkl`` would answer
+    with the WRONG model). Any disagreement refuses the image: the worker
+    falls back to the classic warm path — slower first request, never
+    garbage. Pure host-side comparison, zero device execution.
+
+    ``manifest`` and ``current`` both map the fingerprint field names
+    from :data:`BOOT_IMAGE_FINGERPRINTS` to their values (the image's
+    recorded environment vs the loading process's observed one).
+    """
+    t0 = time.perf_counter()
+    report = VerifyReport(context=context)
+    interp = _Interpreter(Graph(), report.diagnostics, probe_objects=False)
+    for field_name, title in BOOT_IMAGE_FINGERPRINTS:
+        have = manifest.get(field_name)
+        want = current.get(field_name)
+        if have != want:
+            interp.diag(
+                "KV307",
+                f"boot image's {title} ({str(have)[:24]}) disagrees with "
+                f"this worker's ({str(want)[:24]}) — serving through its "
+                "executables could return garbage; the image is refused "
+                "and the worker warms through the classic path",
+                field=field_name,
+                image=str(have)[:24],
+                worker=str(want)[:24],
             )
     report.seconds = time.perf_counter() - t0
     _publish(report, context)

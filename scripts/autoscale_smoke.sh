@@ -1,0 +1,201 @@
+#!/usr/bin/env bash
+# Elastic-fleet smoke: run `keystone-tpu serve --workers 1 --autoscale`
+# on CPU, drive a seeded stdin spike, and assert the autoscaling story
+# end to end (docs/SERVING.md "Elastic fleet"):
+#
+#   - the spike drives a scale-up (fleet grows past the configured
+#     floor, visible live in /stats and in the scale-event metrics)
+#   - the scale-up worker is SIGKILLed mid-scale-event (deterministic
+#     kill spec via KEYSTONE_FAULT_SPECS_WORKER_1, first incarnation
+#     only) and the fleet resolves: restart within the backoff budget,
+#     ring consistent, traffic flowing the whole time
+#   - post-scale traffic is absorbed INSIDE the SLO (measured p99 of
+#     paced HTTP probes < --slo-p99-ms)
+#   - the idle tail drives a scale-down back toward the floor
+#   - ZERO dropped requests across the whole elastic cycle
+#   - zero steady-state compiles on every worker (boot warm only)
+#   - scale_up + scale_down + worker_crash all land in the recovery
+#     ledger (carried on the SERVE_STATS line)
+#
+# This is the CI face of tests/serving/test_autoscaler.py (control law)
+# and the `serving_autoscale` bench leg (latency story).
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+timeout -k 10 540 python - <<'EOF'
+import json, os, re, subprocess, sys, threading, time, urllib.request
+
+D = 8
+SLO_MS = 250.0
+KILL_AT = 3           # worker 1's 3rd request: mid-scale-event
+RESTART_BUDGET_S = 6.5 + 90.0  # backoff schedule sum + spawn slack
+
+env = dict(
+    os.environ,
+    JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+    # Arms the FIRST incarnation of the scale-up worker only: the
+    # restart must come back clean and serve.
+    KEYSTONE_FAULT_SPECS_WORKER_1=json.dumps(
+        [{"match": "serving.worker.request", "kind": "kill", "calls": [KILL_AT]}]
+    ),
+)
+proc = subprocess.Popen(
+    # No --slo-p99-ms: that arms the ADMISSION ladder (shed under
+    # pressure) — this smoke asserts the other answer to pressure,
+    # scaling, where every request is answered. The autoscaler runs on
+    # its default pressure line; SLO_MS gates the probe p99 below.
+    [sys.executable, "-m", "keystone_tpu", "serve",
+     "--synthetic", str(D), "--workers", "1", "--max-batch", "4",
+     "--queue-depth", "2048",  # the spike QUEUES (worker-side) — scaling
+                               # answers it, shedding would fail the gate
+     "--autoscale", "--min-workers", "1", "--max-workers", "2",
+     "--listen", "127.0.0.1:0"],
+    stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    text=True, bufsize=1, env=env,
+)
+
+# stderr: SERVE_LISTEN once bound. stdout: one JSON line per answered
+# request + the final SERVE_STATS line — a reader THREAD keeps the pipe
+# drained (the spike would deadlock a 64KB pipe otherwise).
+port_box, stderr_tail, out_lines = [], [], []
+def read_stderr():
+    for line in proc.stderr:
+        stderr_tail.append(line.rstrip())
+        if line.startswith("SERVE_LISTEN:"):
+            port_box.append(int(line.strip().rsplit(":", 1)[1]))
+def read_stdout():
+    for line in proc.stdout:
+        if line.strip():
+            out_lines.append(line.rstrip())
+threading.Thread(target=read_stderr, daemon=True).start()
+threading.Thread(target=read_stdout, daemon=True).start()
+
+deadline = time.monotonic() + 240
+while not port_box:
+    assert proc.poll() is None, "server died during startup:\n" + "\n".join(stderr_tail[-20:])
+    assert time.monotonic() < deadline, "no SERVE_LISTEN within 240s"
+    time.sleep(0.1)
+port = port_box[0]
+
+def http_get(path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return r.read().decode()
+
+def http_stats():
+    return json.loads(http_get("/stats"))
+
+def scale_events(direction):
+    text = http_get("/metrics")
+    pat = rf'keystone_serving_scale_events_total{{direction="?{direction}"?}}\s+([0-9.]+)'
+    m = re.search(pat, text)
+    return float(m.group(1)) if m else 0.0
+
+next_id = 0
+def send(n, gap_s=0.0):
+    global next_id
+    for _ in range(n):
+        proc.stdin.write(json.dumps({"id": next_id, "x": [float(next_id % 7)] * D,
+                                     "deadline_ms": 120000}) + "\n")
+        next_id += 1
+        if gap_s:
+            time.sleep(gap_s)
+    proc.stdin.flush()
+
+# Phase 1 — the spike: mini-bursts keep the supervisor's pending queue
+# standing (pressure) until the autoscaler adds worker 1. Flow control
+# against answered responses keeps outstanding work under the 1024
+# admission cap — the invariant is zero sheds, not maximum chaos.
+t0 = time.monotonic()
+while True:
+    if next_id - len(out_lines) < 600:
+        send(300)
+    stats = http_stats()
+    if len(stats["workers"]) >= 2:
+        scale_up_wait = time.monotonic() - t0
+        break
+    assert time.monotonic() - t0 < 60, (
+        f"no scale-up within 60s: {stats['supervisor']}")
+    time.sleep(0.05)
+t0 = time.monotonic()
+while scale_events("up") < 1:
+    assert time.monotonic() - t0 < 10, (
+        "scale_up event not visible in /metrics:\n" + http_get("/metrics"))
+    time.sleep(0.2)
+
+# Phase 2 — kill mid-scale-event: a paced trickle routes requests onto
+# worker 1 as soon as it is ready; its armed kill spec fires on request
+# KILL_AT, and the supervisor must restart it within the backoff budget
+# while the ring stays consistent (worker 0 absorbs the requeue).
+t0 = time.monotonic()
+while True:
+    send(5, gap_s=0.005)
+    w1 = http_stats()["workers"].get("1")
+    if w1 and w1["state"] == "ready" and w1["incarnation"] >= 1:
+        restart_wait = time.monotonic() - t0
+        break
+    assert time.monotonic() - t0 < RESTART_BUDGET_S, (
+        f"worker 1 not crashed+restarted within {RESTART_BUDGET_S}s: {w1}")
+    time.sleep(0.05)
+
+# Phase 3 — absorbed inside the SLO: paced HTTP probes against the
+# scaled fleet; measured p99 must sit under --slo-p99-ms.
+lat_ms = []
+for i in range(40):
+    body = json.dumps({"x": [1.0] * D, "deadline_ms": 120000}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/apply", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    t = time.monotonic()
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.status == 200, r.status
+        json.loads(r.read())
+    lat_ms.append((time.monotonic() - t) * 1e3)
+    time.sleep(0.02)
+lat_ms.sort()
+probe_p99 = lat_ms[int(0.99 * (len(lat_ms) - 1))]
+assert probe_p99 < SLO_MS, (
+    f"post-scale p99 {probe_p99:.1f}ms breaches the {SLO_MS}ms SLO")
+
+# Phase 4 — the idle tail: no more traffic; sustained idle must drain
+# the fleet back down (idle_s + cooldown, then the drain itself).
+t0 = time.monotonic()
+while scale_events("down") < 1:
+    assert time.monotonic() - t0 < 60, (
+        f"no scale-down within 60s of idle: {http_stats()['supervisor']}")
+    time.sleep(0.25)
+scale_down_wait = time.monotonic() - t0
+
+proc.stdin.close()
+assert proc.wait(timeout=240) == 0, "\n".join(stderr_tail[-20:])
+time.sleep(0.2)  # let the reader thread drain the tail
+
+stats_lines = [l for l in out_lines if l.startswith("SERVE_STATS:")]
+assert len(stats_lines) == 1, f"expected one stats line, got {len(stats_lines)}"
+stats = json.loads(stats_lines[0][len("SERVE_STATS:"):])
+responses = [json.loads(l) for l in out_lines if not l.startswith("SERVE_STATS:")]
+
+errors = [r for r in responses if "error" in r]
+assert not errors, f"{len(errors)} errored responses, first: {errors[0]}"
+assert len(responses) == next_id, (
+    f"DROPPED: {next_id - len(responses)} of {next_id} requests unanswered")
+assert {r["id"] for r in responses} == set(range(next_id)), "response ids incomplete"
+
+scaler = stats["autoscaler"]
+assert scaler["scale_ups"] >= 1 and scaler["scale_downs"] >= 1, scaler
+kinds = {e["kind"] for e in stats["recovery"]["events"]}
+for needed in ("scale_up", "scale_down", "worker_crash"):
+    assert needed in kinds, f"{needed} missing from recovery ledger: {kinds}"
+for wid, w in stats["workers"].items():
+    compiles = (w.get("stats") or {}).get("xla_compiles_since_warmup")
+    if compiles is not None:
+        assert compiles == 0, f"worker {wid} compiled in steady state: {compiles}"
+
+print(f"autoscale_smoke OK: {next_id} requests, 0 dropped, "
+      f"scale_up_wait={scale_up_wait:.1f}s, crash+restart={restart_wait:.1f}s, "
+      f"probe_p99={probe_p99:.1f}ms (SLO {SLO_MS:.0f}ms), "
+      f"scale_down_wait={scale_down_wait:.1f}s, "
+      f"ups={scaler['scale_ups']}, downs={scaler['scale_downs']}, "
+      f"steady-state compiles=0")
+EOF

@@ -1,0 +1,435 @@
+"""Autoscaler: the control law (clock-injected, deterministic) and the
+elastic-fleet machinery it drives (stub workers, real processes).
+
+The control-law tests drive ``Autoscaler.step(now=...)`` against a fake
+supervisor so hysteresis/cooldown/bounds are exact. The fleet tests run
+real STUB worker processes through ``WorkerSupervisor.add_worker`` /
+``remove_worker`` — the zero-dropped-in-flight invariant under scale
+events, including a worker SIGKILLed mid-scale-event (the chaos case the
+failure matrix in docs/SERVING.md pins). Real-jax scale behavior is
+covered by scripts/autoscale_smoke.sh and the serving_autoscale bench
+leg."""
+
+import json
+import time
+
+import pytest
+
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
+from keystone_tpu.serving.supervisor import SupervisorConfig, WorkerSupervisor
+
+pytestmark = pytest.mark.serving
+
+
+# ----------------------------------------------------- control law (no procs)
+
+
+class FakeFleet:
+    """The stats/add_worker/remove_worker surface the autoscaler drives,
+    with hand-cranked traffic."""
+
+    def __init__(self, workers=1):
+        self.rows = {}
+        self._next = 0
+        for _ in range(workers):
+            self._add("ready")
+        self.pending = 0
+        self.added = []
+        self.removed = []
+
+    def _add(self, state):
+        worker_id = str(self._next)
+        self._next += 1
+        self.rows[worker_id] = {
+            "state": state, "inflight": 0,
+            "stats": {"served": 0, "p99_ms": 1.0},
+        }
+        return worker_id
+
+    def tick(self, p99_ms, served_inc=32, worker_id=None):
+        """One window of traffic: bump served (freshness) and set p99."""
+        for wid, row in self.rows.items():
+            if row["state"] == "ready" and worker_id in (None, wid):
+                row["stats"]["served"] += served_inc
+                row["stats"]["p99_ms"] = p99_ms
+
+    def stats(self):
+        states = [r["state"] for r in self.rows.values()]
+        return {
+            "workers": {
+                wid: {
+                    "state": r["state"], "inflight": r["inflight"],
+                    "stats": dict(r["stats"]),
+                }
+                for wid, r in self.rows.items()
+            },
+            "supervisor": {
+                "alive": states.count("ready"),
+                "booting": sum(1 for s in states if s in ("new", "spawning")),
+                "draining": states.count("draining"),
+                "pending": self.pending,
+            },
+        }
+
+    def add_worker(self, reason="scale_up"):
+        worker_id = self._add("spawning")
+        self.added.append((worker_id, reason))
+        return worker_id
+
+    def remove_worker(self, worker_id=None, reason="scale_down"):
+        ready = [w for w, r in self.rows.items() if r["state"] == "ready"]
+        if len(ready) <= 1:
+            return None
+        target = worker_id or ready[-1]
+        self.rows[target]["state"] = "draining"
+        self.removed.append((target, reason))
+        return target
+
+
+def make_scaler(fleet, **cfg):
+    defaults = dict(
+        target_p99_ms=50.0, min_workers=1, max_workers=3,
+        pressure_s=1.0, idle_s=2.0, cooldown_s=5.0, min_served=16,
+    )
+    defaults.update(cfg)
+    return Autoscaler(fleet, AutoscalerConfig(**defaults))
+
+
+def test_config_bounds_validate():
+    with pytest.raises(ValueError):
+        Autoscaler(FakeFleet(), AutoscalerConfig(min_workers=0))
+    with pytest.raises(ValueError):
+        Autoscaler(
+            FakeFleet(), AutoscalerConfig(min_workers=3, max_workers=2)
+        )
+
+
+def test_sustained_pressure_scales_up_and_cooldown_limits_rate():
+    fleet = FakeFleet(workers=1)
+    scaler = make_scaler(fleet)
+    # Pressure must PERSIST pressure_s before an event fires.
+    fleet.tick(p99_ms=200.0)
+    assert scaler.step(now=0.0) is None
+    fleet.tick(p99_ms=200.0)
+    assert scaler.step(now=0.5) is None
+    fleet.tick(p99_ms=200.0)
+    assert scaler.step(now=1.0) == "up:1"
+    assert fleet.added == [("1", "slo_pressure")]
+    # Cooldown: continued pressure cannot fire again inside cooldown_s.
+    fleet.rows["1"]["state"] = "ready"
+    for now in (1.5, 3.0, 5.0, 5.9):
+        fleet.tick(p99_ms=200.0)
+        assert scaler.step(now=now) is None
+    # Pressure that PERSISTED through the whole cooldown means the first
+    # scale-up didn't absorb it: the next event fires as soon as the
+    # cooldown expires.
+    fleet.tick(p99_ms=200.0)
+    assert scaler.step(now=6.5) == "up:2"
+    assert scaler.stats()["scale_ups"] == 2
+
+
+def test_one_slow_window_is_not_pressure():
+    fleet = FakeFleet(workers=1)
+    scaler = make_scaler(fleet)
+    fleet.tick(p99_ms=200.0)  # one bad window...
+    assert scaler.step(now=0.0) is None
+    fleet.tick(p99_ms=5.0)  # ...then healthy: the pressure timer resets
+    assert scaler.step(now=0.9) is None
+    fleet.tick(p99_ms=200.0)
+    assert scaler.step(now=1.8) is None  # window restarted at 1.8
+    assert fleet.added == []
+
+
+def test_stale_window_contributes_no_pressure():
+    """A worker whose served count stopped moving reports a p99 from OLD
+    traffic — it must not drive scale-up."""
+    fleet = FakeFleet(workers=1)
+    scaler = make_scaler(fleet)
+    fleet.tick(p99_ms=500.0)
+    assert scaler.step(now=0.0) is None  # fresh once: pressure starts
+    # served never moves again: every later step reads the window stale.
+    for now in (1.0, 2.0, 3.0):
+        assert scaler.step(now=now) is None
+    assert fleet.added == []
+
+
+def test_small_window_is_too_noisy_to_act_on():
+    fleet = FakeFleet(workers=1)
+    scaler = make_scaler(fleet, min_served=64)
+    for now in (0.0, 1.0, 2.0):
+        fleet.tick(p99_ms=500.0, served_inc=4)  # 4, 8, 12 < 64 served
+        assert scaler.step(now=now) is None
+    assert fleet.added == []
+
+
+def test_backlog_pressure_fires_even_with_healthy_p99():
+    """The pipe-backlog signal: a serial worker's percentile window can
+    look healthy while dispatched-but-unanswered work piles up."""
+    fleet = FakeFleet(workers=1)
+    scaler = make_scaler(fleet, backlog_per_worker=8.0)
+    fleet.rows["0"]["inflight"] = 20  # 20 in flight per 1 unit capacity
+    fleet.tick(p99_ms=1.0)
+    assert scaler.step(now=0.0) is None
+    fleet.tick(p99_ms=1.0)
+    assert scaler.step(now=1.0) == "up:1"
+
+
+def test_booting_worker_counts_toward_capacity():
+    """Pressure during a boot must not spawn a second worker for the
+    same spike — and at max_workers the fleet stops growing."""
+    fleet = FakeFleet(workers=1)
+    scaler = make_scaler(fleet, max_workers=2, cooldown_s=0.0)
+    fleet.tick(p99_ms=200.0)
+    scaler.step(now=0.0)
+    fleet.tick(p99_ms=200.0)
+    assert scaler.step(now=1.0) == "up:1"
+    # Worker 1 still spawning: capacity is 2 == max, no second spawn.
+    for now in (2.5, 4.0, 6.0):
+        fleet.tick(p99_ms=200.0)
+        assert scaler.step(now=now) is None
+    assert len(fleet.added) == 1
+
+
+def test_sustained_idle_scales_down_to_min_and_stops():
+    fleet = FakeFleet(workers=3)
+    scaler = make_scaler(fleet, min_workers=1, cooldown_s=0.0, idle_s=2.0)
+    assert scaler.step(now=0.0) is None  # idle timer starts
+    assert scaler.step(now=1.0) is None
+    assert scaler.step(now=2.0) == "down:2"
+    assert fleet.removed == [("2", "idle")]
+    # The draining worker blocks further events until it retires.
+    assert scaler.step(now=4.5) is None
+    del fleet.rows["2"]  # retire lands
+    assert scaler.step(now=5.0) is None  # idle window restarts post-event
+    assert scaler.step(now=7.0) == "down:1"
+    del fleet.rows["1"]
+    # At min_workers: never below.
+    for now in (9.0, 12.0, 20.0):
+        assert scaler.step(now=now) is None
+    assert len(fleet.removed) == 2
+    assert scaler.stats()["scale_downs"] == 2
+
+
+def test_pending_queue_blocks_idle_and_reads_as_pressure():
+    fleet = FakeFleet(workers=2)
+    scaler = make_scaler(fleet, cooldown_s=0.0)
+    fleet.pending = 3  # parked requests: the fleet is NOT idle
+    assert scaler.step(now=0.0) is None
+    fleet.tick(p99_ms=1.0)
+    assert scaler.step(now=1.5) == "up:2"
+    assert fleet.removed == []
+
+
+def test_remove_refusal_is_not_a_scale_event():
+    class StubbornFleet(FakeFleet):
+        def remove_worker(self, worker_id=None, reason="scale_down"):
+            return None  # nothing sparable (e.g. all holding in-flight)
+
+    fleet = StubbornFleet(workers=2)
+    scaler = make_scaler(fleet, cooldown_s=0.0, idle_s=1.0)
+    scaler.step(now=0.0)
+    assert scaler.step(now=1.5) is None
+    assert scaler.events == []  # a refused remove is not an event
+
+
+# ------------------------------------------------- elastic fleet (stub procs)
+
+
+def make_supervisor(workers=1, delay_ms=0, chaos=None, **cfg):
+    defaults = dict(
+        workers=workers,
+        heartbeat_s=0.05,
+        hang_timeout_s=0.8,
+        ready_timeout_s=15.0,
+        monitor_interval_s=0.02,
+    )
+    defaults.update(cfg)
+    env = {}
+    for worker_id, specs in (chaos or {}).items():
+        env[f"KEYSTONE_FAULT_SPECS_WORKER_{worker_id}"] = json.dumps(specs)
+    return WorkerSupervisor(
+        {"stub": {"delay_ms": delay_ms}}, SupervisorConfig(**defaults), env=env
+    )
+
+
+def settle(futures, timeout=30):
+    return [f.result(timeout=timeout) for f in futures]
+
+
+def test_scale_up_then_down_zero_dropped_and_ledgered():
+    """The elastic-fleet invariant end to end: grow under load, shrink
+    on idle, and every submitted request answers — the departing worker
+    drains instead of dropping."""
+    sup = make_supervisor(workers=1, delay_ms=2).start()
+    try:
+        sup.wait_ready()
+        futures = [sup.submit([float(i)], deadline_s=30) for i in range(20)]
+        new_id = sup.add_worker(reason="slo_pressure")
+        assert new_id == "1"
+        sup.wait_ready(n=2, timeout_s=15)
+        futures += [
+            sup.submit([float(i)], deadline_s=30) for i in range(20, 40)
+        ]
+        removed = sup.remove_worker()
+        assert removed == "1"  # newest ready worker drains by default
+        # Keep submitting THROUGH the drain: the ring already excludes
+        # the draining worker, so these all land on worker 0.
+        futures += [
+            sup.submit([float(i)], deadline_s=30) for i in range(40, 60)
+        ]
+        results = settle(futures)
+        assert [r[0] for r in results] == [2.0 * i for i in range(60)]
+        # The drain retires the worker (in-flight empties fast here).
+        deadline = time.monotonic() + 10
+        while "1" in sup.stats()["workers"]:
+            assert time.monotonic() < deadline, "drained worker never retired"
+            time.sleep(0.05)
+        kinds = {e.kind for e in get_recovery_log().events()}
+        assert {"scale_up", "scale_down", "worker_retired"} <= kinds
+        retired = get_recovery_log().events("worker_retired")[-1]
+        assert retired.detail["crashed"] is False
+        stats = sup.stats()
+        assert stats["supervisor"]["workers"] == 1
+        assert stats["supervisor"]["retired"] == 1
+        # Lifetime counters survive retirement (the /metrics contract).
+        assert "1" in sup.fleet_counter_totals()
+        assert sup.fleet_counter_totals()["1"]["served"] > 0
+    finally:
+        sup.stop()
+
+
+def test_worker_ids_never_recycle():
+    sup = make_supervisor(workers=1).start()
+    try:
+        sup.wait_ready()
+        assert sup.add_worker() == "1"
+        sup.wait_ready(n=2, timeout_s=15)
+        assert sup.remove_worker(worker_id="1") == "1"
+        deadline = time.monotonic() + 10
+        while "1" in sup.stats()["workers"]:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        # A later scale-up must NOT reuse "1": stats, ledger entries and
+        # retained counters keyed by id would alias two lifetimes.
+        assert sup.add_worker() == "2"
+    finally:
+        sup.stop()
+
+
+def test_remove_refuses_last_capable_worker():
+    sup = make_supervisor(workers=1).start()
+    try:
+        sup.wait_ready()
+        assert sup.remove_worker() is None
+        assert settle([sup.submit([3.0])])[0] == [6.0]
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------------------ chaos: kill mid-scale
+
+
+def test_sigkill_new_worker_mid_scale_up_resolves_consistent():
+    """A scale-up worker SIGKILLed right after joining must resolve to a
+    consistent fleet: no dropped requests (stranded work requeues), no
+    orphaned in-flight, and the supervisor restarts it like any other
+    member."""
+    chaos = {"1": [{"match": "serving.worker.request", "kind": "kill",
+                    "calls": [3]}]}
+    sup = make_supervisor(workers=1, delay_ms=2, chaos=chaos).start()
+    try:
+        sup.wait_ready()
+        assert sup.add_worker(reason="slo_pressure") == "1"
+        sup.wait_ready(n=2, timeout_s=15)
+        futures = [sup.submit([float(i)], deadline_s=30) for i in range(40)]
+        results = settle(futures)
+        assert [r[0] for r in results] == [2.0 * i for i in range(40)]
+        assert sup.requeued > 0, "the kill stranded no in-flight work"
+        kinds = {e.kind for e in get_recovery_log().events()}
+        assert "scale_up" in kinds and "worker_crash" in kinds
+        # The killed scale-up worker restarts and the ring serves again.
+        sup.wait_ready(n=2, timeout_s=20)
+        assert settle([sup.submit([5.0])])[0] == [10.0]
+    finally:
+        sup.stop()
+
+
+def test_sigkill_draining_worker_requeues_and_retires_as_crash():
+    """Kill DURING the drain: a scale-down worker that dies mid-drain
+    must still strand zero requests — its remaining in-flight requeues
+    onto the survivors and the retire is recorded as a crash."""
+    chaos = {"1": [{"match": "serving.worker.request", "kind": "kill",
+                    "calls": [6]}]}
+    sup = make_supervisor(
+        workers=2, delay_ms=40, chaos=chaos, worker_queue_depth=256,
+    ).start()
+    try:
+        sup.wait_ready()
+        # ~20 requests per worker in flight at 40ms each: worker 1 is
+        # still on its first few when the drain starts, and its 6th
+        # (the kill) lands mid-drain.
+        futures = [sup.submit([float(i)], deadline_s=60) for i in range(40)]
+        removed = sup.remove_worker(worker_id="1")
+        assert removed == "1"
+        results = settle(futures, timeout=60)
+        assert [r[0] for r in results] == [2.0 * i for i in range(40)]
+        assert sup.requeued > 0, "the mid-drain kill stranded no work"
+        kinds = {e.kind for e in get_recovery_log().events()}
+        assert {"scale_down", "worker_crash", "worker_retired"} <= kinds
+        retired = get_recovery_log().events("worker_retired")[-1]
+        assert retired.detail["crashed"] is True
+        # Consistent end state: the dead drainer is GONE (a draining
+        # worker is never restarted), worker 0 owns the whole ring.
+        deadline = time.monotonic() + 10
+        while "1" in sup.stats()["workers"]:
+            assert time.monotonic() < deadline, "crashed drainer never retired"
+            time.sleep(0.05)
+        assert settle([sup.submit([7.0], deadline_s=30)])[0] == [14.0]
+        assert sup.stats()["supervisor"]["workers"] == 1
+    finally:
+        sup.stop()
+
+
+# ------------------------------------------- autoscaler over the stub fleet
+
+
+def test_autoscaler_closes_the_loop_on_a_real_stub_fleet():
+    """Live wiring: a backlog spike on a 1-worker stub fleet drives a
+    real add_worker through Autoscaler.step, and post-spike idle drains
+    the fleet back down — zero dropped either way."""
+    sup = make_supervisor(workers=1, delay_ms=15, worker_queue_depth=256).start()
+    scaler = Autoscaler(
+        sup,
+        AutoscalerConfig(
+            target_p99_ms=100.0, max_workers=2, backlog_per_worker=4.0,
+            pressure_s=0.1, idle_s=0.4, cooldown_s=0.3, min_served=4,
+        ),
+    )
+    try:
+        sup.wait_ready()
+        # Spike: 30 requests at 15ms each against one serial worker.
+        futures = [sup.submit([float(i)], deadline_s=60) for i in range(30)]
+        deadline = time.monotonic() + 10
+        while not scaler.events:
+            scaler.step()
+            assert time.monotonic() < deadline, "spike never drove scale-up"
+            time.sleep(0.05)
+        assert scaler.events[0][0] == "up"
+        results = settle(futures, timeout=60)
+        assert [r[0] for r in results] == [2.0 * i for i in range(30)]
+        # Idle: the loop drains the fleet back to min_workers.
+        deadline = time.monotonic() + 15
+        while scaler.stats()["scale_downs"] == 0:
+            scaler.step()
+            assert time.monotonic() < deadline, "idle never drove scale-down"
+            time.sleep(0.05)
+        deadline = time.monotonic() + 10
+        while sup.stats()["supervisor"]["workers"] > 1:
+            assert time.monotonic() < deadline, "fleet never shrank"
+            time.sleep(0.05)
+        kinds = {e.kind for e in get_recovery_log().events()}
+        assert {"scale_up", "scale_down"} <= kinds
+    finally:
+        scaler.stop()
+        sup.stop()

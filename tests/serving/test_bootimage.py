@@ -1,0 +1,260 @@
+"""Boot images: KV307 staleness gate (fast, host-side) and the real
+build → load → serve round trip (slow-marked: pays jax export/compile).
+
+The KV307 verifier is a pure fingerprint comparison — tier-1 covers the
+refusal matrix without touching a device. The slow tests build a real
+image from a synthetic fitted pipeline and pin the contract: loaded
+executables match the classic apply path bit-for-bit-ish on real AND pad
+rows, a stale image is refused into the classic fallback, and the
+refused worker still serves."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.workflow.verify import BOOT_IMAGE_FINGERPRINTS, verify_boot_image
+
+pytestmark = pytest.mark.serving
+
+FP = {
+    "format_version": 1,
+    "jax_version": "0.4.37",
+    "backend": "cpu",
+    "device_kind": "cpu",
+    "weights_digest": "abc123",
+}
+
+
+# ------------------------------------------------------------- KV307 (tier-1)
+
+
+def test_kv307_clean_when_fingerprints_match():
+    report = verify_boot_image(dict(FP), dict(FP))
+    assert report.ok
+    assert report.context == "boot-image"
+
+
+@pytest.mark.parametrize("field", [name for name, _ in BOOT_IMAGE_FINGERPRINTS])
+def test_kv307_flags_each_mismatched_field(field):
+    current = dict(FP)
+    current[field] = "something-else"
+    report = verify_boot_image(dict(FP), current)
+    assert not report.ok
+    codes = [d.code for d in report.errors()]
+    assert codes == ["KV307"]
+    diag = report.errors()[0]
+    assert diag.details["field"] == field
+    assert diag.details["image"] == str(FP[field])[:24]
+
+
+def test_kv307_missing_field_is_a_mismatch():
+    manifest = dict(FP)
+    del manifest["weights_digest"]  # pre-digest image format
+    report = verify_boot_image(manifest, dict(FP))
+    assert [d.details["field"] for d in report.errors()] == ["weights_digest"]
+
+
+def test_kv307_multiple_drifts_all_reported():
+    current = dict(FP, jax_version="9.9.9", backend="tpu")
+    report = verify_boot_image(dict(FP), current)
+    assert sorted(d.details["field"] for d in report.errors()) == [
+        "backend", "jax_version",
+    ]
+
+
+# --------------------------------------------------- real build/load (slow)
+
+D = 8
+SPEC = {"synthetic": {"d": D, "seed": 0}}
+slow = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def image_dir(tmp_path_factory):
+    from keystone_tpu.serving.bootimage import build_boot_image
+
+    out = str(tmp_path_factory.mktemp("bootimage") / "image")
+    manifest = build_boot_image(SPEC, out, buckets=(1, 2, 4), model_name="default")
+    return out, manifest
+
+
+@slow
+def test_build_writes_a_complete_versioned_artifact(image_dir):
+    out, manifest = image_dir
+    assert manifest["format_version"] == 1
+    assert manifest["buckets"] == [1, 2, 4]
+    assert manifest["example"] == {"shape": [D], "dtype": "float32"}
+    import jax
+
+    assert manifest["jax_version"] == jax.__version__
+    for b in (1, 2, 4):
+        assert os.path.exists(os.path.join(out, f"bucket_{b}.bin"))
+    assert os.path.exists(os.path.join(out, "model.pkl"))
+    assert os.path.exists(os.path.join(out, "manifest.json"))
+    assert os.listdir(os.path.join(out, "cache")), (
+        "no persistent-cache entries bundled"
+    )
+
+
+@slow
+def test_load_serves_parity_with_classic_on_real_and_pad_rows(image_dir):
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.serving.bootimage import load_boot_image
+    from keystone_tpu.serving.registry import ModelRegistry
+    from keystone_tpu.serving.worker import _load_spec
+
+    out, _ = image_dir
+    image = load_boot_image(out)
+    assert image.buckets == (1, 2, 4)
+
+    registry = ModelRegistry()
+    _load_spec(registry, "classic", SPEC)
+    classic = registry.resolve("classic").batch_apply
+
+    rng = np.random.default_rng(1)
+    for b, n in [(4, 4), (4, 2), (2, 1), (1, 1)]:
+        data = rng.standard_normal((b, D)).astype(np.float32)
+        want = np.asarray(classic(ArrayDataset(data, num_examples=n)).data)
+        got = np.asarray(image.apply_batch(ArrayDataset(data, num_examples=n)).data)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        # Pad rows are zeroed exactly like the classic path zeroes them.
+        assert not got[n:].any()
+    assert image.fallback_batches == 0
+
+    # A bucket the image never exported falls back to the classic path —
+    # slower, never wrong.
+    data = rng.standard_normal((8, D)).astype(np.float32)
+    got = np.asarray(image.apply_batch(ArrayDataset(data, num_examples=8)).data)
+    want = np.asarray(classic(ArrayDataset(data, num_examples=8)).data)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert image.fallback_batches == 1
+
+    # warm() executes the exported buckets (single-bucket form included).
+    assert image.warm(only=2) >= 0.0
+    assert image.warm() >= 0.0
+
+
+@slow
+def test_stale_image_refused_with_kv307_and_ledgered(tmp_path, image_dir):
+    import shutil
+
+    from keystone_tpu.reliability.recovery import get_recovery_log
+    from keystone_tpu.serving.bootimage import BootImageRefused, load_boot_image
+
+    out, _ = image_dir
+    stale = str(tmp_path / "stale-image")
+    shutil.copytree(out, stale)
+    manifest_path = os.path.join(stale, "manifest.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest["jax_version"] = "0.0.1"
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+
+    with pytest.raises(BootImageRefused, match="KV307") as exc_info:
+        load_boot_image(stale)
+    report = exc_info.value.report
+    assert [d.details["field"] for d in report.errors()] == ["jax_version"]
+    refused = get_recovery_log().events("bootimage_refused")
+    assert refused and refused[-1].detail["fields"] == ["jax_version"]
+
+
+@slow
+def test_tampered_weights_change_the_digest_and_refuse(tmp_path, image_dir):
+    import shutil
+
+    from keystone_tpu.serving.bootimage import BootImageRefused, load_boot_image
+
+    out, _ = image_dir
+    tampered = str(tmp_path / "tampered-image")
+    shutil.copytree(out, tampered)
+    with open(os.path.join(tampered, "model.pkl"), "ab") as f:
+        f.write(b"garbage")  # executables no longer match the weights
+    with pytest.raises(BootImageRefused) as exc_info:
+        load_boot_image(tampered)
+    fields = [d.details["field"] for d in exc_info.value.report.errors()]
+    assert fields == ["weights_digest"]
+
+
+@slow
+def test_verify_off_skips_the_gate(tmp_path, image_dir, monkeypatch):
+    import shutil
+
+    from keystone_tpu.serving.bootimage import load_boot_image
+
+    out, _ = image_dir
+    stale = str(tmp_path / "stale-but-forced")
+    shutil.copytree(out, stale)
+    manifest_path = os.path.join(stale, "manifest.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest["device_kind"] = "TPU v99"
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    monkeypatch.setenv("KEYSTONE_VERIFY", "off")
+    image = load_boot_image(stale)  # operator override: load anyway
+    assert image.buckets == (1, 2, 4)
+
+
+@slow
+def test_refused_worker_falls_back_to_classic_warm_and_serves(tmp_path, image_dir):
+    """The worker-level fallback: a ServerBackend pointed at a STALE
+    image refuses it (KV307) and still comes up through the classic warm
+    path, serving correct numbers."""
+    import shutil
+
+    from keystone_tpu.serving.worker import ServerBackend, add_worker_arguments
+
+    out, _ = image_dir
+    stale = str(tmp_path / "stale-worker-image")
+    shutil.copytree(out, stale)
+    manifest_path = os.path.join(stale, "manifest.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    manifest["backend"] = "not-this-backend"
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    add_worker_arguments(parser)
+    args = parser.parse_args(["--spec", json.dumps(SPEC), "--boot-image", stale])
+    backend = ServerBackend(SPEC, args)
+    try:
+        assert backend.boot_image == "refused"
+        assert backend._warmed  # classic warm path ran
+        y = backend.server.submit(
+            np.ones((D,), np.float32), deadline_s=30.0
+        ).result(timeout=30)
+        assert np.asarray(y).shape[-1] >= 1
+    finally:
+        backend.server.stop(drain=True)
+
+
+@slow
+def test_fresh_worker_boots_from_image_and_serves(image_dir):
+    """The happy path at backend level: boot_image == "loaded", the
+    registry serves through BootImageModel, and provenance names the
+    image."""
+    import argparse
+
+    from keystone_tpu.serving.worker import ServerBackend, add_worker_arguments
+
+    out, _ = image_dir
+    parser = argparse.ArgumentParser()
+    add_worker_arguments(parser)
+    args = parser.parse_args(["--spec", json.dumps(SPEC), "--boot-image", out])
+    backend = ServerBackend(SPEC, args)
+    try:
+        assert backend.boot_image == "loaded"
+        entry = backend.registry.resolve("default")
+        assert entry.source == f"bootimage:{out}"
+        y = backend.server.submit(
+            np.ones((D,), np.float32), deadline_s=30.0
+        ).result(timeout=30)
+        assert np.asarray(y).shape[-1] >= 1
+    finally:
+        backend.server.stop(drain=True)

@@ -122,12 +122,37 @@ def test_status_codes_per_failure_class(frontend):
 def test_healthz_tracks_worker_states(frontend):
     front, supervisor = frontend
     assert _get(front, "/healthz") == (
-        200, {"status": "ok", "alive": 2, "workers": {"0": "ready", "1": "ready"}},
+        200, {"status": "ok", "alive": 2, "booting": 0, "draining": 0,
+              "workers": {"0": "ready", "1": "ready"}},
     )
     supervisor.worker_states["1"] = "dead"
     code, out = _get(front, "/healthz")
     assert (code, out["status"]) == (200, "degraded")
     supervisor.worker_states = {"0": "dead", "1": "failed"}
+    code, out = _get(front, "/healthz")
+    assert (code, out["status"]) == (503, "down")
+
+
+def test_healthz_represents_booting_and_draining_distinctly(frontend):
+    """The elastic-fleet bugfix: a worker that is booting (scale-up in
+    progress) or draining (scale-down in progress) is NOT a degraded
+    fleet — /healthz must say "scaling" and carry the counts, so a probe
+    watching a scale event doesn't page on normal autoscaler motion."""
+    front, supervisor = frontend
+    supervisor.worker_states = {"0": "ready", "1": "spawning"}
+    code, out = _get(front, "/healthz")
+    assert (code, out["status"]) == (200, "scaling")
+    assert (out["alive"], out["booting"], out["draining"]) == (1, 1, 0)
+    supervisor.worker_states = {"0": "ready", "1": "draining"}
+    code, out = _get(front, "/healthz")
+    assert (code, out["status"]) == (200, "scaling")
+    assert (out["alive"], out["booting"], out["draining"]) == (1, 0, 1)
+    # A genuinely dead worker still degrades even while another boots.
+    supervisor.worker_states = {"0": "ready", "1": "spawning", "2": "dead"}
+    code, out = _get(front, "/healthz")
+    assert (code, out["status"]) == (200, "degraded")
+    # Booting-only fleet (cold start): down until the first ready.
+    supervisor.worker_states = {"0": "new", "1": "spawning"}
     code, out = _get(front, "/healthz")
     assert (code, out["status"]) == (503, "down")
 

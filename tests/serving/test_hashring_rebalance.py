@@ -1,0 +1,121 @@
+"""HashRing rebalance properties under elastic membership (satellite of
+the autoscaling PR).
+
+Consistent hashing's whole value to an autoscaler is the rebalance
+bound: adding or removing ONE worker from an N-worker ring must remap
+only ~K/N of K keys (the departing/arriving worker's own keyspace), not
+reshuffle the world. And affinity keys must never split across the old
+and new owner mid-drain — the supervisor rebuilds the ring WITHOUT the
+draining worker the moment the drain starts, so every post-drain submit
+routes to the key's single new owner while the old owner only finishes
+work it already holds."""
+
+import time
+
+import pytest
+
+from keystone_tpu.serving.supervisor import (
+    HashRing,
+    SupervisorConfig,
+    WorkerSupervisor,
+)
+
+pytestmark = pytest.mark.serving
+
+KEYS = [f"tenant-{i}" for i in range(1000)]
+
+
+def owners(ring):
+    return {k: next(iter(ring.walk(k))) for k in KEYS}
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_adding_one_worker_remaps_about_k_over_n_keys(n):
+    before = owners(HashRing([str(i) for i in range(n)]))
+    after = owners(HashRing([str(i) for i in range(n + 1)]))
+    moved = [k for k in KEYS if before[k] != after[k]]
+    expected = len(KEYS) / (n + 1)
+    # Every moved key moved TO the new worker (nothing reshuffles between
+    # survivors), and the count is ~K/(N+1) within loose vnode variance.
+    assert all(after[k] == str(n) for k in moved)
+    assert 0.4 * expected < len(moved) < 2.0 * expected, (
+        f"{len(moved)} keys moved, expected ~{expected:.0f}"
+    )
+
+
+@pytest.mark.parametrize("n", [3, 5, 8])
+def test_removing_one_worker_remaps_only_its_own_keys(n):
+    members = [str(i) for i in range(n)]
+    before = owners(HashRing(members))
+    departed = str(n - 1)
+    after = owners(HashRing([m for m in members if m != departed]))
+    for k in KEYS:
+        if before[k] == departed:
+            assert after[k] != departed
+        else:
+            # A key owned by a survivor NEVER moves on a removal.
+            assert after[k] == before[k], k
+    orphaned = sum(1 for k in KEYS if before[k] == departed)
+    expected = len(KEYS) / n
+    assert 0.4 * expected < orphaned < 2.0 * expected
+
+
+def test_failover_order_is_the_removal_order():
+    """walk()'s second choice IS the owner after removal: the failover
+    path and the rebalance path agree, so a key that failed over to its
+    second choice during a crash lands on the same worker the rebuilt
+    ring assigns it — no double-dispatch window between the two views."""
+    members = ["0", "1", "2", "3"]
+    full = HashRing(members)
+    for key in KEYS[:200]:
+        first, second = list(full.walk(key))[:2]
+        rebuilt = HashRing([m for m in members if m != first])
+        assert next(iter(rebuilt.walk(key))) == second
+
+
+# ---------------------------------------------------- live drain (stub fleet)
+
+
+def test_affinity_key_never_splits_across_old_and_new_owner_mid_drain():
+    """Pin an affinity key to a worker, drain that worker, and keep
+    submitting on the key THROUGH the drain: every post-drain request
+    must land on the key's single new owner (the draining worker serves
+    only what it already held)."""
+    sup = WorkerSupervisor(
+        {"stub": {"delay_ms": 20}},
+        SupervisorConfig(
+            workers=2, heartbeat_s=0.05, hang_timeout_s=5.0,
+            ready_timeout_s=15.0, monitor_interval_s=0.02,
+        ),
+    ).start()
+    try:
+        sup.wait_ready()
+        # Find a key worker 1 owns so the test drains the owner no matter
+        # how the vnodes landed (routing hashes "model:key").
+        ring = sup._ring
+        model = sup.config.model_name
+        key = next(
+            k for k in KEYS
+            if next(iter(ring.walk(f"{model}:{k}"))) == "1"
+        )
+        pre = [sup.submit([1.0], key=key, deadline_s=30) for _ in range(6)]
+        assert sup.remove_worker(worker_id="1") == "1"
+        new_owner = next(iter(sup._ring.walk(f"{model}:{key}")))
+        assert new_owner == "0", "draining worker still owns its keyspace"
+        post = [sup.submit([2.0], key=key, deadline_s=30) for _ in range(6)]
+        assert [f.result(timeout=30) for f in pre] == [[2.0]] * 6
+        assert [f.result(timeout=30) for f in post] == [[4.0]] * 6
+        # The drained worker retires; worker 0 served every post-drain
+        # request (no split: total served splits exactly 6 / 6+pre-spill).
+        deadline = time.monotonic() + 10
+        while "1" in sup.stats()["workers"]:
+            assert time.monotonic() < deadline, "drained worker never retired"
+            time.sleep(0.05)
+        totals = sup.fleet_counter_totals()
+        assert totals["0"]["served"] >= 6
+        assert totals["1"]["served"] <= 6, (
+            "draining worker took post-drain traffic"
+        )
+        assert totals["0"]["served"] + totals["1"]["served"] == 12
+    finally:
+        sup.stop()

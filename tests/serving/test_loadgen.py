@@ -1,0 +1,123 @@
+"""Seeded arrival processes + the replay harness (serving/loadgen.py).
+
+The generators must be deterministic under a seed (the autoscale bench
+and smoke replay the SAME trace across configurations), hit their target
+average rates, and the replay's dropped/completed accounting must be
+exact — ``dropped == 0`` is a hard gate downstream."""
+
+import concurrent.futures
+
+import pytest
+
+from keystone_tpu.serving.loadgen import (
+    LoadReport,
+    bursty_offsets,
+    diurnal_offsets,
+    heavy_tail_offsets,
+    run_load,
+)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.mark.parametrize(
+    "make",
+    [
+        lambda seed: diurnal_offsets(20.0, 10.0, 60.0, seed=seed),
+        lambda seed: bursty_offsets(20.0, 5.0, 80.0, seed=seed),
+        lambda seed: heavy_tail_offsets(20.0, 30.0, seed=seed),
+    ],
+    ids=["diurnal", "bursty", "heavy_tail"],
+)
+def test_generators_are_seeded_sorted_and_bounded(make):
+    a, b = make(7), make(7)
+    assert a == b, "same seed must replay the same trace"
+    assert a != make(8), "different seeds must differ"
+    assert a == sorted(a)
+    assert all(0.0 <= t < 20.0 for t in a)
+    assert len(a) > 50  # the trace actually carries load
+
+
+def test_diurnal_rate_swings_between_base_and_peak():
+    offsets = diurnal_offsets(60.0, 5.0, 100.0, period_s=60.0, seed=3)
+    # Sinusoid starts at the BASE (cos term): the first quarter is quiet,
+    # mid-trace is near peak.
+    quiet = sum(1 for t in offsets if t < 15.0) / 15.0
+    busy = sum(1 for t in offsets if 22.5 <= t < 37.5) / 15.0
+    assert busy > 3 * quiet, (quiet, busy)
+    # Total mass ~ mean rate (52.5 rps) within loose stochastic bounds.
+    assert 0.6 * 52.5 * 60 < len(offsets) < 1.4 * 52.5 * 60
+
+
+def test_bursty_has_bursts_and_quiet_stretches():
+    offsets = bursty_offsets(
+        30.0, 2.0, 200.0, burst_len_s=0.5, quiet_len_s=2.0, seed=5
+    )
+    # Per-100ms histogram: burst bins see many arrivals, quiet bins ~0.
+    bins = [0] * 300
+    for t in offsets:
+        bins[int(t * 10)] += 1
+    assert max(bins) >= 10, "no burst ever materialized"
+    assert sum(1 for b in bins if b == 0) > 50, "no quiet stretch"
+
+
+def test_heavy_tail_mean_rate_and_refusal():
+    offsets = heavy_tail_offsets(120.0, 50.0, alpha=1.5, seed=11)
+    assert 0.4 * 50 * 120 < len(offsets) < 1.6 * 50 * 120
+    with pytest.raises(ValueError, match="alpha"):
+        heavy_tail_offsets(10.0, 5.0, alpha=1.0)
+    with pytest.raises(ValueError, match="peak_rps"):
+        diurnal_offsets(10.0, 20.0, 5.0)
+
+
+def test_run_load_accounts_completed_dropped_and_submit_refusals():
+    def submit(x, deadline_s=None):
+        future = concurrent.futures.Future()
+        if x % 5 == 4:
+            raise RuntimeError("shed at the door")  # admission refusal
+        if x % 5 == 3:
+            future.set_exception(TimeoutError("expired in flight"))
+        else:
+            future.set_result(x * 2)
+        return future
+
+    report = run_load(
+        submit,
+        offsets=[i * 0.001 for i in range(50)],
+        payload=lambda i: i,
+        time_scale=1.0,
+    )
+    assert report.offered == 50
+    assert report.completed == 30  # i%5 in {0,1,2}
+    assert report.dropped == 20
+    assert report.errors == {"RuntimeError": 10, "TimeoutError": 10}
+    assert len(report.latencies_ms) == 30
+    assert report.summary()["dropped"] == 20
+
+
+def test_run_load_flags_unsettled_futures_instead_of_hanging():
+    hung = []
+
+    def submit(x, deadline_s=None):
+        future = concurrent.futures.Future()
+        hung.append(future)  # never resolved
+        return future
+
+    report = run_load(
+        submit,
+        offsets=[0.0, 0.0],
+        payload=lambda i: i,
+        settle_timeout_s=0.2,
+    )
+    assert report.completed == 0
+    assert report.dropped == 2
+    assert report.errors["Unsettled"] == 2
+
+
+def test_report_percentiles():
+    report = LoadReport(
+        offered=4, completed=4, duration_s=2.0,
+        latencies_ms=[1.0, 2.0, 3.0, 100.0],
+    )
+    assert report.rps == 2.0
+    assert report.p(50) <= report.p(99) <= 100.0
