@@ -2069,6 +2069,113 @@ def _bench_sharded(small: bool) -> dict:
     return out
 
 
+def _bench_sketched(small: bool) -> dict:
+    """Sketched solver tier (docs/SOLVERS.md): a very-wide (d=8192)
+    streamed least-squares fit the meta ladder routes onto the
+    randomized-NLA rung — CountSketch carry accumulated chunk-by-chunk
+    (per-device partials, additive reduce), finished by the s-sized
+    sketch solve. Reports the one number the tier exists for
+    (sketch-vs-Gram state bytes, exact-gated), the streaming invariants
+    (zero steady-state compiles — the sketch step is one memoized
+    function), proof the sketched rung actually ran (the in-process
+    keystone_sketch_fits_total delta — the on-disk profile store can
+    carry entries from other runs), and a tight recovery-quality bound
+    on low-effective-rank rows (a row-space sketch recovers predictions
+    only up to the energy it captures, so effective rank ≲ s is the
+    regime with a meaningful gate)."""
+    import numpy as np
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.obs import names as obs_names
+    from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+    from keystone_tpu.ops.stats.core import LinearRectifier
+    from keystone_tpu.sketch.core import sketch_state_bytes
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.streaming import last_stream_report
+
+    # The small variant keeps the FULL shape: the leg is CPU-sized
+    # anyway, and shrinking d below KEYSTONE_SKETCH_MIN_WIDTH would
+    # route the fit off the rung this leg exists to measure.
+    chunk = 256
+    n = 8 * chunk
+    d = 8192
+    k = 8
+    s = 512
+    latent = 128
+    prev_env = {
+        name: os.environ.get(name)
+        for name in ("KEYSTONE_STREAM_CHUNK_ROWS", "KEYSTONE_SKETCH_SIZE")
+    }
+    os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = str(chunk)
+    os.environ["KEYSTONE_SKETCH_SIZE"] = str(s)
+    rng = np.random.default_rng(31)
+    z = rng.normal(size=(n, latent)).astype(np.float32)
+    basis = rng.normal(size=(latent, d)).astype(np.float32) / np.sqrt(latent)
+    # +8σ shift keeps every entry positive, so the LinearRectifier
+    # featurize chain is the identity on this data and the FEATURIZED
+    # rows keep the latent rank (relu of a centered low-rank matrix
+    # would be full-rank, and the gate would measure model error).
+    x = (z @ basis + 0.01 * rng.normal(size=(n, d)) + 8.0).astype(np.float32)
+    w_true = rng.normal(size=(d, k)).astype(np.float32) / np.sqrt(d)
+    y = (np.maximum(x, 0.0) @ w_true).astype(np.float32)
+
+    def build():
+        return LinearRectifier(0.0).to_pipeline().then_label_estimator(
+            LeastSquaresEstimator(reg=1e-3),
+            ArrayDataset(x),
+            ArrayDataset(y),
+        )
+
+    out: dict = {"n": n, "d": d, "k": k, "chunk_rows": chunk, "chunks": 8}
+    out["sketch_size"] = s
+    out["latent_rank"] = latent
+    fits_c = obs_names.metric(obs_names.SKETCH_FITS)
+    try:
+        PipelineEnv.reset()
+        pipe = build()
+        pipe.fit()  # warm: ladder plan + sketch step compile
+        PipelineEnv.reset()
+        before = fits_c.value(variant="countsketch")
+        t0 = time.perf_counter()
+        handle = pipe.fit()
+        out["sketched_fit_wall_s"] = round(time.perf_counter() - t0, 3)
+        rep = last_stream_report()
+        if rep is not None:
+            out["streaming_report"] = {
+                "chunks": rep.chunks,
+                "bytes_transferred": rep.bytes_transferred,
+                "host_buffer_peak_bytes": rep.host_buffer_peak_bytes,
+                "overlap_ok": rep.overlap_ok(),
+                "compiles_first_chunk": rep.compiles_first_chunk,
+                "compiles_steady_state": rep.compiles_steady_state,
+            }
+        out["rung_is_sketch"] = bool(
+            fits_c.value(variant="countsketch") - before >= 1
+        )
+        preds = np.asarray(handle.apply_batch(ArrayDataset(x[:256])).data)
+        rel = float(
+            np.linalg.norm(preds - y[:256]) / max(np.linalg.norm(y[:256]), 1e-30)
+        )
+        out["parity_rel_err"] = rel
+        out["error_ok"] = bool(np.isfinite(preds).all() and rel < 0.05)
+    finally:
+        for name, prev in prev_env.items():
+            if prev is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prev
+
+    # The headline: the O(s·d) sketch carry vs the O(d²) Gram state the
+    # exact rung would have had to hold for the same fit. Both are
+    # closed-form for a pinned shape — exact-gated by bench-diff.
+    out["sketch_state_bytes"] = sketch_state_bytes(s, d, k)
+    out["gram_state_bytes"] = 4 * (d * d + d * k)
+    out["state_bytes_ratio"] = round(
+        out["gram_state_bytes"] / out["sketch_state_bytes"], 1
+    )
+    return out
+
+
 def _workload_registry() -> dict:
     # ORDER IS THE MEASURING PRIORITY: cheap, headline-bearing legs
     # first, so a budget-capped run (KEYSTONE_BENCH_MEASURE_BUDGET — the
@@ -2082,6 +2189,7 @@ def _workload_registry() -> dict:
         "streaming": _bench_streaming,
         "blocksparse": _bench_blocksparse,
         "sharded": _bench_sharded,
+        "sketched": _bench_sketched,
         "refit": _bench_refit,
         "serving": _bench_serving,
         "serving_multiworker": _bench_serving_multiworker,

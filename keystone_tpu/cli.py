@@ -170,6 +170,13 @@ def add_fit_arguments(parser: argparse.ArgumentParser) -> None:
         help="perturb the training matrix by this constant (same shape, "
         "different content — the seeded KV306 stale-resume case)",
     )
+    parser.add_argument(
+        "--solver", choices=("gram", "sketch"), default="gram",
+        help="streamed state family: 'gram' accumulates the O(d²) "
+        "sufficient statistics, 'sketch' the O(s·d) randomized sketch "
+        "(docs/SOLVERS.md — the very-wide rung under test in "
+        "scripts/sketch_smoke.sh)",
+    )
 
 
 def add_explain_arguments(parser: argparse.ArgumentParser) -> None:

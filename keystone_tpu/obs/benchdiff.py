@@ -72,6 +72,11 @@ _EXACT_SUBSTRINGS = (
     # observatory"): harvesting rides the jit trace cache and must
     # compile NOTHING — any nonzero count is a broken harvest path.
     "harvest_compiles",
+    # Sketched-tier invariant (docs/SOLVERS.md): the sketch/Gram state
+    # footprints are pure functions of (s, d, k) — a changed byte count
+    # is a changed state layout, not noise. (Matched before the skip
+    # list's generic "bytes".)
+    "state_bytes",
 )
 _SKIP_SUBSTRINGS = (
     # Environment-dependent measurements no two runs share: compile

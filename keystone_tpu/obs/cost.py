@@ -139,6 +139,11 @@ class Prediction:
     rows_per_s: Optional[float] = None
     calibrated: bool = False
     source: str = "observed"  # store provenance (observed | tune)
+    #: Every candidate an argmin choice considered, as (name,
+    #: seconds-or-None, reason) tuples — "chosen" for the winner,
+    #: the rejection reason otherwise. Lets explain audit the whole
+    #: ladder, not just the surviving rung.
+    candidates: Tuple = ()
 
 
 # Plan-scoped prediction book: node label → Prediction, filled by the
@@ -495,6 +500,9 @@ class PerfLedgerEntry:
     predicted_key: str = ""
     predicted_shape: str = ""
     predicted_calibrated: bool = False
+    #: (name, seconds-or-None, reason) per ladder candidate, when the
+    #: prediction came from an argmin over alternatives.
+    predicted_candidates: Tuple = ()
     ratio: Optional[float] = None  # measured-vs-predicted, >1 = slower
     drift: bool = False
     cold: bool = False  # compiles observed during the forcing
@@ -514,6 +522,10 @@ class PerfLedgerEntry:
                 out[key] = value
         if self.kinds:
             out["kinds"] = list(self.kinds)
+        if self.predicted_candidates:
+            out["predicted_candidates"] = [
+                list(c) for c in self.predicted_candidates
+            ]
         return out
 
 
@@ -1007,6 +1019,7 @@ def _finalize_node(label, seconds, synced, op, span, frame):
     predicted_s = predicted_model = None
     predicted_key = predicted_shape = ""
     calibrated = False
+    predicted_candidates: Tuple = ()
     ratio = None
     drift = False
     cold = frame is not None and frame.compiles > 0
@@ -1015,6 +1028,7 @@ def _finalize_node(label, seconds, synced, op, span, frame):
         predicted_key = prediction.key
         predicted_shape = prediction.shape
         calibrated = prediction.calibrated
+        predicted_candidates = tuple(getattr(prediction, "candidates", ()))
         if prediction.seconds is not None:
             predicted_s = prediction.seconds
         elif (
@@ -1068,6 +1082,7 @@ def _finalize_node(label, seconds, synced, op, span, frame):
         predicted_key=predicted_key,
         predicted_shape=predicted_shape,
         predicted_calibrated=calibrated,
+        predicted_candidates=predicted_candidates,
         ratio=ratio,
         drift=drift,
         rows_per_s=frame.rows_per_s if frame is not None else None,

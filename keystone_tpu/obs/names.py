@@ -79,6 +79,12 @@ SOLVER_FIT_SECONDS = "keystone_solver_fit_seconds"
 SOLVER_RUNG_ATTEMPTS = "keystone_solver_rung_attempts_total"
 SOLVER_ITERATIONS = "keystone_solver_iterations_total"
 
+# ---------------------------------------------------------------- sketch tier
+SKETCH_FITS = "keystone_sketch_fits_total"
+SKETCH_SIZE = "keystone_sketch_size"
+SKETCH_STATE_BYTES = "keystone_sketch_state_bytes"
+SKETCH_FINISH_SECONDS = "keystone_sketch_finish_seconds"
+
 # ---------------------------------------------------------------------- ingest
 INGEST_IMAGES = "keystone_ingest_images_total"
 INGEST_CORRUPT = "keystone_ingest_corrupt_total"
@@ -222,6 +228,10 @@ SCHEMA: Dict[str, Tuple] = {
     SOLVER_FIT_SECONDS: ("histogram", "Solver fit wall time", ("solver",)),
     SOLVER_RUNG_ATTEMPTS: ("counter", "Degradation-ladder rung attempts inside solvers", ("solver",)),
     SOLVER_ITERATIONS: ("counter", "Host-level solver iterations (e.g. L-BFGS steps)", ("solver",)),
+    SKETCH_FITS: ("counter", "Sketched least-squares fits completed, by sketch variant (countsketch/srht)", ("variant",)),
+    SKETCH_SIZE: ("gauge", "Sketch rows s chosen for the last sketched fit (knob/tuned/width default)", ()),
+    SKETCH_STATE_BYTES: ("gauge", "Bytes of the last sketched fit's O(s·d) carry — the number KV308 compares to the device budget", ()),
+    SKETCH_FINISH_SECONDS: ("histogram", "Sketch finish solves (s×s dual ridge or lstsq fallback)", ()),
     INGEST_IMAGES: ("counter", "Records successfully decoded by ingest", ()),
     INGEST_CORRUPT: ("counter", "Records quarantined by ingest", ()),
     INGEST_BYTES: ("counter", "Raw bytes read by ingest", ()),

@@ -164,6 +164,12 @@ class KernelRidgeRegression(LabelEstimator):
         targets = _as_array_dataset(labels)
         n = features.num_examples
 
+        from ...envknobs import env_int
+
+        landmarks = env_int("KEYSTONE_KERNEL_NYSTROM", 0)
+        if 0 < landmarks < n:
+            return self._fit_nystrom(features, targets, landmarks)
+
         # OOM degradation: the live kernel panel is (n_pad, bs) — halving
         # the block halves it (and the replicated bs×bs solve) while the
         # Gauss-Seidel sweep still visits every training row.
@@ -187,6 +193,37 @@ class KernelRidgeRegression(LabelEstimator):
         if ladder.reduced:
             model.degradation = dict(ladder.record)
         return model
+
+    def _fit_nystrom(self, features, targets, landmarks) -> "KernelBlockLinearMapper":
+        """Randomized Nyström rung (``KEYSTONE_KERNEL_NYSTROM=m``, 0=off):
+        m uniform landmark rows stand in for the full training set, the
+        duals solve against the m×m landmark kernel, and scoring reuses
+        the ring mapper with the landmarks AS the training set — exactly
+        K(x, landmarks)·α. Trades the n-dual Gauss-Seidel sweep for an
+        O(n·m + m³) solve; docs/SOLVERS.md has the bound."""
+        from ...envknobs import env_int
+        from ...obs import names as _names
+        from ...obs import solver as solver_obs
+        from ...sketch.solvers import nystrom_krr
+
+        n = features.num_examples
+        gamma = self.kernel_generator.gamma
+        x = np.asarray(features.data, np.float32)
+        y = np.asarray(targets.data, np.float32)
+        with solver_obs.fit_span("kernel_nystrom", n=n, landmarks=landmarks):
+            idx, duals = nystrom_krr(
+                x, y, gamma, self.reg, landmarks,
+                seed=env_int("KEYSTONE_SKETCH_SEED", 0),
+            )
+        try:
+            _names.metric(_names.SKETCH_FITS).inc(variant="nystrom")
+        except Exception:
+            pass
+        return KernelBlockLinearMapper(
+            jnp.asarray(x[np.asarray(idx)]), jnp.asarray(duals), gamma,
+            num_train=landmarks,
+            block_size=min(self.block_size, landmarks),
+        )
 
     def _fit_with_block(self, features, targets, bs) -> "KernelBlockLinearMapper":
         from ...reliability import probe

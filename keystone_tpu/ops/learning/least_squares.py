@@ -78,6 +78,28 @@ class _ExactCost(CostModel):
         return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
 
 
+class _SketchCost(CostModel):
+    """The sketched rung (keystone_tpu/sketch): one data pass into an
+    O(s·d) carry plus an s×s finish solve. Priced at infinity below
+    ``sketch_min_width()`` — at moderate widths the raw flop count would
+    win while accuracy argues for the exact/Gram rungs, so the width
+    floor IS the eligibility gate (docs/SOLVERS.md)."""
+
+    def __init__(self, sketch_size: int):
+        self.sketch_size = sketch_size
+
+    def cost(self, n, d, k, sparsity, num_machines, w=DEFAULT_COST_WEIGHTS):
+        from ...sketch.solvers import sketch_min_width
+
+        if d < sketch_min_width():
+            return np.inf
+        s = self.sketch_size
+        flops = n * (d + k) / num_machines + s * s * (d + k) + s * s * s
+        bytes_scanned = n * d / num_machines + s * (d + k)
+        network = s * (d + k)
+        return max(w.cpu * flops, w.mem * bytes_scanned) + w.network * network
+
+
 class LeastSquaresEstimator(LabelEstimator, Optimizable):
     """Meta-solver choosing the concrete least-squares implementation."""
 
@@ -89,7 +111,10 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
     supports_fit_stream = True
 
     #: Refit state contract (docs/REFIT.md): the meta-solver's state is
-    #: whatever its delegated concrete rung accumulates (Gram today).
+    #: whatever its delegated concrete rung accumulates — Gram for the
+    #: exact/block rungs, "sketch" past ``sketch_min_width()``. The
+    #: class attr is the narrow default; per-stream resolution goes
+    #: through ``stream_state_kind_for`` (reliability/durable.py).
     stream_state_kind = "gram"
 
     def fit_stream(self, stream, state=None):
@@ -102,7 +127,28 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         return fitted
 
     def _stream_solver(self, width: int):
-        """The concrete streaming rung for a featurized ``width``."""
+        """The concrete streaming rung for a featurized ``width``:
+        exact (narrow) → Gram-BCD (wide) → sketched (very wide, where
+        the O(d²) Gram itself is the memory problem — KV303's regime)."""
+        from ...sketch.solvers import (
+            SketchedLeastSquaresEstimator,
+            sketch_min_width,
+        )
+
+        if width >= sketch_min_width():
+            inner = SketchedLeastSquaresEstimator(reg=self.reg)
+            tuned = getattr(self, "_tuned_sketch_size", None)
+            if tuned:
+                # Measured-knob override (workflow/knobs.py) rides the
+                # meta-solver down to whichever rung the width picks.
+                inner._tuned_sketch_size = int(tuned)
+            return inner
+        return self._gram_stream_solver(width)
+
+    def _gram_stream_solver(self, width: int):
+        """The Gram-family rung for ``width`` (also the finish path for
+        persisted Gram carries of ANY width — a pre-sketch-tier state
+        must never be finished by the sketched rung)."""
         if width > self.block_size:
             return BlockLeastSquaresEstimator(
                 self.block_size, num_iter=self.block_iters, reg=self.reg
@@ -114,6 +160,20 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         # (check_finite) rather than degrading to NaN predictions.
         return LinearMapEstimator(reg=self.reg or None)
 
+    def stream_state_kind_for(self, stream) -> str:
+        """Durable-fold protocol: the committed StreamState's kind must
+        be the CHOSEN rung's, resolved after the stream geometry is
+        final (a sketched fold commits kind="sketch" carries)."""
+        return self._stream_solver(
+            _stream_width(stream, self.block_size)
+        ).stream_state_kind
+
+    def stream_state_meta_for(self, stream):
+        """Durable-fold protocol: the chosen rung's envelope meta (the
+        sketch rung's (variant, seed); empty for the Gram family)."""
+        inner = self._stream_solver(_stream_width(stream, self.block_size))
+        return dict(getattr(inner, "stream_state_meta", {}) or {})
+
     # ------------------------------------------------ refit state contract
     def export_stream_state(self):
         return getattr(self, "_stream_state", None)
@@ -124,10 +184,21 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         return merge_stream_states(a, b)
 
     def finish_from_state(self, state):
-        """Finish from statistics alone, re-running the width dispatch
-        the streamed fit would have made (the carry's Gram is (d, d),
-        so the width is in the state itself)."""
-        return self._stream_solver(
+        """Finish from statistics alone. The state's ``kind`` names the
+        rung family that accumulated it: sketch carries finish on the
+        sketched rung regardless of width, Gram carries re-run the
+        width dispatch (the carry's Gram is (d, d), so the width is in
+        the state itself — capped below the sketch floor, which never
+        produces Gram carries)."""
+        if state.kind == "sketch":
+            from ...sketch.solvers import SketchedLeastSquaresEstimator
+
+            inner = SketchedLeastSquaresEstimator(reg=self.reg)
+            if state.meta.get("sketch_variant"):
+                inner.variant = state.meta["sketch_variant"]
+                inner.seed = int(state.meta.get("sketch_seed", inner.seed))
+            return inner.finish_from_state(state)
+        return self._gram_stream_solver(
             int(state.carry[0].shape[0])
         ).finish_from_state(state)
 
@@ -243,45 +314,105 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         # the backend active when planning runs.
         weights = self.weights if self.weights is not None else default_cost_weights()
 
+        from ...sketch.solvers import (
+            SketchedLeastSquaresEstimator,
+            sketch_min_width,
+        )
+
+        sparse_ok = sparsity < self.sparse_threshold
+        sketch_ok = d >= sketch_min_width()
+        # Price the sketch size that will actually run (env knob >
+        # constructor > measured-knob winner > width default) — pricing
+        # the width default when KEYSTONE_SKETCH_SIZE or a tuned winner
+        # pins a smaller s would mischarge the rung ~s² and hand the
+        # argmin to a Gram rung the user explicitly sized the sketch for.
+        sketch_probe = SketchedLeastSquaresEstimator(reg=self.reg)
+        tuned_s = getattr(self, "_tuned_sketch_size", None)
+        if tuned_s:
+            sketch_probe._tuned_sketch_size = int(tuned_s)
+        sketch_s = sketch_probe._resolve_sketch_size(d)
+        # (name, cost, estimator, ineligible-reason). Ineligible rungs
+        # price at inf but STAY in the list: `keystone-tpu explain`
+        # surfaces every rung the argmin saw, with why it lost.
         candidates = [
             (
+                "sparse_lbfgs",
                 _SparseLBFGSCost().cost(n, d, k, sparsity, machines, weights)
-                if sparsity < self.sparse_threshold
+                if sparse_ok
                 else np.inf,
                 SparseLBFGSEstimator(reg=self.reg),
+                ""
+                if sparse_ok
+                else f"density {sparsity:.3f} ≥ sparse_threshold "
+                f"{self.sparse_threshold}",
             ),
             (
+                "dense_lbfgs",
                 _DenseLBFGSCost().cost(n, d, k, 1.0, machines, weights),
                 DenseLBFGSEstimator(reg=self.reg),
+                "",
             ),
             (
+                "block",
                 _BlockSolveCost(self.block_size, self.block_iters).cost(
                     n, d, k, 1.0, machines, weights
                 ),
                 BlockLeastSquaresEstimator(
                     self.block_size, num_iter=self.block_iters, reg=self.reg
                 ),
+                "",
             ),
             (
+                "exact",
                 _ExactCost().cost(n, d, k, 1.0, machines, weights),
                 LinearMapEstimator(reg=self.reg),
+                "",
+            ),
+            (
+                "sketched",
+                _SketchCost(sketch_s).cost(
+                    n, d, k, 1.0, machines, weights
+                ),
+                sketch_probe,
+                ""
+                if sketch_ok
+                else f"width {d} < KEYSTONE_SKETCH_MIN_WIDTH "
+                f"{sketch_min_width()}",
             ),
         ]
-        cost_ms, chosen = min(candidates, key=lambda c: c[0])
+        cost_ms, chosen = min(
+            ((c, est) for _, c, est, _ in candidates), key=lambda c: c[0]
+        )
         # Cost-observatory provenance (obs/cost.py): the rung's predicted
         # cost rides the chosen estimator into the perf ledger and the
-        # solver:fit span. The ladder's constants are RELATIVE (only the
-        # argmin matters; the reference fit them on its own cluster), so
-        # the prediction is displayed but never drift-scored
-        # (calibrated=False).
+        # solver:fit span — with EVERY candidate's cost and the rejected
+        # rungs' reasons, so the three-rung ladder's decisions are
+        # auditable in `keystone-tpu explain`. The ladder's constants are
+        # RELATIVE (only the argmin matters; the reference fit them on
+        # its own cluster), so the prediction is displayed but never
+        # drift-scored (calibrated=False).
         from ...obs.cost import Prediction
 
+        provenance = []
+        for name, c, est, why in candidates:
+            if est is chosen:
+                reason = "chosen"
+            elif why:
+                reason = why
+            elif np.isfinite(c):
+                reason = f"cost above chosen rung ({c / 1e3:.3g}s)"
+            else:
+                reason = "ineligible"
+            provenance.append(
+                (name, None if not np.isfinite(c) else float(c) / 1e3, reason)
+            )
         chosen.predicted_cost = Prediction(
             model="solver_ladder",
             key=f"solver:ladder:{type(chosen).__name__}",
             shape=f"n{n}|{d}|k{k}",
             seconds=float(cost_ms) / 1e3,
             calibrated=False,
+            candidates=tuple(provenance),
         )
         return chosen
 

@@ -51,8 +51,20 @@ import numpy as np
 FORMAT_VERSION = 1
 
 #: kind → merge rule. "additive" is the Gram family's algebra (leafwise
-#: sum of carries, sum of example counts); future kinds register here.
-MERGE_RULES: Dict[str, str] = {"gram": "additive"}
+#: sum of carries, sum of example counts). The sketch tier's carry
+#: (keystone_tpu/sketch) is additive by construction — every row's
+#: contribution is a deterministic function of its absolute index — so
+#: it registers the SAME rule and inherits merge/scaled()/resume whole.
+MERGE_RULES: Dict[str, str] = {"gram": "additive", "sketch": "additive"}
+
+#: Per-kind meta keys that must AGREE for two envelopes to combine
+#: (lenient when either side never recorded them — old envelopes).
+#: Sketch carries are sums of hash-seeded row contributions: adding
+#: sketches drawn from different (variant, seed) maps is algebra on
+#: unrelated projections and must fail loudly.
+MERGE_META_KEYS: Dict[str, Tuple[str, ...]] = {
+    "sketch": ("sketch_variant", "sketch_seed"),
+}
 
 
 class StateMismatch(ValueError):
@@ -128,6 +140,13 @@ def _check_compatible(a: StreamState, b: StreamState) -> None:
             f"carry shapes differ: {shapes_a} vs {shapes_b} — these "
             "statistics were captured over different feature spaces"
         )
+    for key in MERGE_META_KEYS.get(a.kind, ()):
+        va, vb = a.meta.get(key), b.meta.get(key)
+        if va is not None and vb is not None and va != vb:
+            raise StateMismatch(
+                f"{a.kind!r} states disagree on {key}: {va!r} vs {vb!r} — "
+                "carries under different sketch maps cannot be summed"
+            )
 
 
 def merge_stream_states(a: StreamState, b: StreamState) -> StreamState:
@@ -273,3 +292,56 @@ class GramStreamStateMixin:
         )
         self._stream_state = state
         return state
+
+
+# ---------------------------------------------------------- the sketch mixin
+
+
+class SketchStreamStateMixin(GramStreamStateMixin):
+    """State-contract plumbing for the sketch tier (keystone_tpu/sketch).
+
+    Identical protocol to the Gram mixin — the carry is additive, so
+    export/merge/``scaled()``/resume are inherited verbatim — with a
+    different kind tag, a 5-leaf ``(SA, SY, s1, Σx, Σy)`` carry whose
+    leading dimension is the sketch size s (not d), and a meta
+    compatibility check: a resumed fold must keep accumulating under the
+    SAME (variant, seed) sketch map or the sum is meaningless.
+    """
+
+    stream_state_kind = "sketch"
+
+    def _check_state_kind(self, state: StreamState) -> None:
+        super()._check_state_kind(state)
+        mine = getattr(self, "stream_state_meta", {}) or {}
+        for key in MERGE_META_KEYS["sketch"]:
+            va, vb = state.meta.get(key), mine.get(key)
+            if va is not None and vb is not None and va != vb:
+                raise StateMismatch(
+                    f"resume state's {key}={va!r} != estimator's {vb!r} — "
+                    "a fold cannot extend a sketch drawn from a different map"
+                )
+
+    def _seed_carry(self, state: Optional[StreamState], s: int, d: int, k: int):
+        """Fresh zeros, or ``state``'s sketch seeded onto device —
+        shape-checked so a fold never extends statistics captured over a
+        different (s, d, k) geometry."""
+        if state is None:
+            from ..sketch.core import sketch_stream_init
+
+            return sketch_stream_init(s, d, k)
+        self._check_state_kind(state)
+        want = [(s, d), (s, k), (s,), (d,), (k,)]
+        got = [tuple(a.shape) for a in state.carry]
+        if got != want:
+            raise StateMismatch(
+                f"resume state shaped {got} cannot seed a (s={s}, d={d}, "
+                f"k={k}) sketch stream (want {want})"
+            )
+        import jax
+        import jax.numpy as jnp
+
+        carry = tuple(jnp.asarray(a, jnp.float32) for a in state.carry)
+        # Same commit-before-donate discipline as the Gram seed: the fold
+        # step donates this buffer on the first dispatch.
+        # keystone: allow-sync
+        return jax.block_until_ready(carry)

@@ -255,6 +255,10 @@ class DurableFold:
     kind: str                       # stream-state kind ("gram", ...)
     estimator: str                  # estimator qualname for the envelope
     ckpt_every: int                 # chunks between commits (0 = never)
+    #: Extra envelope meta the committed StreamState must carry (e.g. the
+    #: sketch tier's {sketch_variant, sketch_seed} — what a resumed fold
+    #: needs to keep accumulating under the SAME sketch map).
+    state_meta: Dict[str, Any] = field(default_factory=dict)
     fingerprints: Dict[str, Any] = field(default_factory=dict)
     start_chunk: int = 0            # chunks to skip (resumed fold)
     resume_rows: int = 0            # rows those skipped chunks held
@@ -297,7 +301,7 @@ class DurableFold:
             estimator=self.estimator,
             num_examples=int(self.seed_rows + rows_consumed),
             carry=tuple(np.asarray(a) for a in host_carry),
-            meta={"durable": True},
+            meta={**self.state_meta, "durable": True},
         )
         entry = ResumeEntry(
             cursor=self.cursor(
@@ -390,12 +394,27 @@ def arm_durable_fold(stream: Any, estimator: Any, store: Any):
         "feature_width": width,
         "feature_dtype": dtype,
     }
+    # Meta-estimators pick their concrete rung per stream (width-based
+    # ladder), so the committed state's kind/meta must come from the
+    # CHOSEN rung, not the class default — the optional *_for(stream)
+    # protocol resolves both after the geometry is final.
+    kind_for = getattr(estimator, "stream_state_kind_for", None)
+    kind = (
+        kind_for(stream) if callable(kind_for)
+        else getattr(estimator, "stream_state_kind", "gram")
+    )
+    meta_for = getattr(estimator, "stream_state_meta_for", None)
+    if callable(meta_for):
+        state_meta = dict(meta_for(stream) or {})
+    else:
+        state_meta = dict(getattr(estimator, "stream_state_meta", {}) or {})
     durable = DurableFold(
         store=store,
         key=key,
-        kind=getattr(estimator, "stream_state_kind", "gram"),
+        kind=kind,
         estimator=f"{type(estimator).__module__}.{type(estimator).__qualname__}",
         ckpt_every=every,
+        state_meta=state_meta,
         fingerprints=fingerprints,
     )
     if entry is None:

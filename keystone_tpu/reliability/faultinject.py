@@ -224,6 +224,8 @@ KNOWN_PROBE_SITES = frozenset(
         "BlockLeastSquaresEstimator.solve",
         "LeastSquaresEstimator.solve",
         "KernelRidgeRegression.solve",
+        "sketch.finish",               # sketch/solvers.py: finish-solve ladder
+                                       # (dual s×s ridge → lstsq fallback)
     }
 )
 

@@ -1,0 +1,41 @@
+"""Randomized-NLA sketch tier (docs/SOLVERS.md).
+
+Stream-compatible row-space sketching operators with O(s·d) state
+(``core``) and the solvers built on them (``solvers``): the third rung
+of the least-squares ladder for very-wide fits, plus randomized Nyström
+for the kernel path. The streamed sketch carry implements the same
+additive state contract the Gram family rides (refit/state.py), so
+export/merge/``scaled()``/crash-resume/shard-loss salvage all come for
+free — the proof of the "solver-agnostic" claim those subsystems make.
+
+Import discipline: this package imports jax lazily (inside functions),
+so control-plane code can import it without paying a backend init.
+"""
+
+from .core import (
+    MASK_INDEX_EXACT_ROWS,
+    sketch_state_bytes,
+    sketch_stream_finish,
+    sketch_stream_init,
+    sketch_stream_step,
+)
+from .solvers import (
+    SketchedLeastSquaresEstimator,
+    default_sketch_size,
+    nystrom_krr,
+    sketch_min_width,
+    sketch_precond_lstsq,
+)
+
+__all__ = [
+    "MASK_INDEX_EXACT_ROWS",
+    "SketchedLeastSquaresEstimator",
+    "default_sketch_size",
+    "nystrom_krr",
+    "sketch_min_width",
+    "sketch_precond_lstsq",
+    "sketch_state_bytes",
+    "sketch_stream_finish",
+    "sketch_stream_init",
+    "sketch_stream_step",
+]

@@ -1,0 +1,228 @@
+"""Streaming row-space sketch operators: CountSketch and SRHT.
+
+Both sketches compress the n-row (features, labels) stream into an
+O(s·d) carry while staying exact under every composition the streaming
+engine performs — chunking, row sharding, merge, exponential decay, and
+crash-resume. The property that buys all of that at once: every row's
+sketch contribution is a deterministic function of its ABSOLUTE dataset
+row index (threaded through the engine's pad mask, which stores
+``row_index + 1`` per row; see workflow/streaming.py), so the sketch of
+a set of rows is the sum of per-row contributions no matter how the
+rows were batched or which device folded them.
+
+- **CountSketch** hashes row i to bucket h(i) ∈ [s] with sign σ(i) and
+  scatter-adds σ(i)·xᵢ — O(n·d) stream flops, E[SᵀS] = I.
+- **SRHT** uses the closed-form Walsh–Hadamard entry
+  H(r, i) = (−1)^popcount(r & i) over the implicit 2³²-dimensional
+  transform (``jax.lax.population_count``), sampled at s seeded rows r
+  and sign-flipped per input row: each chunk contributes an (s, c)
+  on-the-fly sign matrix times the chunk — O(s·c·d) flops, denser
+  mixing than CountSketch for adversarial row distributions.
+
+The carry is ``(SA, SY, s1, Σx, Σy)`` — sketched features (s, d),
+sketched labels (s, k), the sketch of the all-ones vector (s,), and the
+raw column sums. ``s1`` makes centering algebraic at finish time:
+S·(A − 1μᵀ) = SA − s1·μᵀ, the same identity the Gram family uses, so
+no second data pass is ever needed.
+
+Row indices ride the float32 mask exactly up to 2²⁴ rows
+(:data:`MASK_INDEX_EXACT_ROWS`); solvers refuse longer streams loudly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+#: Largest row count whose absolute indices are exactly representable in
+#: the engine's float32 mask lane (2^24). Beyond this, index encoding
+#: would silently collide — solvers raise instead of degrading.
+MASK_INDEX_EXACT_ROWS = 1 << 24
+
+#: Registered sketch variants (KEYSTONE_SKETCH_VARIANT values).
+VARIANTS = ("countsketch", "srht")
+
+
+def sketch_state_bytes(s: int, d: int, k: int) -> int:
+    """Bytes one float32 sketch carry holds — the O(s·d) number the
+    KV308 feasibility check compares against the device budget."""
+    return 4 * (s * d + s * k + s + d + k)
+
+
+# ------------------------------------------------------------- row hashing
+
+
+def _avalanche(h):
+    """murmur3 finalizer on uint32 lanes — full-entropy bit mixing, runs
+    inside the fused chunk step (pure integer ops, no tables)."""
+    import jax.numpy as jnp
+
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def _row_hash(idx_u32, seed: int, salt: int):
+    """Deterministic uint32 hash of an absolute row index under
+    (seed, salt) — the per-row randomness both variants draw from."""
+    import jax.numpy as jnp
+
+    mix = (int(seed) * 0x9E3779B9 + int(salt) * 0x7F4A7C15) & 0xFFFFFFFF
+    return _avalanche(idx_u32 ^ jnp.uint32(mix))
+
+
+def srht_sample_rows(s: int, seed: int) -> np.ndarray:
+    """The s sampled Walsh–Hadamard row indices, host-generated and
+    regenerable from (s, seed) alone — never persisted; resume rebuilds
+    them from the envelope's meta."""
+    rng = np.random.default_rng(np.uint64(seed) ^ np.uint64(0x5E1EC7ED))
+    return rng.integers(0, 1 << 32, size=int(s), dtype=np.uint64).astype(
+        np.uint32
+    )
+
+
+# ---------------------------------------------------------------- the carry
+
+
+def sketch_stream_init(s: int, d: int, k: int):
+    """Fresh float32 carry: (SA (s,d), SY (s,k), s1 (s,), Σx (d,),
+    Σy (k,)) — every leaf additive over chunks AND shards, which is what
+    lets kind="sketch" ride the engine's per-shard-partials path, the
+    finish-time sum reduce, and shard-loss salvage unchanged."""
+    import jax.numpy as jnp
+
+    return (
+        jnp.zeros((s, d), jnp.float32),
+        jnp.zeros((s, k), jnp.float32),
+        jnp.zeros((s,), jnp.float32),
+        jnp.zeros((d,), jnp.float32),
+        jnp.zeros((k,), jnp.float32),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def sketch_stream_step(variant: str, seed: int):
+    """The fold step for (variant, seed), memoized so repeated fits —
+    refit rounds included — reuse ONE function object and therefore one
+    entry in the engine's shared step-jit cache (0 steady compiles).
+
+    The returned function carries ``needs_mask = True``: the engine then
+    passes the chunk's pad mask, whose lane holds each row's absolute
+    dataset index + 1 (0 for pads) — the only extra plumbing the sketch
+    tier needed from the engine.
+    """
+    if variant not in VARIANTS:
+        raise ValueError(
+            f"unknown sketch variant {variant!r} (known: {VARIANTS})"
+        )
+    seed = int(seed)
+
+    if variant == "countsketch":
+
+        def step(carry, x, y, mask):
+            import jax.numpy as jnp
+
+            sa, sy, s1, sx, sums_y = carry
+            s = sa.shape[0]
+            idx1 = mask[:, 0].astype(jnp.int32)  # row index + 1; 0 = pad
+            valid = (idx1 > 0).astype(jnp.float32)
+            idx = jnp.maximum(idx1 - 1, 0).astype(jnp.uint32)
+            bucket = (_row_hash(idx, seed, 0) % jnp.uint32(s)).astype(
+                jnp.int32
+            )
+            sign = (
+                1.0 - 2.0 * (_row_hash(idx, seed, 1) & jnp.uint32(1)).astype(
+                    jnp.float32
+                )
+            ) * valid
+            sa = sa.at[bucket].add(sign[:, None] * x)
+            sy = sy.at[bucket].add(sign[:, None] * y)
+            s1 = s1.at[bucket].add(sign)
+            # Pads are exact zeros in x (the chain re-zeroes them) and y
+            # (host pad), so raw column sums need no masking.
+            return (
+                sa, sy, s1,
+                sx + jnp.sum(x, axis=0),
+                sums_y + jnp.sum(y, axis=0),
+            )
+
+    else:  # srht
+
+        def step(carry, x, y, mask):
+            import jax
+            import jax.numpy as jnp
+
+            sa, sy, s1, sx, sums_y = carry
+            s = sa.shape[0]
+            idx1 = mask[:, 0].astype(jnp.int32)
+            valid = (idx1 > 0).astype(jnp.float32)
+            idx = jnp.maximum(idx1 - 1, 0).astype(jnp.uint32)
+            rows = jnp.asarray(srht_sample_rows(s, seed))  # (s,) uint32
+            # H(r, i) = (−1)^popcount(r & i): the Walsh–Hadamard entry in
+            # closed form — row-independent, so sharding stays exact.
+            parity = (
+                jax.lax.population_count(rows[:, None] & idx[None, :])
+                & jnp.uint32(1)
+            ).astype(jnp.float32)
+            sign = (
+                1.0 - 2.0 * (_row_hash(idx, seed, 1) & jnp.uint32(1)).astype(
+                    jnp.float32
+                )
+            ) * valid
+            m = (1.0 - 2.0 * parity) * sign[None, :] * (1.0 / np.sqrt(s))
+            return (
+                sa + m @ x,
+                sy + m @ y,
+                s1 + jnp.sum(m, axis=1),
+                sx + jnp.sum(x, axis=0),
+                sums_y + jnp.sum(y, axis=0),
+            )
+
+    step.needs_mask = True
+    step.sketch_variant = variant
+    step.sketch_seed = seed
+    return step
+
+
+def sketch_stream_finish(carry, n: int):
+    """Centered sketches from the accumulated carry: S·Ac, S·Yc, and the
+    means — S·(A − 1μᵀ) = SA − s1·μᵀ, exact for any sketch that is a
+    linear map of the rows (both variants are)."""
+    sa, sy, s1, sx, sums_y = carry
+    mu_a = sx / n
+    mu_b = sums_y / n
+    sa_c = sa - s1[:, None] * mu_a[None, :]
+    sy_c = sy - s1[:, None] * mu_b[None, :]
+    return sa_c, sy_c, mu_a, mu_b
+
+
+# ----------------------------------------------------------- in-core sketch
+
+
+def sketch_rows(x, start_index: int, variant: str, seed: int, s: int):
+    """Sketch a materialized row block whose rows occupy absolute
+    indices [start_index, start_index + rows): the in-core counterpart
+    of one stream chunk, sharing the exact per-row hashing — sketching
+    a matrix block-by-block equals sketching it whole (the additivity
+    the round-trip tests pin)."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(x, jnp.float32)
+    rows = x.shape[0]
+    step = sketch_stream_step(variant, seed)
+    mask = (
+        jnp.arange(start_index + 1, start_index + rows + 1, dtype=jnp.float32)
+    )[:, None]
+    carry = (
+        jnp.zeros((s, x.shape[1]), jnp.float32),
+        jnp.zeros((s, 1), jnp.float32),
+        jnp.zeros((s,), jnp.float32),
+        jnp.zeros((x.shape[1],), jnp.float32),
+        jnp.zeros((1,), jnp.float32),
+    )
+    sa, _, s1, _, _ = step(carry, x, jnp.zeros((rows, 1), jnp.float32), mask)
+    return sa, s1

@@ -1,0 +1,485 @@
+"""Sketch-based least-squares solvers and randomized Nyström KRR.
+
+Two regimes share the operators in :mod:`.core`:
+
+- **Streamed** (:meth:`SketchedLeastSquaresEstimator.fit_stream`): pure
+  one-pass sketch-and-solve. The fold accumulates the O(s·d) carry; the
+  finish solves the SKETCHED ridge objective exactly via the dual
+  (push-through) identity ``(ÃᵀÃ+λI)⁻¹Ãᵀ = Ãᵀ(ÃÃᵀ+λI)⁻¹`` — an s×s
+  solve, never a d×d one. Error vs the exact solution is the classic
+  subspace-embedding bound: relative residual O(ε) when s = Θ(d/ε²)
+  (docs/SOLVERS.md), and the estimator's default s keeps fits in the
+  full-accuracy regime until width forces the trade.
+- **In-core** (:meth:`SketchedLeastSquaresEstimator.fit` /
+  :func:`sketch_precond_lstsq`): sketch-and-PRECONDITION. The same
+  sketch builds a Woodbury preconditioner for block PCG on the full
+  normal operator — a handful of refinement passes
+  (``KEYSTONE_SKETCH_REFINE``) drive the error to solver tolerance
+  while every iteration stays O(n·d·k).
+
+The streamed carry is kind="sketch" :class:`~..refit.state.StreamState`
+(every leaf additive), so merge/``scaled()``/crash-resume/shard-loss
+salvage ride the PR-12/PR-15 contracts with zero new persistence code.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..envknobs import env_int, env_str
+from ..refit.state import SketchStreamStateMixin
+from ..workflow.pipeline import LabelEstimator
+from .core import (
+    MASK_INDEX_EXACT_ROWS,
+    VARIANTS,
+    sketch_state_bytes,
+    sketch_stream_finish,
+    sketch_stream_init,
+    sketch_stream_step,
+)
+
+
+def default_sketch_size(d: int) -> int:
+    """Sketch rows for a width-d fit when nothing pins one: ``min(4096,
+    max(128, d))``. At s ≥ d the sketched ridge objective is a
+    full-rank compression (near-exact recovery); only past d=4096 does
+    the O(s·d) state force the accuracy/memory trade the error bounds
+    in docs/SOLVERS.md quantify."""
+    return int(min(4096, max(128, int(d))))
+
+
+def sketch_min_width() -> int:
+    """Ladder eligibility floor (``KEYSTONE_SKETCH_MIN_WIDTH``): below
+    this featurized width the exact/Gram rungs are both affordable and
+    more accurate, so the sketched rung prices itself out (inf)."""
+    return env_int("KEYSTONE_SKETCH_MIN_WIDTH", 8192)
+
+
+def _refine_iters_default() -> int:
+    return env_int("KEYSTONE_SKETCH_REFINE", 16)
+
+
+def _reg_floor(k_mat, s: int, reg: float) -> float:
+    """λ for the s×s dual solve: the caller's ridge when set, else the
+    scale-aware floor the block solver uses (block.py) — relative to
+    tr(K)/s so a rank-deficient sketch factors finitely instead of
+    emitting NaNs."""
+    if reg and reg > 0:
+        return float(reg)
+    import jax.numpy as jnp
+
+    return max(1e-6 * float(jnp.trace(k_mat)) / max(s, 1), 1e-6)
+
+
+class SketchedLeastSquaresEstimator(SketchStreamStateMixin, LabelEstimator):
+    """Least squares from an O(s·d) row-space sketch.
+
+    The very-wide rung of the solver ladder (least_squares.py): state
+    O(s·d) vs the Gram family's O(d²), so a d≥64k streamed fit holds in
+    memory where KV303 refuses the Gram path. ``reg`` follows the exact
+    rung's contract (>0 ridge, 0/None minimum-norm via the scale-aware
+    floor); ``sketch_size``/``variant``/``seed`` default from the
+    ``KEYSTONE_SKETCH_*`` knobs (docs/SOLVERS.md).
+    """
+
+    #: Chunked-fit protocol (workflow/streaming.py): the sketch carry
+    #: accumulates per chunk exactly like a Gram does.
+    supports_fit_stream = True
+
+    def __init__(
+        self,
+        reg: Optional[float] = None,
+        sketch_size: Optional[int] = None,
+        variant: Optional[str] = None,
+        seed: Optional[int] = None,
+        refine_iters: Optional[int] = None,
+    ):
+        self.reg = reg
+        self.sketch_size = sketch_size
+        self.variant = variant or env_str(
+            "KEYSTONE_SKETCH_VARIANT", "countsketch"
+        )
+        if self.variant not in VARIANTS:
+            raise ValueError(
+                f"KEYSTONE_SKETCH_VARIANT={self.variant!r} "
+                f"(known: {VARIANTS})"
+            )
+        self.seed = (
+            env_int("KEYSTONE_SKETCH_SEED", 0) if seed is None else int(seed)
+        )
+        self.refine_iters = refine_iters
+
+    # ------------------------------------------------------- configuration
+    def _resolve_sketch_size(self, d: int) -> int:
+        """Priority: env knob > constructor > MeasuredKnobRule's tuned
+        winner (knobs.py copies estimators with ``_tuned_sketch_size``)
+        > width default."""
+        s = env_int("KEYSTONE_SKETCH_SIZE", 0)
+        if s > 0:
+            return s
+        if self.sketch_size:
+            return int(self.sketch_size)
+        tuned = getattr(self, "_tuned_sketch_size", 0)
+        if tuned:
+            return int(tuned)
+        return default_sketch_size(d)
+
+    @property
+    def stream_state_meta(self):
+        """Envelope meta for kind="sketch" states: what a resumed or
+        merged fold must agree on for the additive carry algebra to be
+        meaningful (sizes are structural — carried by the shapes)."""
+        return {
+            "sketch_variant": self.variant,
+            "sketch_seed": int(self.seed),
+        }
+
+    def out_spec(self, in_specs):
+        """Plan-time spec protocol (workflow/verify.py): same fitted-map
+        shape as every least-squares rung."""
+        from ..workflow.verify import dense_fit_spec
+
+        return dense_fit_spec(in_specs, self.label)
+
+    # ------------------------------------------------------- streamed path
+    def fit_stream(self, stream, state=None):
+        """One-pass sketch-and-solve over the chunk stream.
+
+        ``state`` (kind="sketch") seeds the carry so the fold EXTENDS an
+        earlier fit — resuming adopts the state's (variant, seed) so the
+        combined sketch stays one coherent linear map of all rows."""
+        from ..ops.learning.block import _stream_shapes
+        from ..workflow.streaming import StreamingFallback
+
+        n_rows = int(getattr(stream, "num_examples", 0))
+        if n_rows > MASK_INDEX_EXACT_ROWS:
+            raise StreamingFallback(
+                f"sketch row indices exceed float32-exact range "
+                f"({n_rows} > {MASK_INDEX_EXACT_ROWS})"
+            )
+        variant, seed = self.variant, self.seed
+        if state is not None and state.meta.get("sketch_variant"):
+            variant = state.meta["sketch_variant"]
+            seed = int(state.meta.get("sketch_seed", seed))
+            self.variant, self.seed = variant, seed
+        shapes = {}
+
+        def init(feat_aval, y_aval):
+            d, k = _stream_shapes(feat_aval, y_aval)
+            s = self._resolve_sketch_size(d)
+            shapes.update(s=s, d=d, k=k)
+            return self._seed_carry(state, s, d, k)
+
+        t0 = time.perf_counter()
+        carry, info = stream.fold(init, sketch_stream_step(variant, seed))
+        n = info["num_examples"] + (state.num_examples if state else 0)
+        self._capture_state(
+            carry, n, reg=self.reg,
+            sketch_variant=variant, sketch_seed=int(seed),
+        )
+        model = self._finish_from_stats(carry, n)
+        self._observe(
+            rows=n, wall_s=time.perf_counter() - t0, variant=variant, **shapes
+        )
+        return model
+
+    def _finish_from_stats(self, carry, n: int):
+        """Solve the sketched objective from the carry alone — shared by
+        the streamed fit and the refit ``finish_from_state`` path.
+
+        Rung 1 ("dual") is the s×s dual-ridge solve; when it OOMs the
+        ladder degrades to a direct lstsq on the sketched system
+        (O(s·d·min(s,d)) workspace instead of s² + the Cholesky's
+        temporaries) — slower, never bigger."""
+        import jax.numpy as jnp
+
+        from ..obs import solver as solver_obs
+        from ..reliability import DegradationLadder, probe
+        from ..ops.learning.linear import LinearMapper
+
+        carry = [jnp.asarray(c) for c in carry]
+        sa_c, sy_c, mu_a, mu_b = sketch_stream_finish(carry, n)
+        s, d = int(sa_c.shape[0]), int(sa_c.shape[1])
+
+        def _primal():
+            # s ≥ d: stacked ridge lstsq on [SAc; √λ·I]. The dual form is
+            # catastrophically unstable here — K = SAc·SAcᵀ is rank ≤ d,
+            # so (K+λI)⁻¹·SYc blows up ~‖SYc‖/λ along K's null space and
+            # the cancellation under SAcᵀ is exact only in exact
+            # arithmetic; float-reorder noise in the carry (sharded or
+            # resumed accumulation) amplifies to ~1e-3 in W.
+            trace = jnp.sum(sa_c * sa_c)
+            lam = self.reg if self.reg and self.reg > 0 else jnp.maximum(
+                1e-6 * trace / s, 1e-6
+            )
+            stacked = jnp.concatenate(
+                [sa_c, jnp.sqrt(lam) * jnp.eye(d, dtype=sa_c.dtype)], axis=0
+            )
+            rhs = jnp.concatenate(
+                [sy_c, jnp.zeros((d, sy_c.shape[1]), sy_c.dtype)], axis=0
+            )
+            w, *_ = jnp.linalg.lstsq(stacked, rhs, rcond=None)
+            return w
+
+        def _dual():
+            # s < d: the s×s dual is the whole point of the sketch — the
+            # d×d primal never materializes; K is full-rank generically.
+            k_mat = sa_c @ sa_c.T
+            lam = _reg_floor(k_mat, s, self.reg or 0.0)
+            duals = jnp.linalg.solve(
+                k_mat + lam * jnp.eye(s, dtype=k_mat.dtype), sy_c
+            )
+            return sa_c.T @ duals
+
+        def _lstsq():
+            w, *_ = jnp.linalg.lstsq(sa_c, sy_c, rcond=None)
+            return w
+
+        first = ("primal", _primal) if s >= d else ("dual", _dual)
+        ladder = DegradationLadder(
+            [first, ("lstsq", _lstsq)], label="sketch.finish"
+        )
+
+        attempts = iter(range(len(ladder.rungs)))
+
+        def attempt(rung):
+            name, fn = rung
+            probe("sketch.finish")
+            with solver_obs.rung_span("sketch_ls", name, next(attempts)):
+                return fn()
+
+        t0 = time.perf_counter()
+        w = ladder.run(attempt)
+        self._metric_finish(time.perf_counter() - t0)
+        model = LinearMapper(w, intercept=mu_b, feature_mean=mu_a)
+        if ladder.reduced:
+            model.degradation = dict(
+                ladder.record, rung=ladder.record["rung"][0],
+                first_rung=ladder.record["first_rung"][0],
+            )
+        return model
+
+    # -------------------------------------------------------- in-core path
+    def fit(self, data, labels):
+        """Sketch-and-precondition on materialized data: the sketch
+        builds a Woodbury preconditioner and block PCG refines on the
+        FULL operator, so accuracy is solver-grade while no d×d matrix
+        ever exists."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.learning.linear import LinearMapper
+        from ..ops.stats.core import _as_array_dataset
+
+        features = _as_array_dataset(data)
+        targets = _as_array_dataset(labels)
+        x = jnp.asarray(features.data, jnp.float32)[: features.num_examples]
+        y = jnp.asarray(targets.data, jnp.float32)[: targets.num_examples]
+        if y.ndim == 1:
+            y = y[:, None]
+        n, d = int(x.shape[0]), int(x.shape[1])
+        mu_a = jnp.mean(x, axis=0)
+        mu_b = jnp.mean(y, axis=0)
+        xc, yc = x - mu_a, y - mu_b
+        s = self._resolve_sketch_size(d)
+        iters = (
+            self.refine_iters
+            if self.refine_iters is not None
+            else _refine_iters_default()
+        )
+        t0 = time.perf_counter()
+        w = sketch_precond_lstsq(
+            xc, yc, reg=self.reg or 0.0, sketch_size=s,
+            variant=self.variant, seed=self.seed, iters=iters,
+        )
+        self._observe(
+            rows=n, wall_s=time.perf_counter() - t0, variant=self.variant,
+            s=s, d=d, k=int(y.shape[1]), refine_iters=iters,
+        )
+        return LinearMapper(w, intercept=mu_b, feature_mean=mu_a)
+
+    # --------------------------------------------------------- observation
+    def _observe(self, rows, wall_s, variant, s, d, k, **extra):
+        """Profile-store observation (MeasuredKnobRule reads the best
+        recorded sketch size back) + the keystone_sketch_* metrics.
+        Best effort — observability must never fail a fit."""
+        try:
+            from ..obs import names as _names
+            from ..ops.learning.block import _record_solver_observation
+
+            _record_solver_observation(
+                "sketch_ls", rows=rows, d=d, block_size=s, wall_s=wall_s,
+                rungs_attempted=1, sketch_size=s, sketch_variant=variant,
+                **extra,
+            )
+            _names.metric(_names.SKETCH_FITS).inc(variant=variant)
+            _names.metric(_names.SKETCH_SIZE).set(s)
+            _names.metric(_names.SKETCH_STATE_BYTES).set(
+                sketch_state_bytes(s, d, k)
+            )
+        except Exception:  # pragma: no cover
+            pass
+
+    def _metric_finish(self, seconds: float) -> None:
+        try:
+            from ..obs import names as _names
+
+            _names.metric(_names.SKETCH_FINISH_SECONDS).observe(seconds)
+        except Exception:  # pragma: no cover
+            pass
+
+
+# -------------------------------------------------- sketch-and-precondition
+
+
+def sketch_precond_lstsq(
+    xc,
+    yc,
+    reg: float = 0.0,
+    sketch_size: Optional[int] = None,
+    variant: str = "countsketch",
+    seed: int = 0,
+    iters: Optional[int] = None,
+    block_rows: int = 8192,
+):
+    """Solve min ‖xc·w − yc‖² + reg‖w‖² by sketch-and-precondition.
+
+    ``xc``/``yc`` are CENTERED (n, d)/(n, k). The sketch of xc (built
+    block-by-block — additivity is exact) yields K = (S·xc)(S·xc)ᵀ and
+    the Woodbury preconditioner
+
+        M⁻¹v = (v − (S·xc)ᵀ(K+λI)⁻¹(S·xc)v) / λ,
+
+    the exact inverse of the SKETCHED normal operator — when the sketch
+    is a subspace embedding, M⁻¹N has condition O(1) and block PCG on
+    the full operator N·v = xcᵀ(xc·v) + λv converges in a handful of
+    iterations regardless of xc's conditioning (the sketch-to-
+    precondition literature's whole point). Returns w (d, k).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.linalg import lu_factor, lu_solve, solve_triangular
+
+    xc = jnp.asarray(xc, jnp.float32)
+    yc = jnp.asarray(yc, jnp.float32)
+    if yc.ndim == 1:
+        yc = yc[:, None]
+    n, d = int(xc.shape[0]), int(xc.shape[1])
+    s = int(sketch_size or default_sketch_size(d))
+    iters = _refine_iters_default() if iters is None else int(iters)
+
+    step = sketch_stream_step(variant, int(seed))
+    carry = sketch_stream_init(s, d, int(yc.shape[1]))
+    for start in range(0, n, block_rows):
+        stop = min(start + block_rows, n)
+        mask = jnp.arange(start + 1, stop + 1, dtype=jnp.float32)[:, None]
+        carry = step(carry, xc[start:stop], yc[start:stop], mask)
+    sa = carry[0]  # xc is pre-centered: the raw sketch IS the centered one
+
+    k_mat = sa @ sa.T
+    lam = _reg_floor(k_mat, s, reg)
+
+    if s >= d:
+        # Blendenpik form: R from QR of [SA; √λ·I] gives RᵀR = SAᵀSA + λI
+        # exactly, applied by two triangular solves — stable where the
+        # s×s K (rank ≤ d < s) would NaN a float32 Cholesky.
+        stacked = jnp.concatenate(
+            [sa, jnp.sqrt(lam) * jnp.eye(d, dtype=sa.dtype)], axis=0
+        )
+        _, rmat = jnp.linalg.qr(stacked)
+
+        def minv(v):
+            t = solve_triangular(rmat.T, v, lower=True)
+            return solve_triangular(rmat, t, lower=False)
+
+    else:
+        # Very-wide regime: K = SA·SAᵀ is generically full-rank (s < d),
+        # so the Woodbury identity inverts (SAᵀSA + λI) through one s×s
+        # LU factor.
+        lu = lu_factor(k_mat + lam * jnp.eye(s, dtype=k_mat.dtype))
+
+        def minv(v):
+            return (v - sa.T @ lu_solve(lu, sa @ v)) / lam
+
+    def nmat(v):  # the full (never materialized) normal operator
+        return xc.T @ (xc @ v) + lam * v
+
+    tiny = jnp.asarray(1e-30, jnp.float32)
+    b = xc.T @ yc
+    w = jnp.zeros_like(b)
+    r = b  # w0 = 0
+    z = minv(r)
+    p = z
+    rz = jnp.sum(r * z, axis=0)
+    for _ in range(max(iters, 0)):
+        q = nmat(p)
+        alpha = rz / jnp.maximum(jnp.sum(p * q, axis=0), tiny)
+        w = w + alpha * p
+        r = r - alpha * q
+        z = minv(r)
+        rz_new = jnp.sum(r * z, axis=0)
+        beta = rz_new / jnp.maximum(rz, tiny)
+        p = z + beta * p
+        rz = rz_new
+
+    def sketch_only():
+        # The dual identity on the sketched system alone — coarser than
+        # refined PCG but bounded, and never NaN.
+        return sa.T @ jnp.linalg.solve(
+            k_mat + lam * jnp.eye(s, dtype=k_mat.dtype), carry[1]
+        )
+
+    if iters <= 0:
+        w = sketch_only()
+    else:
+        # Divergence guard: when s undersamples the row space (s well
+        # below rank(xc)) M⁻¹N is no longer O(1)-conditioned and PCG can
+        # run away — float32 overflow shows up as a residual orders of
+        # magnitude past ‖b‖, then NaN. The refined answer is only kept
+        # when it beats the starting residual.
+        r_norm = jnp.linalg.norm(r)
+        b_norm = jnp.linalg.norm(b)
+        if not bool(jnp.isfinite(r_norm)) or float(r_norm) > float(b_norm):
+            w = sketch_only()
+    return jax.block_until_ready(w)
+
+
+# ------------------------------------------------------------ Nyström KRR
+
+
+def nystrom_krr(x, y, gamma: float, reg: float, landmarks: int, seed: int = 0):
+    """Randomized Nyström kernel ridge: m seeded uniform landmarks, solve
+    (K_nmᵀK_nm + reg·K_mm)·α = K_nmᵀy — O(n·m + m²) state instead of the
+    full O(n²) kernel. Returns (landmark_indices, duals) for a mapper
+    that scores via K(x, landmarks)·α (ops/learning/kernel.py gates the
+    path on ``KEYSTONE_KERNEL_NYSTROM``)."""
+    import jax.numpy as jnp
+
+    from ..ops.learning.kernel import gaussian_kernel_block
+
+    x = jnp.asarray(x, jnp.float32)
+    y = np.asarray(y, np.float64)
+    if y.ndim == 1:
+        y = y[:, None]
+    n = int(x.shape[0])
+    m = int(min(landmarks, n))
+    rng = np.random.default_rng(np.uint64(seed) ^ np.uint64(0xA11CE5))
+    idx = np.sort(rng.choice(n, size=m, replace=False))
+    xm = x[jnp.asarray(idx)]
+    knm = np.asarray(gaussian_kernel_block(x, xm, gamma), np.float64)  # (n, m)
+    kmm = np.asarray(gaussian_kernel_block(xm, xm, gamma), np.float64)  # (m, m)
+    lam = max(float(reg), 1e-6)
+    # min ‖K_nm·α − y‖² + λ·αᵀK_mm·α as a stacked least squares
+    # [K_nm; √λ·Lᵀ]·α ≈ [y; 0] with L = chol(K_mm + jitter) — the normal
+    # equations K_nmᵀK_nm square κ(K), which in float32 blows up exactly
+    # as m→n on a smooth kernel; the stacked form keeps κ(K) itself and
+    # the float64 host solve is cheap next to the O(n·m) panel.
+    jitter = 1e-10 * max(float(np.trace(kmm)) / m, 1.0)
+    lmat = np.linalg.cholesky(kmm + jitter * np.eye(m))
+    stacked = np.concatenate([knm, np.sqrt(lam) * lmat.T], axis=0)
+    rhs = np.concatenate([y, np.zeros((m, y.shape[1]))], axis=0)
+    duals, *_ = np.linalg.lstsq(stacked, rhs, rcond=None)
+    return idx, jnp.asarray(duals, jnp.float32)

@@ -158,6 +158,13 @@ def _provenance(entry, partition_by_label: Dict[str, Any]) -> Dict[str, Any]:
             out["store_key"] = entry.predicted_key
         if entry.predicted_shape:
             out["shape_class"] = entry.predicted_shape
+    if getattr(entry, "predicted_candidates", ()):
+        # The whole ladder the argmin saw — every rung's predicted cost
+        # with the rejected rungs' reasons, not just the survivor.
+        out["candidates"] = [
+            {"rung": name, "predicted_s": cost, "reason": reason}
+            for name, cost, reason in entry.predicted_candidates
+        ]
     decision = partition_by_label.get(entry.node)
     if decision is not None:
         out["partition"] = {
@@ -207,6 +214,13 @@ def _render_human(report: Dict[str, Any]) -> str:
             f"{(intensity if intensity is not None else float('nan')):6.2f} "
             f"{node.get('roofline') or 'unmeasured':>14s}  {prov_text}"
         )
+        for cand in prov.get("candidates", []):
+            cost = cand.get("predicted_s")
+            cost_text = f"{cost * 1e3:9.3f}" if cost is not None else "      inf"
+            lines.append(
+                f"    ∟ rung {cand['rung']:14s} pred ms {cost_text}  "
+                f"{cand['reason']}"
+            )
     for event in report.get("drift_events", []):
         lines.append(
             f"  DRIFT: {event['model']} mis-predicted {event['node']} "

@@ -86,11 +86,17 @@ def fit_from_args(args) -> int:
     ).astype(np.float32)
 
     enable_checkpointing(args.store_dir)
+    if getattr(args, "solver", "gram") == "sketch":
+        from ..sketch import SketchedLeastSquaresEstimator
+
+        estimator = SketchedLeastSquaresEstimator(reg=args.reg)
+    else:
+        estimator = LinearMapEstimator(reg=args.reg)
     pipeline = (
         FitDemoScaler(scale=2.0, shift=0.5)
         .to_pipeline()
         .then_label_estimator(
-            LinearMapEstimator(reg=args.reg),
+            estimator,
             ArrayDataset(x),
             ArrayDataset(y),
         )

@@ -174,6 +174,7 @@ class MeasuredKnobRule(Rule):
             if mode == "all":
                 graph = self._tune_solver_block(graph, store, overrides, sp)
                 graph = self._tune_solver_precision(graph, store, overrides, sp)
+                graph = self._tune_sketch_size(graph, store, overrides, sp)
         return graph, prefixes
 
     # ------------------------------------------------------- chunk rows
@@ -284,6 +285,68 @@ class MeasuredKnobRule(Rule):
             _spans.add_span_event(
                 "measured_knob", knob="solver_block_size",
                 value=best_block, was=block,
+            )
+        return graph
+
+    # ------------------------------------------------------ sketch size
+    def _tune_sketch_size(self, graph, store, overrides, sp):
+        from ..sketch.solvers import SketchedLeastSquaresEstimator
+        from .streaming import StreamingFitOperator
+
+        if env_set("KEYSTONE_SKETCH_SIZE"):
+            return graph  # explicit env knob always wins
+        for node in sorted(graph.nodes):
+            op = graph.operators.get(node)
+            target = op.estimator if isinstance(op, StreamingFitOperator) else op
+            if not isinstance(target, EstimatorOperator):
+                continue
+            # Eligible: the sketched rung itself, or a meta-solver whose
+            # width dispatch may pick it (_tuned_sketch_size rides the
+            # delegation either way; Gram rungs just never read it).
+            sketched = isinstance(target, SketchedLeastSquaresEstimator)
+            if not sketched and not callable(
+                getattr(target, "_stream_solver", None)
+            ):
+                continue
+            if getattr(target, "sketch_size", None):
+                continue  # constructor pinned its own choice
+            rows = self._head_rows_bucket(graph, node)
+            if rows is None:
+                continue
+            # Same commensurability rules as block size: only sketch_ls
+            # entries vote, and the winning s must be unanimous across
+            # the bucket's feature widths.
+            best = _unanimous_winner(
+                store, "solver:sketch_ls:", rows, "sketch_size",
+                knob="sketch_size", sp=sp,
+            )
+            if best is None:
+                continue
+            best_key, best_shape, best = best
+            best_s = int(best.get("sketch_size", 0))
+            if best_s <= 0 or best_s == getattr(
+                target, "_tuned_sketch_size", None
+            ):
+                continue
+            tuned = copy.copy(target)
+            tuned._tuned_sketch_size = best_s
+            tuned.predicted_cost = _cost.Prediction(
+                model="measured_knob", key=best_key, shape=best_shape,
+                seconds=float(best["wall_s"]), calibrated=False,
+                source=str(best.get("source", "observed")),
+            )
+            if isinstance(op, StreamingFitOperator):
+                new_op = StreamingFitOperator(
+                    tuned, op.members,
+                    chunk_rows=op.chunk_rows, prefetch=op.prefetch,
+                )
+            else:
+                new_op = tuned
+            graph = graph.set_operator(node, new_op)
+            overrides.inc(knob="sketch_size")
+            sp.set_attribute(f"sketch_size:{node}", best_s)
+            _spans.add_span_event(
+                "measured_knob", knob="sketch_size", value=best_s,
             )
         return graph
 

@@ -362,12 +362,21 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
 
     traces: List[tuple] = []
 
+    # Index-keyed folds (sketch/core.py) declare needs_mask: the step
+    # receives the chunk's pad mask — whose lane holds absolute row
+    # indices — as a fourth argument. Gram-family steps keep the 3-arg
+    # signature untouched.
+    needs_mask = bool(getattr(step_fn, "needs_mask", False))
+
     if partition is None:
 
         def fused(carry, x_raw, y, mask):
             traces.append(())  # trace-time side effect: once per new shape
             x = _apply_chain(members, x_raw, mask)
-            new_carry = step_fn(carry, x, y)
+            if needs_mask:
+                new_carry = step_fn(carry, x, y, mask)
+            else:
+                new_carry = step_fn(carry, x, y)
             leaf = jax.tree_util.tree_leaves(new_carry)[0]
             probe = leaf.ravel()[:1]  # tiny, NOT donated: safe to block on
             return new_carry, probe
@@ -390,7 +399,13 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
                 # contract), so per-shard application is exact.
                 c0 = jax.tree_util.tree_map(lambda a: a[0], c)
                 feats = _apply_chain(members, x, m)
-                c1 = step_fn(c0, feats, yb)
+                # m is this device's row slice of the mask, so an
+                # index-keyed step sees exactly its rows' absolute
+                # indices — per-shard sketch partials stay exact.
+                if needs_mask:
+                    c1 = step_fn(c0, feats, yb, m)
+                else:
+                    c1 = step_fn(c0, feats, yb)
                 return jax.tree_util.tree_map(lambda a: a[None], c1)
 
             new_carry = _smap(
@@ -629,8 +644,14 @@ class ChunkStream:
                     y = np.concatenate(
                         [y, np.zeros((padded_rows - rows,) + y.shape[1:], y.dtype)]
                     )
+                # The pad-mask lane carries each row's ABSOLUTE dataset
+                # index + 1 (0 = pad). The chain only tests m > 0, so
+                # this is backward-compatible; index-keyed folds (the
+                # sketch tier) read the value itself, which stays exact
+                # in float32 up to 2^24 rows (sketch/core.py refuses
+                # longer streams).
                 mask = np.zeros((padded_rows, 1), np.float32)
-                mask[:rows] = 1.0
+                mask[:rows, 0] = np.arange(start + 1, stop + 1, dtype=np.float32)
                 return x, y, mask, rows
 
             return prepare

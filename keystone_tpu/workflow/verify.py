@@ -38,6 +38,14 @@ KV307     error     a serving boot image's environment fingerprints
                     serving through its executables could return garbage;
                     the image is refused and the worker falls back to the
                     classic warm path (:func:`verify_boot_image`)
+KV308     error     a streamed fit routed onto the sketched tier
+                    (keystone_tpu/sketch) is infeasible or meaningless:
+                    even the O(s·d) sketch state exceeds the device
+                    memory budget (no further rung exists below the
+                    sketch), or the sketch size fails the conditioning
+                    heuristic (s below the label width / dual-solve
+                    floor), so the sketched objective's error bound is
+                    vacuous
 KV401     error     dependency cycle in the graph
 KV402     info      node not statically analyzable (no ``out_spec``,
                     not eval_shape-able) — propagation continues unknown
@@ -112,6 +120,7 @@ CODES: Dict[str, Tuple[str, str]] = {
     "KV305": (ERROR, "refit candidate disagrees with incumbent warm state"),
     "KV306": (ERROR, "stale stream-resume entry refused"),
     "KV307": (ERROR, "stale boot image refused"),
+    "KV308": (ERROR, "sketched-fit state infeasible or bound too weak"),
     "KV401": (ERROR, "dependency cycle"),
     "KV402": (INFO, "node not statically analyzable"),
 }
@@ -748,7 +757,10 @@ def _streaming_diagnostics(
         op = graph.get_operator(node)
         label = str(getattr(op, "label", type(op).__name__))
         if isinstance(op, StreamingFitOperator):
-            _gram_feasibility(graph, interp, node, op, memory_limit)
+            if _plan_state_kind(interp, node, op) == "sketch":
+                _sketch_feasibility(graph, interp, node, op, memory_limit)
+            else:
+                _gram_feasibility(graph, interp, node, op, memory_limit)
             continue
         if not isinstance(op, EstimatorOperator):
             continue
@@ -922,6 +934,98 @@ def _gram_feasibility(
             d=d,
             k=k,
             gram_bytes=gram_bytes,
+            memory_limit=memory_limit,
+        )
+
+
+def _plan_state_kind(interp: _Interpreter, node: NodeId, op: Any) -> str:
+    """Which stream-state kind this fit will produce at plan time —
+    mirrors the solver ladder's width-based dispatch so the feasibility
+    check inspects the rung that will actually run."""
+    from ..refit.state import SketchStreamStateMixin
+
+    est = getattr(op, "estimator", None)
+    if isinstance(est, SketchStreamStateMixin):
+        return "sketch"
+    feat_spec = interp.specs.get(("feat", node))
+    d = _width(feat_spec) if feat_spec is not None else None
+    solver_for = getattr(est, "_stream_solver", None)
+    if callable(solver_for) and d is not None:
+        try:
+            return str(getattr(solver_for(d), "stream_state_kind", "gram"))
+        except Exception:
+            return "gram"
+    return "gram"
+
+
+def _sketch_feasibility(
+    graph: Graph,
+    interp: _Interpreter,
+    node: NodeId,
+    op: Any,
+    memory_limit: Optional[int],
+) -> None:
+    """The sketched tier is the LAST memory rung — below it there is
+    nothing to degrade to, so an O(s·d) state that still misses the
+    budget, or a sketch size too small for its error bound to mean
+    anything (s below the dual-solve / label-width floor), is a plan
+    error (KV308), not a warning like the Gram tier's KV303."""
+    from ..envknobs import env_int
+    from ..sketch.core import sketch_state_bytes
+    from ..sketch.solvers import default_sketch_size
+
+    feat_spec = interp.specs.get(("feat", node))
+    d = _width(feat_spec) if feat_spec is not None else None
+    if d is None:
+        return
+    label = str(getattr(op, "label", type(op).__name__))
+    k = 1
+    deps = graph.get_dependencies(node)
+    if len(deps) > 1:
+        k = _width(interp.specs.get(deps[1])) or 1
+    est = getattr(op, "estimator", None)
+    s = (
+        env_int("KEYSTONE_SKETCH_SIZE", 0)
+        or int(getattr(est, "sketch_size", 0) or 0)
+        or default_sketch_size(d)
+    )
+    # Conditioning / bound heuristic: the finish is a dual s×s ridge
+    # whose solution spans at most s directions — with s below a small
+    # multiple of the label width (or a hard floor) the sketched
+    # objective's error bound is vacuous. Checked even without a memory
+    # budget: a bad sketch size is wrong on any device.
+    floor = max(32, 4 * (k + 1))
+    if s < floor:
+        interp.diag(
+            "KV308",
+            f"{label}: sketch size s={s} is below the conditioning floor "
+            f"{floor} (max(32, 4*(k+1)) with k={k}) — the dual ridge "
+            "finish spans too few directions for the sketch-and-solve "
+            "error bound to hold; raise KEYSTONE_SKETCH_SIZE",
+            node=node,
+            d=d,
+            k=k,
+            sketch_size=s,
+            floor=floor,
+        )
+        return
+    if memory_limit is None:
+        return
+    # carry (SA s·d, SY s·k, s1, sums) + the donated update's transient
+    # double-residency: same 2× working-set model as the Gram check.
+    state_bytes = 2 * sketch_state_bytes(s, d, k)
+    if state_bytes > memory_limit:
+        interp.diag(
+            "KV308",
+            f"{label}: even the sketched tier needs ~{state_bytes / 1e9:.2f} "
+            f"GB of state (s={s}, d={d}, k={k}) against a "
+            f"{memory_limit / 1e9:.2f} GB budget — no lower-memory rung "
+            "exists; shrink KEYSTONE_SKETCH_SIZE or the feature width",
+            node=node,
+            d=d,
+            k=k,
+            sketch_size=s,
+            state_bytes=state_bytes,
             memory_limit=memory_limit,
         )
 

@@ -25,25 +25,29 @@ WORK=$(mktemp -d /tmp/elastic_smoke.XXXXXX)
 trap 'rm -rf "$WORK"' EXIT
 KILL5='[{"match":"streaming.chunk","kind":"kill","calls":[5]}]'
 
-run_leg () {  # run_leg <name> <device-count-flags...>
+run_leg () {  # run_leg <name> <device-count-flags...>; SOLVER=gram|sketch
   local name="$1"; shift
   local flags=("$@")
+  local solver="${SOLVER:-gram}"
 
-  echo "== elastic leg: $name =="
+  echo "== elastic leg: $name (solver=$solver) =="
   env "${flags[@]}" timeout -k 10 180 python -m keystone_tpu fit \
+    --solver "$solver" \
     --store-dir "$WORK/$name-ref" --out "$WORK/$name-ref.npz" \
     | tee "$WORK/$name-ref.log" | grep -a FIT_STATS >/dev/null
 
   # SIGKILL at chunk 5 of 8 (checkpoints at 2 and 4) — rc must be a kill.
   set +e
   env "${flags[@]}" KEYSTONE_FAULT_SPECS="$KILL5" timeout -k 10 180 \
-    python -m keystone_tpu fit --store-dir "$WORK/$name-dur" \
+    python -m keystone_tpu fit --solver "$solver" \
+    --store-dir "$WORK/$name-dur" \
     --ckpt-chunks 2 >/dev/null 2>&1
   rc=$?
   set -e
   [ "$rc" -ne 0 ] || { echo "FAIL($name): killed run exited 0"; exit 1; }
 
   env "${flags[@]}" timeout -k 10 180 python -m keystone_tpu fit \
+    --solver "$solver" \
     --store-dir "$WORK/$name-dur" --ckpt-chunks 2 \
     --out "$WORK/$name-res.npz" --expect-resume \
     | tee "$WORK/$name-res.log" | grep -a FIT_STATS > "$WORK/$name-res.json"
@@ -71,6 +75,35 @@ EOF
 
 run_leg sharded XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 run_leg onedev XLA_FLAGS="${XLA_FLAGS:-}"
+# Same kill/resume contract on the NON-Gram state family: the sketched
+# tier's kind="sketch" carries ride the identical ResumeEntry path.
+SOLVER=sketch run_leg sketch-sharded \
+  XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+SOLVER=sketch run_leg sketch-onedev XLA_FLAGS="${XLA_FLAGS:-}"
+
+# ---- sketched shard loss: a device lost mid-stream is absorbed --------
+echo "== elastic leg: sketch-shardloss =="
+SHARDLOSS='[{"match":"parallel.shard_loss","kind":"transient","calls":[3]}]'
+env XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  KEYSTONE_FAULT_SPECS="$SHARDLOSS" timeout -k 10 180 \
+  python -m keystone_tpu fit --solver sketch \
+  --store-dir "$WORK/skloss" --out "$WORK/skloss.npz" \
+  | grep -a FIT_STATS > "$WORK/skloss.json"
+timeout -k 10 60 python - "$WORK" <<'EOF'
+import json, sys
+import numpy as np
+
+work = sys.argv[1]
+stats = json.loads(
+    open(f"{work}/skloss.json").read().split("FIT_STATS:", 1)[1]
+)
+assert stats["shard_losses"] > 0, stats
+ref = np.load(f"{work}/sketch-sharded-ref.npz")["preds"]
+out = np.load(f"{work}/skloss.npz")["preds"]
+err = float(np.linalg.norm(ref - out) / np.linalg.norm(ref))
+assert err <= 1e-5, f"sketch shard-loss parity {err} > 1e-5"
+print(f"sketch-shardloss: losses={stats['shard_losses']} parity_rel_err={err:.2e}")
+EOF
 
 # ---- seeded KV306: stale resume entry refused, strict mode exits 1 ----
 echo "== elastic leg: kv306 =="

@@ -1,0 +1,129 @@
+#!/usr/bin/env bash
+# Sketched-tier smoke (docs/SOLVERS.md): the randomized-NLA rung for
+# very-wide fits, end to end:
+#   1. LADDER — a d=8192 streamed fit routes onto the sketched rung via
+#      the solver ladder (no explicit estimator choice — the in-process
+#      keystone_sketch_fits_total counter proves the rung ran) and
+#      compiles ZERO steady-state steps (the sketch step is one memoized
+#      function), with a tight quality gate on low-effective-rank rows;
+#   2. RESUME — a real SIGKILL mid-stream; the re-run resumes from the
+#      durable cursor (kind="sketch" ResumeEntry) with parity ≤ 1e-6 vs
+#      the uninterrupted reference;
+#   3. KV308 — a sketch size below the conditioning floor is refused at
+#      plan time: KEYSTONE_VERIFY=strict exits 1 naming KV308.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+export KEYSTONE_STREAM_CHUNK_ROWS=256
+export KEYSTONE_SKETCH_SIZE=256
+
+timeout -k 10 300 python - <<'EOF'
+import numpy as np
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.obs import names as obs_names
+from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+from keystone_tpu.workflow.pipeline import BatchTransformer
+from keystone_tpu.workflow.streaming import last_stream_report
+
+# n is past the rung crossover (with KEYSTONE_SKETCH_SIZE=256 priced,
+# the sketched rung undercuts Gram-BCD from n≈2500 at this width) and
+# the rows have a low-dimensional latent structure: a row-space sketch
+# recovers predictions only up to the energy it captures, so a
+# TIGHT quality gate needs effective rank ≲ s — exactly the regime the
+# tier is for (docs/SOLVERS.md "When the sketch is enough").
+CHUNK, N, D, K, R = 256, 16 * 256, 8192, 4, 64
+rng = np.random.default_rng(7)
+z = rng.normal(size=(N, R)).astype(np.float32)
+basis = rng.normal(size=(R, D)).astype(np.float32) / np.sqrt(R)
+x = (z @ basis + 0.01 * rng.normal(size=(N, D))).astype(np.float32)
+w = rng.normal(size=(D, K)).astype(np.float32) / np.sqrt(D)
+y = (x @ w).astype(np.float32)
+
+
+class Scale(BatchTransformer):
+    def __init__(self, c):
+        self.c = float(c)
+
+    def apply_arrays(self, a):
+        return a * self.c
+
+
+# ---- 1. the ladder routes the very-wide fit onto the sketched rung ----
+# Proof the SKETCHED rung ran: the in-process keystone_sketch_fits_total
+# counter (the on-disk profile store can carry entries from earlier
+# runs, so its contents prove nothing about THIS fit).
+fits_c = obs_names.metric(obs_names.SKETCH_FITS)
+before = fits_c.value(variant="countsketch")
+
+est = LeastSquaresEstimator(reg=1e-3)
+pipeline = Scale(1.0).to_pipeline().then_label_estimator(
+    est, ArrayDataset(x), ArrayDataset(y)
+)
+handle = pipeline.fit()
+
+sketch_fits = fits_c.value(variant="countsketch") - before
+assert sketch_fits >= 1, (
+    "no sketched fit recorded — the ladder picked another rung"
+)
+
+rep = last_stream_report()
+assert rep is not None and rep.chunks == 16, (
+    "very-wide fit did not run on the streaming engine: " + repr(rep)
+)
+assert rep.compiles_steady_state == 0, (
+    f"sketched stream recompiled {rep.compiles_steady_state} steady chunks"
+)
+
+preds = np.asarray(handle.apply_batch(ArrayDataset(x[:256])).data)
+rel = np.linalg.norm(preds - y[:256]) / np.linalg.norm(y[:256])
+assert np.isfinite(preds).all() and rel < 0.05, rel
+print(f"ladder: kind=sketch chunks=16 steady_compiles=0 train_rel_err={rel:.4f}")
+
+EOF
+
+# ---- 2. SIGKILL mid-stream → resume parity ≤ 1e-6 ---------------------
+# The sketch hashes GLOBAL row indices (the mask lane), so resume must
+# ride the durable cursor — the ResumeEntry path, across real processes.
+WORK=$(mktemp -d /tmp/sketch_smoke.XXXXXX)
+trap 'rm -rf "$WORK"' EXIT
+KILL5='[{"match":"streaming.chunk","kind":"kill","calls":[5]}]'
+unset KEYSTONE_SKETCH_SIZE
+
+timeout -k 10 180 python -m keystone_tpu fit --solver sketch \
+  --store-dir "$WORK/ref" --out "$WORK/ref.npz" >/dev/null
+set +e
+env KEYSTONE_FAULT_SPECS="$KILL5" timeout -k 10 180 \
+  python -m keystone_tpu fit --solver sketch --store-dir "$WORK/dur" \
+  --ckpt-chunks 2 >/dev/null 2>&1
+rc=$?
+set -e
+[ "$rc" -ne 0 ] || { echo "FAIL: killed sketch run exited 0"; exit 1; }
+timeout -k 10 180 python -m keystone_tpu fit --solver sketch \
+  --store-dir "$WORK/dur" --ckpt-chunks 2 --out "$WORK/res.npz" \
+  --expect-resume >/dev/null
+timeout -k 10 60 python - "$WORK" <<'EOF'
+import sys
+import numpy as np
+
+work = sys.argv[1]
+ref = np.load(f"{work}/ref.npz")["preds"]
+res = np.load(f"{work}/res.npz")["preds"]
+err = float(np.linalg.norm(ref - res) / np.linalg.norm(ref))
+assert err <= 1e-6, f"sketch resume parity {err} > 1e-6"
+print(f"resume: parity_rel_err={err:.2e}")
+EOF
+
+# ---- 3. seeded KV308: conditioning floor refused under strict ---------
+set +e
+env KEYSTONE_SKETCH_SIZE=4 KEYSTONE_VERIFY=strict timeout -k 10 180 \
+  python -m keystone_tpu fit --solver sketch --store-dir "$WORK/kv" \
+  > "$WORK/kv308.log" 2>&1
+rc=$?
+set -e
+[ "$rc" -eq 1 ] || { echo "FAIL: KV308 strict refusal exited $rc (want 1)"; cat "$WORK/kv308.log"; exit 1; }
+grep -aq "KV308" "$WORK/kv308.log" || { echo "FAIL: no KV308 in refusal output"; cat "$WORK/kv308.log"; exit 1; }
+echo "kv308: undersized sketch refused under strict (exit 1)"
+
+echo "sketch_smoke OK"

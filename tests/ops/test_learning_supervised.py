@@ -240,8 +240,13 @@ def test_meta_solver_choice_flips_at_tpu_crossover_shapes():
         x = rng.normal(size=(64, d)).astype(np.float32)
         return est.optimize([ArrayDataset(x), ArrayDataset(y)], stats)
 
+    from keystone_tpu.sketch.solvers import SketchedLeastSquaresEstimator
+
     assert isinstance(choice(1024), LinearMapEstimator)       # exact wins small-d
-    assert isinstance(choice(16384), BlockLeastSquaresEstimator)  # block wins big-d
+    assert isinstance(choice(4096), BlockLeastSquaresEstimator)   # block wins big-d
+    # Past the sketch width floor the randomized rung tops the ladder
+    # (docs/SOLVERS.md): O(s·d) state vs block's O(d²)-adjacent cost.
+    assert isinstance(choice(16384), SketchedLeastSquaresEstimator)
 
 
 def test_default_weights_resolve_by_backend():

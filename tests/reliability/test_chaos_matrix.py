@@ -421,6 +421,27 @@ def drive_krr_oom():
     assert _ledger_has("degrade")
 
 
+def drive_sketch_finish_oom():
+    """OOM in the sketched finish's dual ridge falls to the lstsq rung;
+    the streamed fit still completes and predicts."""
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.sketch import SketchedLeastSquaresEstimator
+    from keystone_tpu.workflow.streaming import ChunkStream
+
+    data, labels = _solver_data(n=256, d=24)
+    stream = ChunkStream(data, labels, (), chunk_rows=64)
+    with injected(
+        FaultSpec(match="sketch.finish", kind="oom", calls=(1,))
+    ):
+        model = SketchedLeastSquaresEstimator(
+            reg=1e-3, sketch_size=128
+        ).fit_stream(stream)
+    assert model.degradation["rung"] == "lstsq"
+    assert _ledger_has("degrade")
+    preds = np.asarray(model.apply_arrays(np.asarray(data.data)[:16]))
+    assert preds.shape == (16, K)
+
+
 #: site → driver. The sweep fails when KNOWN_PROBE_SITES grows past it.
 MATRIX = {
     "streaming.chunk": drive_streaming_chunk,
@@ -435,6 +456,7 @@ MATRIX = {
     "LeastSquaresEstimator.solve": drive_least_squares_oom,
     "BlockLeastSquaresEstimator.solve": drive_block_solver_oom,
     "KernelRidgeRegression.solve": drive_krr_oom,
+    "sketch.finish": drive_sketch_finish_oom,
 }
 
 #: drivers that accept a tmp_path for a checkpoint store

@@ -1,0 +1,142 @@
+"""Core sketch-operator identities (docs/SOLVERS.md): both variants are
+linear maps of the rows keyed on ABSOLUTE row indices, so sketching
+block-by-block equals sketching whole, centering is algebraic at finish
+time, and pad rows contribute nothing."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.sketch.core import (
+    MASK_INDEX_EXACT_ROWS,
+    VARIANTS,
+    sketch_rows,
+    sketch_state_bytes,
+    sketch_stream_finish,
+    sketch_stream_init,
+    sketch_stream_step,
+    srht_sample_rows,
+)
+
+pytestmark = pytest.mark.sketch
+
+S, D, K = 64, 24, 3
+
+
+def _rows(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    y = rng.normal(size=(n, K)).astype(np.float32)
+    return x, y
+
+
+def _fold(x, y, variant, seed, chunk, s=S, index_base=0):
+    """Fold (x, y) through the stream step in `chunk`-row pieces whose
+    mask lanes carry the rows' absolute indices (index_base offset)."""
+    import jax.numpy as jnp
+
+    step = sketch_stream_step(variant, seed)
+    carry = sketch_stream_init(s, D, K)
+    for start in range(0, x.shape[0], chunk):
+        stop = min(start + chunk, x.shape[0])
+        mask = jnp.arange(
+            index_base + start + 1, index_base + stop + 1, dtype=jnp.float32
+        )[:, None]
+        carry = step(carry, x[start:stop], y[start:stop], mask)
+    return tuple(np.asarray(c) for c in carry)
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+@pytest.mark.parametrize("chunk", [7, 32, 128])
+def test_chunked_equals_whole(variant, chunk):
+    """Additivity over arbitrary chunk boundaries: the property that lets
+    one carry ride chunking, sharding, merge, and resume unchanged."""
+    x, y = _rows(128)
+    whole = _fold(x, y, variant, seed=5, chunk=128)
+    pieces = _fold(x, y, variant, seed=5, chunk=chunk)
+    for a, b in zip(whole, pieces):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+def test_index_base_shifts_the_map(variant):
+    """The sketch is a function of ABSOLUTE indices: the same rows at a
+    different offset land differently (why resume must ride the durable
+    cursor), while split-at-the-true-offset sums back to the whole."""
+    x, y = _rows(96, seed=1)
+    whole = _fold(x, y, variant, seed=2, chunk=96)
+    shifted = _fold(x, y, variant, seed=2, chunk=96, index_base=96)
+    assert not np.allclose(whole[0], shifted[0])
+    half = 48
+    a = _fold(x[:half], y[:half], variant, seed=2, chunk=half)
+    b = _fold(x[half:], y[half:], variant, seed=2, chunk=half, index_base=half)
+    for w, (pa, pb) in zip(whole, zip(a, b)):
+        np.testing.assert_allclose(w, pa + pb, rtol=0, atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+def test_centering_identity(variant):
+    """S·(A − 1μᵀ) = SA − s1·μᵀ: finish-time centering equals sketching
+    pre-centered rows, no second data pass."""
+    x, y = _rows(80, seed=3)
+    carry = _fold(x, y, variant, seed=0, chunk=80)
+    n = x.shape[0]
+    sa_c, sy_c, mu_a, mu_b = sketch_stream_finish(carry, n)
+    np.testing.assert_allclose(np.asarray(mu_a), x.mean(axis=0), atol=1e-5)
+    centered = _fold(
+        x - x.mean(axis=0), y - y.mean(axis=0), variant, seed=0, chunk=80
+    )
+    np.testing.assert_allclose(np.asarray(sa_c), centered[0], atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sy_c), centered[1], atol=1e-3)
+
+
+@pytest.mark.parametrize("variant", VARIANTS)
+def test_pad_rows_contribute_nothing(variant):
+    """Mask lane 0 marks padding: a padded tail (zero rows, zero mask)
+    leaves every carry leaf untouched — chunk-boundary padding can never
+    leak into the statistics."""
+    import jax.numpy as jnp
+
+    x, y = _rows(40, seed=4)
+    clean = _fold(x, y, variant, seed=9, chunk=40)
+    step = sketch_stream_step(variant, 9)
+    pad = 24
+    xp = np.concatenate([x, np.zeros((pad, D), np.float32)])
+    yp = np.concatenate([y, np.zeros((pad, K), np.float32)])
+    mask = jnp.concatenate(
+        [jnp.arange(1, 41, dtype=jnp.float32), jnp.zeros((pad,), jnp.float32)]
+    )[:, None]
+    padded = step(sketch_stream_init(S, D, K), xp, yp, mask)
+    for a, b in zip(clean, padded):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=0, atol=1e-4)
+
+
+def test_sketch_rows_matches_stream_step():
+    """The in-core block sketcher is the stream step at the same absolute
+    indices — one hashing, two entry points."""
+    x, _ = _rows(48, seed=6)
+    sa, s1 = sketch_rows(x, start_index=16, variant="countsketch", seed=3, s=S)
+    y = np.zeros((48, K), np.float32)
+    carry = _fold(x, y, "countsketch", seed=3, chunk=48, index_base=16)
+    np.testing.assert_allclose(np.asarray(sa), carry[0], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), carry[2], atol=1e-4)
+
+
+def test_unknown_variant_raises():
+    with pytest.raises(ValueError, match="unknown sketch variant"):
+        sketch_stream_step("gaussian", 0)
+
+
+def test_srht_sample_rows_deterministic():
+    """Sampled WH rows regenerate from (s, seed) alone — they are never
+    persisted; resume rebuilds them from the envelope's meta."""
+    a = srht_sample_rows(32, 7)
+    assert a.dtype == np.uint32 and a.shape == (32,)
+    np.testing.assert_array_equal(a, srht_sample_rows(32, 7))
+    assert not np.array_equal(a, srht_sample_rows(32, 8))
+
+
+def test_state_bytes_formula_and_index_cap():
+    assert sketch_state_bytes(256, 8192, 8) == 4 * (
+        256 * 8192 + 256 * 8 + 256 + 8192 + 8
+    )
+    assert MASK_INDEX_EXACT_ROWS == 1 << 24

@@ -1,0 +1,98 @@
+"""The solver ladder's sketched rung: eligibility (width floor), the
+cost crossover, and the resolved-sketch-size pricing — the argmin must
+charge the rung for the s that will actually run, not the width default
+(docs/SOLVERS.md)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+from keystone_tpu.sketch.solvers import SketchedLeastSquaresEstimator
+from keystone_tpu.workflow.optimize import DataStats
+
+pytestmark = pytest.mark.sketch
+
+
+def _pick(n, d, k=8, machines=1, est=None):
+    """The meta-solver's argmin rung for given shape stats (the same
+    path NodeOptimizationRule drives at plan time)."""
+    est = est or LeastSquaresEstimator(reg=1e-3, num_machines=machines)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, d)).astype(np.float32)
+    y = rng.normal(size=(32, k)).astype(np.float32)
+    return est.optimize(
+        [ArrayDataset(x), ArrayDataset(y)],
+        DataStats(n_total=n, num_shards=1, n_per_shard=[n]),
+    )
+
+
+def test_sketched_rung_wins_past_crossover(monkeypatch):
+    """With the env knob pinning a small s, the sketched rung undercuts
+    every Gram/LBFGS rung at the smoke leg's shape (n=4096, d=8192) —
+    the crossover scripts/sketch_smoke.sh rides."""
+    monkeypatch.setenv("KEYSTONE_SKETCH_SIZE", "256")
+    picked = _pick(n=4096, d=8192)
+    assert isinstance(picked, SketchedLeastSquaresEstimator)
+
+
+def test_width_floor_gates_the_rung(monkeypatch):
+    """Below KEYSTONE_SKETCH_MIN_WIDTH the sketched rung prices at inf:
+    even a tiny pinned s must never win at moderate width (the floor IS
+    the eligibility gate, accuracy-motivated)."""
+    monkeypatch.setenv("KEYSTONE_SKETCH_SIZE", "256")
+    picked = _pick(n=4096, d=4096)
+    assert not isinstance(picked, SketchedLeastSquaresEstimator)
+
+
+def test_pricing_uses_resolved_sketch_size(monkeypatch):
+    """The bench leg's regression: at n=2048/d=8192 the width-default
+    s=4096 prices the rung OUT (a Gram/LBFGS rung wins), while the env
+    knob's s=512 prices it IN — so optimize() must resolve s exactly the
+    way the fit will."""
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    default_pick = _pick(n=2048, d=8192, machines=8)
+    assert not isinstance(default_pick, SketchedLeastSquaresEstimator)
+    monkeypatch.setenv("KEYSTONE_SKETCH_SIZE", "512")
+    pinned_pick = _pick(n=2048, d=8192, machines=8)
+    assert isinstance(pinned_pick, SketchedLeastSquaresEstimator)
+
+
+def test_tuned_sketch_size_rides_the_pricing_and_the_pick(monkeypatch):
+    """A MeasuredKnobRule winner (_tuned_sketch_size) must steer the
+    argmin exactly like the env knob AND ride onto the chosen estimator
+    so the fit runs at the priced s."""
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    est = LeastSquaresEstimator(reg=1e-3, num_machines=8)
+    est._tuned_sketch_size = 512
+    picked = _pick(n=2048, d=8192, machines=8, est=est)
+    assert isinstance(picked, SketchedLeastSquaresEstimator)
+    assert picked._resolve_sketch_size(8192) == 512
+
+
+def test_every_candidate_priced_for_explain(monkeypatch):
+    """Losing rungs stay in the provenance with their costs/reasons —
+    `keystone-tpu explain` shows the whole ladder, including WHY the
+    sketched rung lost below the width floor."""
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    picked = _pick(n=100_000, d=1024)
+    pred = picked.predicted_cost
+    names = {name for name, _, _ in pred.candidates}
+    assert {"sparse_lbfgs", "dense_lbfgs", "block", "exact", "sketched"} <= names
+    reason = next(r for name, _, r in pred.candidates if name == "sketched")
+    assert "KEYSTONE_SKETCH_MIN_WIDTH" in reason
+
+
+def test_stream_solver_collapse_by_width(monkeypatch):
+    """Under streaming the meta-choice collapses by width: Gram rungs up
+    to the sketch floor, the sketched rung past it, and a tuned s rides
+    the delegation."""
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    est = LeastSquaresEstimator(reg=1e-3)
+    assert not isinstance(
+        est._stream_solver(4096), SketchedLeastSquaresEstimator
+    )
+    inner = est._stream_solver(8192)
+    assert isinstance(inner, SketchedLeastSquaresEstimator)
+    est._tuned_sketch_size = 384
+    assert est._stream_solver(8192)._resolve_sketch_size(8192) == 384

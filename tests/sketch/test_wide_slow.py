@@ -1,0 +1,76 @@
+"""The tier's headline acceptance: a d ≥ 64k streamed fit holds in a
+memory budget the Gram tier refuses at plan time (docs/SOLVERS.md) —
+the O(s·d) carry vs the O(d²) wall, end to end on real data."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.sketch.core import sketch_state_bytes
+from keystone_tpu.sketch.solvers import SketchedLeastSquaresEstimator
+from keystone_tpu.workflow.streaming import ChunkStream
+
+pytestmark = [pytest.mark.sketch, pytest.mark.slow]
+
+N, D, K, R, S, CHUNK = 1024, 65536, 4, 64, 512, 256
+BUDGET = 1 << 30  # 1 GiB device budget
+
+
+def test_very_wide_streamed_fit_where_gram_refuses(monkeypatch):
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+    from keystone_tpu.workflow.operators import EstimatorOperator
+    from keystone_tpu.workflow.streaming import StreamingFitOperator
+    from keystone_tpu.workflow.verify import verify_graph
+
+    monkeypatch.setenv("KEYSTONE_SKETCH_SIZE", str(S))
+
+    # --- plan level: the Gram tier is refused, the sketched tier fits.
+    def streamed_graph(est):
+        pipe = est.with_data(
+            ArrayDataset(np.zeros((8, D), dtype=np.float32)),
+            ArrayDataset(np.zeros((8, K), dtype=np.float32)),
+        )
+        graph = pipe.graph
+        node = next(
+            n
+            for n in graph.nodes
+            if isinstance(graph.get_operator(n), EstimatorOperator)
+            and not hasattr(graph.get_operator(n), "dataset")
+        )
+        return graph.set_operator(
+            node, StreamingFitOperator(graph.get_operator(node), members=())
+        )
+
+    gram_report = verify_graph(
+        streamed_graph(LinearMapEstimator(reg=1e-3)),
+        device_memory_bytes=BUDGET,
+    )
+    assert gram_report.by_code("KV303"), "Gram tier must refuse d=64k"
+    sketch_report = verify_graph(
+        streamed_graph(SketchedLeastSquaresEstimator(reg=1e-3)),
+        device_memory_bytes=BUDGET,
+    )
+    assert sketch_report.by_code("KV308") == []
+    assert 2 * sketch_state_bytes(S, D, K) < BUDGET
+
+    # --- and the fit actually runs, bounded and accurate: low-effective-
+    # rank rows (the regime the tier is for), train rel err < 5%.
+    rng = np.random.default_rng(11)
+    z = rng.normal(size=(N, R)).astype(np.float32)
+    basis = rng.normal(size=(R, D)).astype(np.float32) / np.sqrt(R)
+    x = (z @ basis + 0.01 * rng.normal(size=(N, D))).astype(np.float32)
+    w = rng.normal(size=(D, K)).astype(np.float32) / np.sqrt(D)
+    y = (x @ w).astype(np.float32)
+
+    est = SketchedLeastSquaresEstimator(reg=1e-4)
+    model = est.fit_stream(
+        ChunkStream(ArrayDataset(x), ArrayDataset(y), (), chunk_rows=CHUNK)
+    )
+    state = est.export_stream_state()
+    assert state.kind == "sketch"
+    carry_bytes = sum(a.nbytes for a in state.carry)
+    assert carry_bytes == sketch_state_bytes(S, D, K)
+
+    preds = np.asarray(model.apply_arrays(x[:CHUNK]))
+    rel = float(np.linalg.norm(preds - y[:CHUNK]) / np.linalg.norm(y[:CHUNK]))
+    assert np.isfinite(preds).all() and rel < 0.05, rel

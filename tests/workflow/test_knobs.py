@@ -417,3 +417,119 @@ print(json.dumps({"chunk_rows": out.get_operator(s).chunk_rows}))
     payload = _json.loads(result.stdout.strip().splitlines()[-1])
     # the stale 1024 winner is skipped in the fresh process too
     assert payload["chunk_rows"] == 256
+
+
+# ------------------------------------------------------------- sketch size
+
+
+def sketch_graph(est=None):
+    """dataset → StreamingFitOperator(meta least-squares) → sink: the
+    shape whose width dispatch may route onto the sketched rung."""
+    from keystone_tpu.ops.learning.least_squares import LeastSquaresEstimator
+
+    data = ArrayDataset(np.ones((N_ROWS, 8), dtype=np.float32))
+    est = est or LeastSquaresEstimator(reg=1e-3)
+    op = StreamingFitOperator(est, ())
+    g = Graph()
+    g, d = g.add_node(DatasetOperator(data), [])
+    g, s = g.add_node(op, [d])
+    g, _ = g.add_sink(s)
+    return g, s, data
+
+
+def record_sketch_obs(st, s=256, wall_s=0.02, rows=N_ROWS, d=8):
+    st.record(f"solver:sketch_ls:bs{s}:precrefine",
+              shape_class(rows, (d,), "float32"),
+              wall_s=wall_s, sketch_size=s, sketch_variant="countsketch")
+
+
+def test_sketch_size_override_in_all_mode(tmp_path, monkeypatch):
+    """The best-wall sketch_ls observation rides onto the meta-solver as
+    _tuned_sketch_size — the width dispatch AND the ladder's pricing
+    both read it (docs/SOLVERS.md)."""
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    from keystone_tpu.parallel import linalg
+
+    st = store(tmp_path)
+    record_sketch_obs(st, s=512, wall_s=0.1)
+    record_sketch_obs(st, s=256, wall_s=0.02)  # best wall wins
+    g, node, _ = sketch_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        tuned = out.get_operator(node).estimator
+        assert tuned._tuned_sketch_size == 256
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_sketch_env_knob_beats_measurement(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.setenv("KEYSTONE_SKETCH_SIZE", "1024")
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    from keystone_tpu.parallel import linalg
+
+    st = store(tmp_path)
+    record_sketch_obs(st)
+    g, node, _ = sketch_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert getattr(
+            out.get_operator(node).estimator, "_tuned_sketch_size", None
+        ) is None
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_constructor_pinned_sketch_size_untouched(tmp_path, monkeypatch):
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    from keystone_tpu.parallel import linalg
+    from keystone_tpu.sketch.solvers import SketchedLeastSquaresEstimator
+
+    st = store(tmp_path)
+    record_sketch_obs(st)
+    g, node, _ = sketch_graph(
+        est=SketchedLeastSquaresEstimator(reg=1e-3, sketch_size=128)
+    )
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert getattr(
+            out.get_operator(node).estimator, "_tuned_sketch_size", None
+        ) is None
+    finally:
+        linalg.set_solver_mode_override(None)
+
+
+def test_disagreeing_widths_block_sketch_override(tmp_path, monkeypatch):
+    """Unanimity across feature widths in the rows bucket, same as the
+    block-size knob: disagreeing widths veto the override and count a
+    non_unanimous rejection."""
+    from keystone_tpu.obs import names as obs_names
+    from keystone_tpu.parallel import linalg
+
+    monkeypatch.setenv("KEYSTONE_MEASURED_KNOBS", "all")
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    monkeypatch.delenv("KEYSTONE_SOLVER_PRECISION", raising=False)
+    monkeypatch.delenv("KEYSTONE_STREAM_CHUNK_ROWS", raising=False)
+    st = store(tmp_path)
+    record_sketch_obs(st, s=256, d=8)
+    record_sketch_obs(st, s=512, d=16)  # another width disagrees
+    counter = obs_names.metric(obs_names.KNOB_REJECTED)
+    before = counter.value(knob="sketch_size", reason="non_unanimous")
+    g, node, _ = sketch_graph()
+    try:
+        out, _ = MeasuredKnobRule(profile_store=st).apply(g, {})
+        assert getattr(
+            out.get_operator(node).estimator, "_tuned_sketch_size", None
+        ) is None
+        assert counter.value(
+            knob="sketch_size", reason="non_unanimous"
+        ) == before + 1
+    finally:
+        linalg.set_solver_mode_override(None)

@@ -296,6 +296,56 @@ def test_gram_infeasibility_is_kv303():
     assert verify_graph(graph, device_memory_bytes=None).by_code("KV303") == []
 
 
+def test_sketch_infeasibility_is_kv308(monkeypatch):
+    """The sketched tier's feasibility is KV308 (ERROR — it is the LAST
+    memory rung, nothing to degrade to) and the dispatch routes sketch-
+    kind fits AWAY from the Gram tier's KV303 warning."""
+    from keystone_tpu.sketch.core import sketch_state_bytes
+    from keystone_tpu.sketch.solvers import SketchedLeastSquaresEstimator
+    from keystone_tpu.workflow.streaming import StreamingFitOperator
+
+    d = 8192
+    x = ArrayDataset(np.zeros((8, d), dtype=np.float32))
+    y = ArrayDataset(np.zeros((8, 4), dtype=np.float32))
+
+    def sketch_graph():
+        pipe = SketchedLeastSquaresEstimator(reg=1e-3).with_data(x, y)
+        graph = pipe.graph
+        est_node = next(
+            n
+            for n in graph.nodes
+            if isinstance(graph.get_operator(n), EstimatorOperator)
+            and not hasattr(graph.get_operator(n), "dataset")
+        )
+        return graph.set_operator(
+            est_node,
+            StreamingFitOperator(graph.get_operator(est_node), members=()),
+        )
+
+    # Conditioning floor: checked on ANY device (no budget needed).
+    monkeypatch.setenv("KEYSTONE_SKETCH_SIZE", "4")
+    report = verify_graph(sketch_graph(), device_memory_bytes=None)
+    kv308 = report.by_code("KV308")
+    assert len(kv308) == 1 and kv308[0].severity == ERROR
+    assert kv308[0].details["floor"] == max(32, 4 * (4 + 1))
+
+    # Memory: 2× the O(s·d) carry vs the budget — and the sketch-kind
+    # dispatch must NOT also warn KV303 (that is the Gram tier's check).
+    monkeypatch.delenv("KEYSTONE_SKETCH_SIZE", raising=False)
+    report = verify_graph(sketch_graph(), device_memory_bytes=1_000_000)
+    kv308 = report.by_code("KV308")
+    assert len(kv308) == 1
+    assert kv308[0].details["state_bytes"] == 2 * sketch_state_bytes(
+        4096, d, 4
+    )
+    assert report.by_code("KV303") == []
+
+    # Feasible sketch plan: a budget the carry fits leaves no diagnostic.
+    assert verify_graph(
+        sketch_graph(), device_memory_bytes=1 << 30
+    ).by_code("KV308") == []
+
+
 def test_cycle_is_kv401_and_linearize_raises():
     pipe = Scale(2.0).to_pipeline().then(Scale(3.0)).then(Scale(4.0))
     graph = pipe.graph
