@@ -1189,6 +1189,23 @@ def _bench_serving_multiworker(small: bool) -> dict:
         out["two_worker_kill_rps"] / max(out["one_worker_rps"], 1e-9), 2
     )
 
+    # Quality plane (docs/OBSERVABILITY.md "Quality plane"): the fleet-
+    # merged view from the chaos sweep's worker heartbeat sketch deltas.
+    # Rows/bytes are evidence, not gates (the kill loses the dead
+    # incarnation's un-shipped delta); the DECISION count is exact-gated
+    # by bench-diff — a pure serving sweep must decide nothing.
+    quality = stats.get("quality") or {}
+    sketch = (
+        quality.get("models", {}).get("default", {}).get("sketch") or {}
+    )
+    out["quality"] = {
+        "streams_tracked": len(quality.get("models", {})),
+        "sketch_rows": sketch.get("rows", 0),
+        "quality_sketch_bytes": sketch.get("bytes", 0),
+        "sketch_merges": quality.get("sketch_merges", 0),
+        "quality_decisions": len(quality.get("decisions", [])),
+    }
+
     # Leg 3 — fleet-tracing overhead (docs/OBSERVABILITY.md budget:
     # ≤5%). Same 2-worker synthetic fleet as the sweeps above, no
     # chaos: one fleet with fleet tracing OFF, one with it ON (worker
@@ -1508,6 +1525,13 @@ def _bench_refit(small: bool) -> dict:
         serve_requests=96 if small else 384,
         chunk_rows=256 if small else 1024,
         seed=0,
+        # Quality plane (docs/OBSERVABILITY.md): every watch window runs
+        # the anytime-valid sequential gate and the drift detector steers
+        # state_decay; outcome counts are unchanged vs the margin gate
+        # (same seeded loop), and the leg's quality block records the
+        # decision trail bench-diff exact-gates (quality_decisions).
+        watch_gate="sequential",
+        adaptive_decay=True,
     )
     out = run_refit_demo(config)
     # The per-round detail is smoke-log material, not a gated artifact;

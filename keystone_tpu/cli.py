@@ -123,6 +123,18 @@ def add_refit_arguments(parser: argparse.ArgumentParser) -> None:
         help="checkpoint-store directory for the stream state "
         "(default: a fresh temp dir)",
     )
+    parser.add_argument(
+        "--watch-gate", choices=("margin", "sequential"),
+        default="margin", dest="watch_gate",
+        help="post-publish watch rule: fixed margin floor, or the "
+        "anytime-valid sequential gate (docs/OBSERVABILITY.md "
+        "\"Quality plane\")",
+    )
+    parser.add_argument(
+        "--adaptive-decay", action="store_true", dest="adaptive_decay",
+        help="let the quality plane's drift detector shrink state_decay "
+        "under detected score drift",
+    )
 
 
 def add_fit_arguments(parser: argparse.ArgumentParser) -> None:
@@ -477,6 +489,20 @@ def main(argv: Optional[list] = None) -> int:
     )
     add_tune_arguments(tune_parser)
 
+    # Quality plane (docs/OBSERVABILITY.md "Quality plane"): the
+    # operator-facing report over score streams, drift state, and
+    # anytime-valid decision gates — run on a deterministic seeded
+    # scenario so scripts/quality_smoke.sh can assert its decisions.
+    # Stdlib-only flag wiring AND dispatch (the plane itself is jax-free).
+    from .obs.quality_cli import add_quality_arguments
+
+    quality_parser = sub.add_parser(
+        "quality",
+        help="quality-plane report: score streams, drift state, open "
+        "sequential tests, decisions with evidence",
+    )
+    add_quality_arguments(quality_parser)
+
     # Continuous refit (docs/REFIT.md): the drifting-workload closed
     # loop — serve, tap, incremental fold, shadow-eval, publish, watch,
     # auto-rollback — with a final REFIT_STATS: JSON line the chaos
@@ -527,6 +553,10 @@ def main(argv: Optional[list] = None) -> int:
         print(
             f"{'tune':28s} offline autotuner: measured knob search → "
             "profile-store winners"
+        )
+        print(
+            f"{'quality':28s} quality-plane report: score streams, drift "
+            "state, anytime-valid decision gates"
         )
         print(
             f"{'refit':28s} continuous-refit loop: incremental retrain + "
@@ -581,6 +611,11 @@ def main(argv: Optional[list] = None) -> int:
 
         enable_persistent_cache()  # measured runs warm the same cache
         return tune_from_args(args)
+
+    if args.workload == "quality":
+        from .obs.quality_cli import quality_from_args
+
+        return quality_from_args(args)
 
     if args.workload == "refit":
         from .refit.daemon import refit_from_args

@@ -72,6 +72,13 @@ _EXACT_SUBSTRINGS = (
     # observatory"): harvesting rides the jit trace cache and must
     # compile NOTHING — any nonzero count is a broken harvest path.
     "harvest_compiles",
+    # Quality-plane invariant (docs/OBSERVABILITY.md "Quality plane"):
+    # the sequential gate's decision count is deterministic in the
+    # seeded loop — a pure serving sweep decides nothing, the refit
+    # demo decides exactly its seeded rounds. (quality_sketch_bytes
+    # stays under the skip list's generic "bytes" — heartbeat timing
+    # shapes what a killed worker managed to ship.)
+    "quality_decisions",
     # Sketched-tier invariant (docs/SOLVERS.md): the sketch/Gram state
     # footprints are pure functions of (s, d, k) — a changed byte count
     # is a changed state layout, not noise. (Matched before the skip

@@ -42,6 +42,64 @@ _STREAM_COMPUTE_TID = 900002
 # Synthetic track for the cost observatory's per-node counters.
 _COST_LEDGER_TID = 900003
 
+# Synthetic track for the quality plane's drift/gate event stream.
+_QUALITY_TID = 900004
+
+
+def quality_events(
+    entries: Any, base_unix: float, pid: int
+) -> List[Dict[str, Any]]:
+    """Quality-plane ring entries (obs/flight.py ``quality`` ring) as a
+    Chrome ``quality`` track: drift scores and gate likelihood ratios as
+    ``ph:C`` counter samples, plus one instant event per drift firing /
+    gate decision so the moment a model went bad is findable next to the
+    serving spans. Ring entries carry ``unix`` stamps; ``base_unix`` is
+    the session's wall-clock origin (``TraceSession.started_unix``)."""
+    events: List[Dict[str, Any]] = []
+    for entry in entries or []:
+        ts = round((float(entry.get("unix", base_unix)) - base_unix) * 1e6, 3)
+        kind = entry.get("kind")
+        counters: Dict[str, Any] = {}
+        if kind == "drift" and entry.get("score") is not None:
+            counters["drift_score"] = round(float(entry["score"]), 4)
+        elif kind == "gate_decision" and entry.get("lr") is not None:
+            counters["gate_lr"] = round(float(entry["lr"]), 4)
+        if counters:
+            events.append(
+                {
+                    "name": "quality",
+                    "cat": "quality",
+                    "ph": "C",
+                    "ts": ts,
+                    "pid": pid,
+                    "tid": _QUALITY_TID,
+                    "args": counters,
+                }
+            )
+        label = kind or "quality"
+        if kind == "gate_decision":
+            label = "gate:%s" % entry.get("decision", "?")
+        elif kind == "drift":
+            label = "drift:%s" % entry.get("model", "?")
+        events.append(
+            {
+                "name": label,
+                "cat": "quality",
+                "ph": "i",
+                "s": "t",
+                "ts": ts,
+                "pid": pid,
+                "tid": _QUALITY_TID,
+                "args": {k: _json_safe(v) for k, v in entry.items()},
+            }
+        )
+    if events:
+        events.append(
+            {"name": "thread_name", "ph": "M", "pid": pid,
+             "tid": _QUALITY_TID, "args": {"name": "quality"}}
+        )
+    return events
+
 
 def cost_ledger_events(
     entries: Any, base_s: float, pid: int
@@ -142,12 +200,16 @@ def chrome_trace(
     session: TraceSession,
     stream_report: Any = None,
     cost_ledger: Any = None,
+    quality_ring: Any = None,
 ) -> Dict[str, Any]:
     """The session's spans as a Chrome trace-event JSON object; pass the
     last :class:`~keystone_tpu.workflow.streaming.StreamReport` to also
     emit its per-chunk upload/compute slices (:func:`stream_report_events`),
-    and a list of perf-ledger entries (``obs.cost.get_ledger().tail(n)``)
-    for the ``cost-ledger`` counter track (:func:`cost_ledger_events`)."""
+    a list of perf-ledger entries (``obs.cost.get_ledger().tail(n)``)
+    for the ``cost-ledger`` counter track (:func:`cost_ledger_events`),
+    and the flight recorder's quality ring
+    (``get_flight_recorder().quality_ring()``) for the ``quality``
+    drift/gate track (:func:`quality_events`)."""
     import os
 
     pid = os.getpid()
@@ -203,6 +265,7 @@ def chrome_trace(
         )
     events.extend(stream_report_events(stream_report, session.started_s, pid))
     events.extend(cost_ledger_events(cost_ledger, session.started_s, pid))
+    events.extend(quality_events(quality_ring, session.started_unix, pid))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -220,11 +283,13 @@ def write_chrome_trace(
     path: str,
     stream_report: Any = None,
     cost_ledger: Any = None,
+    quality_ring: Any = None,
 ) -> str:
     with open(path, "w") as f:
         json.dump(
             chrome_trace(
-                session, stream_report=stream_report, cost_ledger=cost_ledger
+                session, stream_report=stream_report, cost_ledger=cost_ledger,
+                quality_ring=quality_ring,
             ),
             f,
         )

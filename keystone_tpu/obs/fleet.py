@@ -353,6 +353,11 @@ def publish_fleet_metrics(supervisor: Any) -> None:
         gauge = _names.metric(_names.FLEET_WORKER_SERIES)
         for series, value in collector.metric_totals().items():
             gauge.set(round(value, 6), series=series)
+    # Quality plane: the supervisor's fleet-merged sketch/stream state
+    # surfaces as keystone_quality_* gauges on the same scrape.
+    quality = getattr(supervisor, "quality", None)
+    if quality is not None:
+        quality.publish_metrics()
 
 
 def fleet_prometheus_text(supervisor: Any) -> str:

@@ -19,6 +19,11 @@ trigger fires:
   down (the latency objective was violated; capture why).
 - ``refit_rollback`` — the post-publish watch window rolled a candidate
   back.
+- ``quality_drift`` / ``quality_rollback`` — the quality plane detected
+  an input/score drift, or its sequential gate decided ``rollback``
+  (docs/OBSERVABILITY.md "Quality plane"). Quality events live in their
+  own ``quality`` ring so the dump separates statistical evidence from
+  the recovery ledger.
 
 Triggers ride the recovery ledger: :func:`observe_ledger` is called by
 ``RecoveryLog.record`` for every event (a single global read when no
@@ -32,6 +37,7 @@ Artifact schema (one JSON object)::
      "written_unix": ..., "detail": {...},
      "spans": [<fleet span fragments, absolute-unix times>],
      "ledger": [{"kind", "label", "unix", ...detail}],
+     "quality": [{"kind", "unix", ...evidence}],
      "metric_snapshots": [{"unix", "metrics": {...}}],
      "metrics": {<full registry snapshot at dump time>},
      "marks": [{"label", "unix", ...}], "dropped_spans": N}
@@ -96,6 +102,7 @@ class FlightRecorder:
         self.metrics_interval_s = metrics_interval_s
         self._lock = threading.Lock()
         self._ledger: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
+        self._quality: "deque[Dict[str, Any]]" = deque(maxlen=128)
         self._marks: "deque[Dict[str, Any]]" = deque(maxlen=64)
         self._metric_ring: "deque[Dict[str, Any]]" = deque(maxlen=8)
         self._last_metrics_at = -float("inf")
@@ -122,6 +129,30 @@ class FlightRecorder:
             trigger = "slo_degrade"
         if trigger is not None:
             self.dump(trigger, detail={"kind": kind, "label": label})
+
+    def observe_quality(self, event: Dict[str, Any]) -> None:
+        """Append a quality-plane event (drift firing, gate decision) to
+        the ``quality`` ring; a ``drift`` event or a ``rollback`` gate
+        decision is a post-mortem moment and dumps immediately."""
+        entry = {"unix": round(time.time(), 6), **_json_safe_detail(event)}
+        with self._lock:
+            self._quality.append(entry)
+        self._m_records.inc(kind="quality")
+        kind = event.get("kind")
+        if kind == "drift":
+            self.dump("quality_drift",
+                      detail={"kind": "quality_drift",
+                              "model": event.get("model", "")})
+        elif kind == "gate_decision" and event.get("decision") == "rollback":
+            self.dump("quality_rollback",
+                      detail={"kind": "quality_rollback",
+                              "model": event.get("model", "")})
+
+    def quality_ring(self) -> List[Dict[str, Any]]:
+        """A copy of the quality ring — the Perfetto exporter's
+        ``quality`` track source (obs/export.py quality_events)."""
+        with self._lock:
+            return list(self._quality)
 
     def mark(self, label: str, **data: Any) -> None:
         """Append a caller-defined waypoint (heartbeat seq, round index)
@@ -174,6 +205,7 @@ class FlightRecorder:
                 return None
             self._last_dump_at[trigger] = now
             ledger = list(self._ledger)
+            quality = list(self._quality)
             marks = list(self._marks)
             metric_ring = list(self._metric_ring)
         session = _spans.active_session()
@@ -208,6 +240,7 @@ class FlightRecorder:
             "detail": _json_safe_detail(detail or {}),
             "spans": span_tail,
             "ledger": ledger,
+            "quality": quality,
             "perf_ledger": perf_ledger,
             "metric_snapshots": metric_ring,
             "metrics": get_registry().snapshot(),

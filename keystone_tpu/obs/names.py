@@ -178,6 +178,22 @@ COST_DRIFT_RATIO = "keystone_cost_drift_ratio"
 COST_HARVEST_COMPILES = "keystone_cost_harvest_compiles_total"
 COST_ROOFLINE_PEAK = "keystone_cost_roofline_peak"
 
+# --------------------------------------------------------------- quality plane
+QUALITY_SCORES = "keystone_quality_scores_total"
+QUALITY_SCORE_MEAN = "keystone_quality_score_mean"
+QUALITY_SCORE_QUANTILE = "keystone_quality_score_quantile"
+QUALITY_LABEL_JOINS = "keystone_quality_label_joins_total"
+QUALITY_JOIN_LAG_ROWS = "keystone_quality_join_lag_rows"
+QUALITY_SKETCH_ROWS = "keystone_quality_sketch_rows"
+QUALITY_SKETCH_BYTES = "keystone_quality_sketch_bytes"
+QUALITY_SKETCH_MERGES = "keystone_quality_sketch_merges_total"
+QUALITY_DRIFT_EVENTS = "keystone_quality_drift_events_total"
+QUALITY_DRIFT_SCORE = "keystone_quality_drift_score"
+QUALITY_STATE_DECAY = "keystone_quality_state_decay"
+QUALITY_GATE_DECISIONS = "keystone_quality_gate_decisions_total"
+QUALITY_GATE_OPEN = "keystone_quality_gate_open"
+QUALITY_GATE_SAMPLES = "keystone_quality_gate_samples"
+
 # ---------------------------------------------------------------------- memory
 MEMORY_IN_USE_BYTES = "keystone_memory_in_use_bytes"
 PEAK_MEMORY_BYTES = "keystone_peak_memory_bytes"
@@ -251,17 +267,17 @@ SCHEMA: Dict[str, Tuple] = {
     VERIFY_SECONDS: ("histogram", "Whole-graph verification passes", ()),
     VERIFY_LINT_FINDINGS: ("counter", "keystone-lint findings", ("rule",)),
     XLA_COMPILES: ("counter", "Backend XLA compiles observed by jax.monitoring", ()),
-    SERVING_REQUESTS: ("counter", "Requests served to completion", ()),
-    SERVING_BATCHES: ("counter", "Micro-batches dispatched", ()),
-    SERVING_SHEDS: ("counter", "Requests shed by admission control", ()),
-    SERVING_TIMEOUTS: ("counter", "Requests expired before batch assembly", ()),
-    SERVING_RETRIES: ("counter", "Apply-path retry attempts", ()),
-    SERVING_FAILURES: ("counter", "Requests failed by apply errors", ()),
-    SERVING_BUCKET_HITS: ("counter", "Batches padded onto an already-warm bucket", ()),
-    SERVING_BUCKET_COMPILES: ("counter", "First batches at a cold bucket", ()),
-    SERVING_LATENCY_SECONDS: ("histogram", "End-to-end request latency", ()),
-    SERVING_QUEUE_WAIT_SECONDS: ("histogram", "Submit-to-apply queue wait", ()),
-    SERVING_BATCH_OCCUPANCY: ("histogram", "Batch size / max_batch", (), "ratio"),
+    SERVING_REQUESTS: ("counter", "Requests served to completion", ("model",)),
+    SERVING_BATCHES: ("counter", "Micro-batches dispatched", ("model",)),
+    SERVING_SHEDS: ("counter", "Requests shed by admission control", ("model",)),
+    SERVING_TIMEOUTS: ("counter", "Requests expired before batch assembly", ("model",)),
+    SERVING_RETRIES: ("counter", "Apply-path retry attempts", ("model",)),
+    SERVING_FAILURES: ("counter", "Requests failed by apply errors", ("model",)),
+    SERVING_BUCKET_HITS: ("counter", "Batches padded onto an already-warm bucket", ("model",)),
+    SERVING_BUCKET_COMPILES: ("counter", "First batches at a cold bucket", ("model",)),
+    SERVING_LATENCY_SECONDS: ("histogram", "End-to-end request latency", ("model",)),
+    SERVING_QUEUE_WAIT_SECONDS: ("histogram", "Submit-to-apply queue wait", ("model",)),
+    SERVING_BATCH_OCCUPANCY: ("histogram", "Batch size / max_batch", ("model",), "ratio"),
     SERVING_WORKER_RESTARTS: ("counter", "Worker processes restarted by the supervisor", ("reason",)),
     SERVING_WORKER_REQUEUED: ("counter", "In-flight requests requeued off a dead worker", ()),
     SERVING_WORKERS_ALIVE: ("gauge", "Worker processes currently serving", ()),
@@ -296,9 +312,23 @@ SCHEMA: Dict[str, Tuple] = {
     COST_DRIFT_RATIO: ("gauge", "Latest measured-vs-predicted cost ratio observed per model (>1 = slower than predicted)", ("model",)),
     COST_HARVEST_COMPILES: ("counter", "Backend compiles triggered by cost harvesting — must stay 0 (harvest rides the jit trace cache)", ()),
     COST_ROOFLINE_PEAK: ("gauge", "Probe-calibrated roofline peaks for this process's backend, by resource (flops_per_s/bytes_per_s)", ("resource",)),
-    FLIGHT_RECORDS: ("counter", "Entries appended to the flight-recorder ring buffers, by kind (ledger/metrics/mark)", ("kind",)),
+    FLIGHT_RECORDS: ("counter", "Entries appended to the flight-recorder ring buffers, by kind (ledger/metrics/mark/quality)", ("kind",)),
     FLIGHT_DUMPS: ("counter", "Flight-recorder dump artifacts written, by trigger", ("trigger",)),
     FLIGHT_DUMP_BYTES: ("gauge", "Size of the last flight-recorder dump artifact written by this process", ()),
+    QUALITY_SCORES: ("counter", "Prediction scores observed by the quality plane, per model and stream role (live/labeled/candidate/incumbent)", ("model", "role")),
+    QUALITY_SCORE_MEAN: ("gauge", "Running mean of a model's score stream, per role", ("model", "role")),
+    QUALITY_SCORE_QUANTILE: ("gauge", "P² quantile markers of a model's score stream (p10/p50/p90), per role", ("model", "role", "q")),
+    QUALITY_LABEL_JOINS: ("counter", "Delayed labels joined against served predictions into the labeled score stream (exactly-once via the refit journal)", ("model",)),
+    QUALITY_JOIN_LAG_ROWS: ("gauge", "Labeled rows buffered in the tap awaiting the next refit round's label join", ("model",)),
+    QUALITY_SKETCH_ROWS: ("gauge", "Payload rows folded into the fleet-merged input-distribution sketch", ("model",)),
+    QUALITY_SKETCH_BYTES: ("gauge", "Serialized size of the fleet-merged quality sketch (the bounded-memory contract)", ("model",)),
+    QUALITY_SKETCH_MERGES: ("counter", "Worker heartbeat sketch deltas merged fleet-wide, per shipping role", ("role",)),
+    QUALITY_DRIFT_EVENTS: ("counter", "Drift events fired by the quality drift detector (edge-triggered threshold crossings)", ("model",)),
+    QUALITY_DRIFT_SCORE: ("gauge", "Latest standardized score-shift vs the frozen baseline window, in baseline standard deviations", ("model",)),
+    QUALITY_STATE_DECAY: ("gauge", "Effective refit state_decay chosen adaptively from the drift score", ("model",)),
+    QUALITY_GATE_DECISIONS: ("counter", "Sequential-gate decisions emitted, by model and decision (promote/rollback)", ("model", "decision")),
+    QUALITY_GATE_OPEN: ("gauge", "Sequential tests currently open (still sampling)", ()),
+    QUALITY_GATE_SAMPLES: ("gauge", "Samples consumed so far by a model's open sequential gate", ("model",)),
     MEMORY_IN_USE_BYTES: ("gauge", "Current memory in use", ("source", "device")),
     PEAK_MEMORY_BYTES: ("gauge", "Peak memory observed, attributed per stage", ("stage", "device")),
 }

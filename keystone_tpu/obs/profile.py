@@ -195,10 +195,14 @@ def run_profile(
 
     from ..workflow.streaming import last_stream_report
 
+    from .flight import get_flight_recorder
+
+    recorder = get_flight_recorder()
     trace_path = export.write_chrome_trace(
         session, os.path.join(out_dir, "profile_trace.json"),
         stream_report=last_stream_report(),
         cost_ledger=_cost.get_ledger().tail(_cost.get_ledger().capacity),
+        quality_ring=recorder.quality_ring() if recorder is not None else None,
     )
     prom_path = export.write_prometheus(
         os.path.join(out_dir, "profile_metrics.prom"), registry

@@ -58,10 +58,11 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..envknobs import env_float, env_int
+from ..envknobs import env_flag, env_float, env_int, env_str
 from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs.flight import install_flight_recorder
+from ..obs.quality import get_quality_plane
 from ..reliability import faultinject
 from ..reliability.faultinject import probe
 from ..reliability.recovery import get_recovery_log
@@ -105,6 +106,35 @@ class RefitConfig:
     #: (KEYSTONE_REFIT_WATCH_MARGIN).
     watch_margin: float = field(
         default_factory=lambda: env_float("KEYSTONE_REFIT_WATCH_MARGIN", 0.05)
+    )
+    #: watch rule (KEYSTONE_REFIT_WATCH_GATE): ``margin`` is the fixed
+    #: floor above; ``sequential`` feeds per-row live-vs-incumbent scores
+    #: into an anytime-valid mSPRT (obs/quality.py SequentialGate) and
+    #: rolls back only on a statistically significant regression at
+    #: ``gate_alpha`` — an undecided gate at window end promotes on
+    #: budget, exactly like the fixed window expiring clean.
+    watch_gate: str = field(
+        default_factory=lambda: env_str("KEYSTONE_REFIT_WATCH_GATE", "margin")
+    )
+    #: sequential-watch false-positive bound (KEYSTONE_REFIT_GATE_ALPHA).
+    gate_alpha: float = field(
+        default_factory=lambda: env_float("KEYSTONE_REFIT_GATE_ALPHA", 0.05)
+    )
+    #: sequential-watch sample budget, capped at the watch rows available
+    #: (KEYSTONE_REFIT_GATE_MAX_SAMPLES; candidate+baseline both count).
+    gate_max_samples: int = field(
+        default_factory=lambda: env_int(
+            "KEYSTONE_REFIT_GATE_MAX_SAMPLES", 512
+        )
+    )
+    #: let the quality plane's drift detector shrink ``state_decay``
+    #: toward its floor under detected score drift, so the fold forgets
+    #: the stale distribution faster (KEYSTONE_REFIT_ADAPTIVE_DECAY;
+    #: docs/OBSERVABILITY.md "Quality plane").
+    adaptive_decay: bool = field(
+        default_factory=lambda: env_flag(
+            "KEYSTONE_REFIT_ADAPTIVE_DECAY", False
+        )
     )
     #: watch gate: post-publish serving p99 above this rolls back
     #: (None = score-only watch).
@@ -160,6 +190,23 @@ class RefitDaemon:
         if self._state is None and store is not None:
             self._state = load_stream_state(store, self.config.state_key)
         self._rounds = 0
+        #: decay the last fold actually applied (== config.state_decay
+        #: unless adaptive_decay let the drift detector shrink it).
+        self.applied_decay: float = self.config.state_decay
+        #: join token of the last watch window whose label join was
+        #: persisted — a journal replay with a matching token skips the
+        #: re-join (exactly-once across kills; docs/OBSERVABILITY.md
+        #: "Quality plane").
+        self._joined_token: Optional[str] = None
+        if store is not None:
+            from ..reliability.checkpoint import _MISS
+
+            saved = store.lookup(None, digest=self._quality_state_key())
+            if saved is not _MISS and isinstance(saved, dict):
+                get_quality_plane().restore(
+                    self.config.name, saved.get("state")
+                )
+                self._joined_token = saved.get("token")
         # Always-on flight recorder (idempotent): a watch-window
         # rollback's ledger event dumps this process's post-mortem.
         install_flight_recorder("refit")
@@ -261,6 +308,13 @@ class RefitDaemon:
             f"keystone-refit-journal:{self.config.name}".encode()
         ).hexdigest()
 
+    def _quality_state_key(self) -> str:
+        import hashlib
+
+        return hashlib.sha1(
+            f"keystone-refit-quality:{self.config.name}".encode()
+        ).hexdigest()
+
     def _save_journal(self, payload: Dict[str, Any]) -> None:
         if self.store is not None:
             self.store.save(None, payload, digest=self._journal_key())
@@ -301,11 +355,13 @@ class RefitDaemon:
             journal["x"], journal["y"], round_index,
             skip_fold=(phase == "folded"),
             attempts=int(journal.get("attempts", 0)),
+            token=journal.get("token"),
         )
 
     def _round_body(
         self, x: np.ndarray, y: np.ndarray, round_index: int,
         skip_fold: bool = False, attempts: int = 0,
+        token: Optional[str] = None,
     ) -> str:
         n = x.shape[0]
         eval_n = max(min(int(n * self.config.eval_fraction), n - 1), 1)
@@ -319,6 +375,13 @@ class RefitDaemon:
         # holds a byte-identical payload (saved with the bumped counter
         # moments ago), so only fresh rounds pay the drained-batch write.
         if not skip_fold and self.store is not None and attempts == 0:
+            # The token identifies THIS drained batch across replays: the
+            # quality-plane label join commits it with its state, so a
+            # replayed batch whose join already persisted is not joined
+            # twice (see _observe_quality).
+            import os as _os
+
+            token = _os.urandom(8).hex()
             self._save_journal(
                 {
                     "phase": "drained",
@@ -327,6 +390,7 @@ class RefitDaemon:
                     "y": y,
                     "state_before": self._state,
                     "attempts": attempts,
+                    "token": token,
                 }
             )
 
@@ -352,6 +416,7 @@ class RefitDaemon:
                             "x": x,
                             "y": y,
                             "attempts": attempts,
+                            "token": token,
                         }
                     )
             fold_s = time.perf_counter() - t_fold
@@ -391,14 +456,30 @@ class RefitDaemon:
             # spot is exactly how a bad candidate reaches traffic) and
             # the watch window below must catch it.
             candidate = injector.wrap("refit.candidate", lambda: candidate)()
+        # The sequential watch needs the INCUMBENT's per-row scores on
+        # the watch slice, and the incumbent stops being reachable the
+        # moment the publish below swaps it out — score it here.
+        incumbent_rows = None
+        if self.config.watch_gate == "sequential":
+            from .shadow import _predict
+
+            try:
+                incumbent_rows = self.shadow.score_rows(
+                    _predict(incumbent, eval_x), eval_y
+                )
+            except Exception:
+                incumbent_rows = None  # falls back to the margin rule
         with _spans.span("refit:publish", round=round_index):
             ticket = self.publisher.publish(candidate, round_index=round_index)
-        outcome = self._watch(ticket, report, eval_x, eval_y, round_index)
+        outcome = self._watch(
+            ticket, report, eval_x, eval_y, round_index,
+            incumbent_rows=incumbent_rows, token=token,
+        )
         if hasattr(self.publisher, "settle"):
             self.publisher.settle()
         return self._outcome(
             outcome, round_index, fold_s=fold_s, shadow=report.to_json(),
-            version=ticket.version,
+            version=ticket.version, state_decay=round(self.applied_decay, 4),
         )
 
     def _fold(self, train_x: np.ndarray, train_y: np.ndarray):
@@ -415,12 +496,23 @@ class RefitDaemon:
             partition=self.partition,
         )
         state = self._state
-        if state is not None and self.config.state_decay < 1.0:
-            state = state.scaled(self.config.state_decay)
+        decay = self.config.state_decay
+        if self.config.adaptive_decay:
+            # Quiet traffic keeps the configured decay; detected drift
+            # shrinks it toward the detector's floor so the fold weights
+            # the CURRENT distribution over the stale history.
+            decay = get_quality_plane().suggested_decay(
+                self.config.name, base=decay
+            )
+        self.applied_decay = decay
+        if state is not None and decay < 1.0:
+            state = state.scaled(decay)
         return self.estimator.fit_stream(stream, state=state)
 
     def _watch(
-        self, ticket, shadow_report, watch_x, watch_y, round_index: int
+        self, ticket, shadow_report, watch_x, watch_y, round_index: int,
+        incumbent_rows: Optional[np.ndarray] = None,
+        token: Optional[str] = None,
     ) -> str:
         """Post-publish watch window, on its OWN thread: it scores live
         traffic, which is the shape a future non-blocking watch (running
@@ -439,7 +531,8 @@ class RefitDaemon:
                     version=str(ticket.version),
                 ) as watch_span:
                     box["outcome"] = self._watch_inner(
-                        ticket, shadow_report, watch_x, watch_y
+                        ticket, shadow_report, watch_x, watch_y,
+                        incumbent_rows=incumbent_rows, token=token,
                     )
                     watch_span.set_attribute("outcome", box["outcome"])
             except BaseException as exc:  # re-raised on the round thread
@@ -454,18 +547,34 @@ class RefitDaemon:
             raise box["error"]
         return box["outcome"]
 
-    def _watch_inner(self, ticket, shadow_report, watch_x, watch_y) -> str:
+    def _watch_inner(
+        self, ticket, shadow_report, watch_x, watch_y,
+        incumbent_rows: Optional[np.ndarray] = None,
+        token: Optional[str] = None,
+    ) -> str:
         reason = None
         live_score = None
+        live_rows = None
         try:
             live_pred = self.publisher.apply_live(watch_x)
             live_score = self.shadow.score_predictions(live_pred, watch_y)
+            live_rows = self.shadow.score_rows(live_pred, watch_y)
             self._m_score.set(live_score, role="live")
         except Exception as exc:
             # The published version cannot even answer — that IS the
             # regression, not an excuse to skip the watch.
             reason = f"live apply failed: {type(exc).__name__}: {exc}"
-        if reason is None and live_score is not None:
+        if live_rows is not None:
+            self._observe_quality(live_rows, token)
+        if (
+            reason is None
+            and self.config.watch_gate == "sequential"
+            and live_rows is not None
+            and incumbent_rows is not None
+            and len(live_rows) >= 2
+        ):
+            reason = self._sequential_watch(live_rows, incumbent_rows)
+        elif reason is None and live_score is not None:
             floor = shadow_report.incumbent_score - self.config.watch_margin
             if live_score < floor:
                 reason = (
@@ -485,10 +594,102 @@ class RefitDaemon:
         self.publisher.rollback(ticket, reason=reason)
         return "rolled_back"
 
+    # ------------------------------------------------------- quality plane
+    #
+    # The watch window's per-row live scores ARE the delayed-label join:
+    # the rows carry labels (the tap's held-back slice), and scoring the
+    # live serve path on them is exactly the "labeled accuracy stream"
+    # the quality plane tracks (docs/OBSERVABILITY.md "Quality plane").
+    # The join commits with the round — _persist_quality runs before the
+    # journal clears, and a replayed batch whose token already persisted
+    # is skipped — so a kill anywhere mid-round joins exactly once.
+
+    def _observe_quality(
+        self, live_rows: np.ndarray, token: Optional[str]
+    ) -> None:
+        if token is not None and token == self._joined_token:
+            return  # replayed batch: this join already committed
+        plane = get_quality_plane()
+        model = self.config.name
+        scores = [float(s) for s in live_rows]
+        detector = plane.drift(model)
+        for score in scores:
+            plane.observe_score(model, score, role="live")
+        if detector.baseline is None:
+            # First watch window: adopt it as the drift reference.
+            detector.freeze_baseline()
+        else:
+            plane.check_drift(model)
+        plane.join_labels(model, scores)
+        self._joined_token = token
+
+    def _sequential_watch(
+        self, live_rows: np.ndarray, incumbent_rows: np.ndarray
+    ) -> Optional[str]:
+        """Anytime-valid watch verdict: per-row live-vs-incumbent scores
+        feed a quality-plane SequentialGate pairwise; the gate may stop
+        the moment significance is reached. Returns the rollback reason,
+        or None (promoted — by evidence or by exhausted budget)."""
+        from ..obs.quality import quality_min_samples
+
+        plane = get_quality_plane()
+        budget = min(
+            2 * min(len(live_rows), len(incumbent_rows)),
+            self.config.gate_max_samples,
+        )
+        gate = plane.open_gate(
+            self.config.name,
+            kind="refit_watch",
+            alpha=self.config.gate_alpha,
+            min_samples=min(quality_min_samples(), budget),
+            max_samples=budget,
+        )
+        verdict = "continue"
+        for cand, base in zip(live_rows, incumbent_rows):
+            verdict = gate.observe(candidate=float(cand), baseline=float(base))
+            if verdict != "continue":
+                break
+        if verdict == "continue":
+            # Window exhausted undecided: force the budget ruling so the
+            # gate closes with archived evidence instead of lingering.
+            gate.max_samples = min(gate.max_samples, gate.samples)
+            verdict = gate.evaluate()
+        evidence = plane.record_decision(gate)
+        if verdict == "rollback":
+            return (
+                "sequential gate: live scores significantly below "
+                f"incumbent (lr={evidence['lr']}, alpha={gate.alpha}, "
+                f"samples={evidence['samples']})"
+            )
+        return None
+
+    def _persist_quality(self) -> None:
+        """Commit the quality plane's label-joined state (plus the join
+        token) next to the stream state, atomically with round
+        completion."""
+        if self.store is None:
+            return
+        try:
+            state = get_quality_plane().state(self.config.name)
+            self.store.save(
+                None,
+                {"token": self._joined_token, "state": state},
+                digest=self._quality_state_key(),
+            )
+        except Exception:
+            pass  # quality is evidence, not correctness: never fail a round
+
     def _outcome(self, outcome: str, round_index: int, **detail) -> str:
-        # The round reached a decision: retire its journal (a no-op when
-        # none was written — skips journal before the fold phase).
+        # The round reached a decision: persist the quality join state,
+        # then retire its journal (a no-op when none was written — skips
+        # journal before the fold phase).
+        self._persist_quality()
         self._clear_journal()
+        # Join lag: labeled rows already in the tap that this round did
+        # not reach — the backlog the next round's label join clears.
+        _names.metric(_names.QUALITY_JOIN_LAG_ROWS).set(
+            self.tap.depth(), model=self.config.name
+        )
         self._m_rounds.inc(outcome=outcome)
         self.outcomes.append(
             {"round": round_index, "outcome": outcome, **detail}
@@ -566,6 +767,8 @@ class RefitDemoConfig:
     seed: int = 0
     reg: float = 1e-2
     store_dir: Optional[str] = None
+    watch_gate: str = "margin"      # or "sequential": anytime-valid watch
+    adaptive_decay: bool = False    # drift detector steers state_decay
 
 
 def _corrupt_mapper(model: Any) -> Any:
@@ -599,7 +802,12 @@ def run_refit_demo(config: RefitDemoConfig) -> Dict[str, Any]:
     from ..workflow.streaming import ChunkStream
     from .publish import InProcessPublisher
 
+    from ..obs.quality import reset_quality_plane
+
     cfg = config
+    # The demo is an entry point: its quality evidence must reflect THIS
+    # run, not whatever the process observed before.
+    reset_quality_plane()
     rng = np.random.default_rng(cfg.seed)
     drift_rng = np.random.default_rng(cfg.seed + 1)
 
@@ -662,6 +870,8 @@ def run_refit_demo(config: RefitDemoConfig) -> Dict[str, Any]:
             chunk_rows=cfg.chunk_rows,
             watch_margin=0.05,
             state_decay=cfg.state_decay,
+            watch_gate=cfg.watch_gate,
+            adaptive_decay=cfg.adaptive_decay,
         ),
         state=estimator.export_stream_state(),
     )
@@ -766,6 +976,24 @@ def run_refit_demo(config: RefitDemoConfig) -> Dict[str, Any]:
 
     outcomes = [r["outcome"] for r in rounds]
     ledger = get_recovery_log()
+    # Quality-plane evidence: the labeled (watch-window) stream, drift
+    # state, gate decisions, and the decay the last fold applied — the
+    # bench `quality` obs block and REFIT_STATS consumers read this.
+    quality_report = get_quality_plane().report()
+    demo_view = quality_report["models"].get("demo", {})
+    quality_block = {
+        "label_joins": demo_view.get("label_joins", 0),
+        "drift_score": demo_view.get("drift", {}).get("score", 0.0),
+        "drift_events": demo_view.get("drift", {}).get("events", 0),
+        "decisions": [d["decision"] for d in quality_report["decisions"]],
+        # bench-diff exact-gates this count (deterministic seeded loop).
+        "quality_decisions": len(quality_report["decisions"]),
+        "join_lag_rows": tap.depth(),
+        "state_decay_applied": round(daemon.applied_decay, 4),
+        "labeled_mean": (
+            demo_view.get("streams", {}).get("labeled", {}).get("mean")
+        ),
+    }
     return {
         "d": cfg.d,
         "classes": cfg.classes,
@@ -796,6 +1024,7 @@ def run_refit_demo(config: RefitDemoConfig) -> Dict[str, Any]:
             {e.kind for e in ledger.events() if e.kind.startswith("refit_")}
         ),
         "models": server.registry.describe(),
+        "quality": quality_block,
     }
 
 
@@ -839,6 +1068,8 @@ def refit_from_args(args) -> int:
         bad_round=args.bad_round,
         seed=args.seed,
         store_dir=args.store_dir,
+        watch_gate=getattr(args, "watch_gate", "margin"),
+        adaptive_decay=bool(getattr(args, "adaptive_decay", False)),
     )
     results = run_refit_demo(config)
     results["recovery"] = get_recovery_log().summary()

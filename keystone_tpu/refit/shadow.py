@@ -138,6 +138,35 @@ class ShadowEvaluator:
         err = np.asarray(pred, np.float64) - np.asarray(y, np.float64)
         return -float(np.mean(err * err))  # negative MSE: higher is better
 
+    def score_rows(
+        self, pred: np.ndarray, y: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Per-row scores of already-computed predictions, higher better:
+        0/1 correctness when labels are classes, negative squared error
+        otherwise. The sequential watch gate and the quality plane's
+        label-join stream consume these (a sample mean over them equals
+        :meth:`score_predictions` for both metrics). ``None`` when a
+        custom aggregate ``score_fn`` owns scoring — callers fall back
+        to the aggregate margin rule then."""
+        if self.score_fn is not None:
+            return None
+        pred = np.asarray(pred)
+        classes = _as_classes(y)
+        if classes is not None:
+            k = int(max(
+                int(classes.max()) + 1,
+                pred.shape[-1] if pred.ndim > 1 else 1,
+            ))
+            pred_classes = (
+                pred.argmax(axis=1) if pred.ndim > 1 and pred.shape[1] > 1
+                else np.round(pred).astype(np.int64).ravel().clip(0, k - 1)
+            )
+            return (pred_classes == classes).astype(np.float64)
+        err = np.asarray(pred, np.float64) - np.asarray(y, np.float64)
+        if err.ndim > 1:
+            return -np.mean(err * err, axis=tuple(range(1, err.ndim)))
+        return -(err * err)
+
     def mirror_divergence(
         self, candidate: Any, incumbent: Any, mirror_x: np.ndarray
     ) -> float:

@@ -75,11 +75,13 @@ class PipelineServer:
         #: batch — off the submit hot path, O(1) per row, and a full or
         #: slow tap only ever drops tap rows, never requests.
         self.tap = tap
-        self.telemetry = telemetry or ServingTelemetry(window=self.config.telemetry_window)
+        self.telemetry = telemetry or ServingTelemetry(
+            window=self.config.telemetry_window, default_model=self.default_model
+        )
         self.admission = AdmissionController(self.config.queue_depth)
         self.batcher = MicroBatcher(
             self.config.queue_depth,
-            on_expired=lambda _req: self.telemetry.record_timeout(),
+            on_expired=lambda req: self.telemetry.record_timeout(model=req.model),
         )
         self._buckets = self.config.buckets()
         self._stop = threading.Event()
@@ -177,7 +179,7 @@ class PipelineServer:
         try:
             self.admission.admit(self.batcher.depth())
         except RequestShed:
-            self.telemetry.record_shed()
+            self.telemetry.record_shed(model=model or self.default_model)
             raise
         request = Request(
             payload=payload, model=model or self.default_model, deadline=deadline
@@ -189,7 +191,7 @@ class PipelineServer:
             request.trace_start_s = time.perf_counter()
             _spans.add_span_event("serving.submit", request_id=request.request_id)
         if not self.batcher.offer(request):  # raced to hard-full
-            self.telemetry.record_shed()
+            self.telemetry.record_shed(model=request.model)
             raise RequestShed(f"queue hard-full ({self.batcher.capacity})")
         if self._stop.is_set():
             # Raced stop(): the worker may already have passed its final
@@ -320,7 +322,7 @@ class PipelineServer:
                     entry, [r.payload for r in group], deadline=group_deadline
                 )
             except Exception as exc:
-                self.telemetry.record_failure(len(group))
+                self.telemetry.record_failure(len(group), model=model_name)
                 for req in group:
                     _settle_exception(req.future, exc)
                 return
@@ -342,7 +344,7 @@ class PipelineServer:
             # A model may legally return fewer logical rows than it was
             # given (e.g. a filtering ObjectDataset transformer) — the
             # unmatched tail must fail loudly, never hang unsettled.
-            self.telemetry.record_failure(len(group) - len(rows))
+            self.telemetry.record_failure(len(group) - len(rows), model=model_name)
             for req in group[len(rows):]:
                 _settle_exception(
                     req.future,
@@ -359,6 +361,7 @@ class PipelineServer:
             self.telemetry.record_request(
                 latency_s=done - req.enqueued_at,
                 queue_wait_s=t_apply - req.enqueued_at,
+                model=model_name,
             )
         if self.tap is not None:
             # AFTER every future settled: tap work can never delay a
@@ -416,8 +419,8 @@ class PipelineServer:
             # Count retries whether or not the batch ultimately succeeded:
             # a fault storm that exhausts the policy must still show up.
             for _ in range(attempts["n"] - 1):
-                self.telemetry.record_retry()
-        self.telemetry.record_batch(n, bucket, self.config.max_batch)
+                self.telemetry.record_retry(model=entry.name)
+        self.telemetry.record_batch(n, bucket, self.config.max_batch, model=entry.name)
         # Slice the real rows HOST-side: Dataset.take would device-slice
         # a[:n], and that dynamic_slice compiles per (bucket, n) pair —
         # exactly the steady-state recompile this layer exists to avoid.

@@ -59,6 +59,7 @@ from ..obs import names as _names
 from ..obs import spans as _spans
 from ..obs.fleet import MONOTONIC_WORKER_COUNTERS, FleetTraceCollector
 from ..obs.flight import get_flight_recorder, install_flight_recorder
+from ..obs.quality import QualityPlane
 from ..reliability.recovery import get_recovery_log
 from ..reliability.retry import Deadline, RetryPolicy
 from .admission import AdmissionController
@@ -263,6 +264,12 @@ class WorkerSupervisor:
         #: deltas arriving on heartbeats land here; the frontend's
         #: /metrics and the `keystone-tpu trace` artifact read it.
         self.fleet = FleetTraceCollector()
+        #: Fleet quality view (docs/OBSERVABILITY.md "Quality plane"):
+        #: worker heartbeat sketch deltas merge here; /metrics and the
+        #: quality CLI report read it. Own instance, not the process
+        #: singleton — a supervisor sharing a process with an in-process
+        #: server must not mix fleet and local observations.
+        self.quality = QualityPlane()
         # Always-on flight recorder (idempotent; a frontend sharing this
         # process may have installed one already): worker_crash ledger
         # events auto-dump the supervisor's post-mortem view.
@@ -696,6 +703,14 @@ class WorkerSupervisor:
         delta = msg.get("metrics_delta")
         if isinstance(delta, dict) and delta:
             self.fleet.observe_metrics(worker.id, worker.incarnation, delta)
+        quality = msg.get("quality")
+        if isinstance(quality, dict) and quality:
+            # Sketch deltas are increments (drained-and-reset each beat),
+            # so fleet merge needs no incarnation folding.
+            try:
+                self.quality.merge_delta(quality, role=role)
+            except Exception:
+                pass  # a malformed delta must not take down the reader
 
     def _on_ready(self, worker: _Worker, msg: Optional[Dict[str, Any]] = None) -> None:
         worker.last_beat = time.monotonic()
@@ -871,7 +886,7 @@ class WorkerSupervisor:
         try:
             self.admission.admit(outstanding)
         except RequestShed:
-            self._m_sheds.inc()
+            self._m_sheds.inc(model=model or "default")
             raise
         if hasattr(payload, "tolist"):
             payload = payload.tolist()
@@ -1232,4 +1247,7 @@ class WorkerSupervisor:
         }
         if self.slo is not None:
             out["supervisor"]["slo"] = self.slo.stats()
+        quality = self.quality.report()
+        if quality["models"]:
+            out["quality"] = quality
         return out

@@ -13,6 +13,13 @@ serving next to executor/reliability metrics. Per-instance windows are
 kept for ``snapshot()`` so two servers in one process don't blend their
 percentiles; the registry series aggregate across servers, as process-
 level metrics should.
+
+Every ``keystone_serving_*`` series carries a ``model`` label: a registry
+hosting two tenants emits two distinct series per metric instead of
+collapsing both into one (the quality plane's per-model SLO/drift views
+depend on this). Recording calls that predate multi-tenancy default the
+label to the telemetry's ``default_model``; ``snapshot()`` additionally
+reports a ``per_model`` breakdown of served/failure counts.
 """
 
 from __future__ import annotations
@@ -48,8 +55,10 @@ class ServingTelemetry:
         window: int = 2048,
         clock: Callable[[], float] = time.monotonic,
         log: Optional[logging.Logger] = None,
+        default_model: str = "default",
     ):
         self._clock = clock
+        self.default_model = default_model
         self._lock = threading.Lock()
         self._log = log or logging.getLogger("keystone_tpu.serving")
         self._latencies_s: deque = deque(maxlen=window)
@@ -66,34 +75,55 @@ class ServingTelemetry:
         self.bucket_hits = 0      # batch padded to an already-warm bucket
         self.bucket_compiles = 0  # first batch at a bucket (warm-up compile)
         self._warm_buckets: set = set()
+        # Per-model tallies for snapshot(): the flat counters above stay
+        # the supervisor's monotonic aggregation surface; this keeps the
+        # tenant breakdown visible next to it.
+        self._per_model: Dict[str, Dict[str, int]] = {}
         # Registry handles resolved once (hot-path: no name lookups per
-        # request). These aggregate across all servers in the process.
+        # request). These aggregate across all servers in the process,
+        # one series per model.
         registry = _metrics.get_registry()
-        self._m_requests = registry.counter(SERVING_REQUESTS, "Requests served to completion")
-        self._m_batches = registry.counter(SERVING_BATCHES, "Micro-batches dispatched")
-        self._m_sheds = registry.counter(SERVING_SHEDS, "Requests shed by admission control")
-        self._m_timeouts = registry.counter(SERVING_TIMEOUTS, "Requests expired before batch assembly")
-        self._m_retries = registry.counter(SERVING_RETRIES, "Apply-path retry attempts")
-        self._m_failures = registry.counter(SERVING_FAILURES, "Requests failed by apply errors")
-        self._m_bucket_hits = registry.counter(SERVING_BUCKET_HITS, "Batches padded onto an already-warm bucket")
-        self._m_bucket_compiles = registry.counter(SERVING_BUCKET_COMPILES, "First batches at a cold bucket")
-        self._m_latency = registry.histogram(SERVING_LATENCY_SECONDS, "End-to-end request latency")
-        self._m_queue_wait = registry.histogram(SERVING_QUEUE_WAIT_SECONDS, "Submit-to-apply queue wait")
+        labels = ("model",)
+        self._m_requests = registry.counter(SERVING_REQUESTS, "Requests served to completion", labels)
+        self._m_batches = registry.counter(SERVING_BATCHES, "Micro-batches dispatched", labels)
+        self._m_sheds = registry.counter(SERVING_SHEDS, "Requests shed by admission control", labels)
+        self._m_timeouts = registry.counter(SERVING_TIMEOUTS, "Requests expired before batch assembly", labels)
+        self._m_retries = registry.counter(SERVING_RETRIES, "Apply-path retry attempts", labels)
+        self._m_failures = registry.counter(SERVING_FAILURES, "Requests failed by apply errors", labels)
+        self._m_bucket_hits = registry.counter(SERVING_BUCKET_HITS, "Batches padded onto an already-warm bucket", labels)
+        self._m_bucket_compiles = registry.counter(SERVING_BUCKET_COMPILES, "First batches at a cold bucket", labels)
+        self._m_latency = registry.histogram(SERVING_LATENCY_SECONDS, "End-to-end request latency", labels)
+        self._m_queue_wait = registry.histogram(SERVING_QUEUE_WAIT_SECONDS, "Submit-to-apply queue wait", labels)
         self._m_occupancy = registry.histogram(
-            SERVING_BATCH_OCCUPANCY, "Batch size / max_batch", buckets=RATIO_BUCKETS
+            SERVING_BATCH_OCCUPANCY, "Batch size / max_batch", labels, buckets=RATIO_BUCKETS
         )
 
+    def _model(self, model: Optional[str]) -> str:
+        return model if model else self.default_model
+
+    def _tally(self, model: str, key: str, n: int = 1) -> None:
+        # Callers hold self._lock.
+        row = self._per_model.setdefault(model, {})
+        row[key] = row.get(key, 0) + n
+
     # --------------------------------------------------------------- recording
-    def record_request(self, latency_s: float, queue_wait_s: float) -> None:
+    def record_request(
+        self, latency_s: float, queue_wait_s: float, model: Optional[str] = None
+    ) -> None:
+        model = self._model(model)
         with self._lock:
             self.served += 1
             self._latencies_s.append(latency_s)
             self._queue_waits_s.append(queue_wait_s)
-        self._m_requests.inc()
-        self._m_latency.observe(latency_s)
-        self._m_queue_wait.observe(queue_wait_s)
+            self._tally(model, "served")
+        self._m_requests.inc(model=model)
+        self._m_latency.observe(latency_s, model=model)
+        self._m_queue_wait.observe(queue_wait_s, model=model)
 
-    def record_batch(self, size: int, bucket: int, max_batch: int) -> None:
+    def record_batch(
+        self, size: int, bucket: int, max_batch: int, model: Optional[str] = None
+    ) -> None:
+        model = self._model(model)
         with self._lock:
             self.batches += 1
             self._occupancies.append(size / float(max_batch))
@@ -104,9 +134,9 @@ class ServingTelemetry:
                 self._warm_buckets.add(bucket)
                 self.bucket_compiles += 1
                 hit = False
-        self._m_batches.inc()
-        self._m_occupancy.observe(size / float(max_batch))
-        (self._m_bucket_hits if hit else self._m_bucket_compiles).inc()
+        self._m_batches.inc(model=model)
+        self._m_occupancy.observe(size / float(max_batch), model=model)
+        (self._m_bucket_hits if hit else self._m_bucket_compiles).inc(model=model)
 
     def mark_bucket_warm(self, bucket: int) -> None:
         """Pre-declare a bucket as compiled (AOT warmup path), so the
@@ -121,25 +151,32 @@ class ServingTelemetry:
         with self._lock:
             return sorted(self._warm_buckets)
 
-    def record_shed(self) -> None:
+    def record_shed(self, model: Optional[str] = None) -> None:
+        model = self._model(model)
         with self._lock:
             self.sheds += 1
-        self._m_sheds.inc()
+            self._tally(model, "sheds")
+        self._m_sheds.inc(model=model)
 
-    def record_timeout(self) -> None:
+    def record_timeout(self, model: Optional[str] = None) -> None:
+        model = self._model(model)
         with self._lock:
             self.timeouts += 1
-        self._m_timeouts.inc()
+            self._tally(model, "timeouts")
+        self._m_timeouts.inc(model=model)
 
-    def record_retry(self) -> None:
+    def record_retry(self, model: Optional[str] = None) -> None:
+        model = self._model(model)
         with self._lock:
             self.retries += 1
-        self._m_retries.inc()
+        self._m_retries.inc(model=model)
 
-    def record_failure(self, n: int = 1) -> None:
+    def record_failure(self, n: int = 1, model: Optional[str] = None) -> None:
+        model = self._model(model)
         with self._lock:
             self.failures += n
-        self._m_failures.inc(n)
+            self._tally(model, "failures", n)
+        self._m_failures.inc(n, model=model)
 
     # --------------------------------------------------------------- snapshots
     def snapshot(self, queue_depth: Optional[int] = None) -> Dict[str, object]:
@@ -168,6 +205,10 @@ class ServingTelemetry:
                     self.bucket_hits / max(1, self.bucket_hits + self.bucket_compiles), 4
                 ),
             }
+            if self._per_model:
+                out["per_model"] = {
+                    name: dict(row) for name, row in sorted(self._per_model.items())
+                }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
         return out

@@ -22,7 +22,8 @@ worker → supervisor on stdout):
        "clock": {"unix": ..., "perf": ...}}
     ← {"kind": "response", "id": N, "y": [...], "latency_ms": ...}   (or "error")
     ← {"kind": "heartbeat", "seq": K, "worker": ..., "stats": {...},
-       "spans": [...], "metrics_delta": {...}, "clock": {...}}
+       "spans": [...], "metrics_delta": {...}, "clock": {...},
+       "quality": {<model>: <sketch delta>}}
     ← {"kind": "swapped", "name": ..., "version": ..., "warmup_s": ...}
     ← {"kind": "stats", "stats": {...}}
 
@@ -74,6 +75,7 @@ from ..obs import fleet as _fleet
 from ..obs import spans as _spans
 from ..obs.flight import get_flight_recorder, install_flight_recorder
 from ..obs.metrics import delta as _metrics_delta, get_registry
+from ..obs.quality import get_quality_plane
 from ..reliability import faultinject
 from ..reliability.faultinject import probe
 
@@ -161,11 +163,18 @@ class StubBackend:
             self._latencies.append(latency_s)
             if len(self._latencies) > 2048:
                 del self._latencies[:1024]
+        y = [2.0 * float(v) for v in x]
+        # Quality plane: sketch the payload and feed the prediction
+        # score (mean output — the scalar proxy both backends use) into
+        # the pending heartbeat delta.
+        get_quality_plane().observe_served(
+            msg.get("model") or "default", x, sum(y) / len(y)
+        )
         emitter.emit(
             {
                 "kind": "response",
                 "id": msg.get("id"),
-                "y": [2.0 * float(v) for v in x],
+                "y": y,
                 "latency_ms": round(latency_s * 1e3, 3),
                 # Echo the budget the worker SAW: supervisor tests assert
                 # the remaining deadline crossed the boundary.
@@ -316,13 +325,19 @@ class ServerBackend:
         def on_done(f) -> None:
             try:
                 row = f.result()
+                # Response egress: serialized onto the pipe, so it must
+                # be host-side.  # keystone: allow-sync
+                y = np.asarray(row, np.float64).reshape(-1)
+                get_quality_plane().observe_served(
+                    msg.get("model") or self.name,
+                    payload.reshape(-1).tolist(),
+                    float(y.mean()) if y.size else None,
+                )
                 emitter.emit(
                     {
                         "kind": "response",
                         "id": request_id,
-                        # Response egress: serialized onto the pipe, so
-                        # it must be host-side.  # keystone: allow-sync
-                        "y": np.asarray(row).tolist(),
+                        "y": y.tolist(),
                         "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
                     }
                 )
@@ -470,6 +485,14 @@ def main(argv: Optional[list] = None) -> int:
                 payload["clock"] = {
                     "unix": time.time(), "perf": time.perf_counter()
                 }
+            # Quality sketch deltas ride every beat (independent of the
+            # fleet-trace switch): the pending per-model payload/score
+            # sketches accumulated since the last beat, drained here and
+            # merged fleet-wide by the supervisor. Deltas are increments,
+            # so a restarted worker needs no incarnation folding.
+            quality_delta = get_quality_plane().drain_delta()
+            if quality_delta is not None:
+                payload["quality"] = quality_delta
             recorder = get_flight_recorder()
             if recorder is not None:
                 recorder.observe_metrics()  # rate-limited ring snapshot

@@ -1,0 +1,193 @@
+#!/usr/bin/env bash
+# Quality-plane smoke (docs/OBSERVABILITY.md "Quality plane"): the
+# anytime-valid statistical contract, end to end:
+#
+#   - CLEAN seeded traffic stays quiet across 20 independent seeds:
+#     ZERO drift events, ZERO gate decisions, exit 0 every run — the
+#     sequential gate's false-positive bound holding in practice
+#   - a seeded 3-sigma score REGRESSION fires exactly ONE edge-triggered
+#     drift event and exactly ONE rollback decision (exit 2), with the
+#     evidence on every surface: the QUALITY_STATS report, the
+#     keystone_quality_* metrics, the flight-recorder quality ring, and
+#     a quality_drift dump artifact
+#   - the drift detector measurably moves the adaptive state_decay
+#     suggestion off its base
+#   - serving p99 with the plane enabled stays inside the 5% overhead
+#     budget vs KEYSTONE_QUALITY=off, measured through the real HTTP
+#     front end over a stub-worker fleet (jax-free)
+#
+# This is the CI face of tests/obs/test_quality.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+# ---- clean traffic: 20 seeds, all quiet ------------------------------------
+# Seed 0 through the real CLI (the exit-code/report contract)...
+set +e
+timeout -k 10 60 python -m keystone_tpu quality \
+  --rows 256 --shift 0.0 --seed 0 > /tmp/quality_clean.log
+rc=$?
+set -e
+if [ "$rc" -ne 0 ]; then
+  echo "clean run exited $rc (want 0):"
+  cat /tmp/quality_clean.log
+  exit 1
+fi
+# ...then all 20 seeds in one process (no per-seed interpreter boot).
+timeout -k 10 120 python - <<'EOF'
+import argparse, json
+
+line = [l for l in open("/tmp/quality_clean.log") if l.startswith("QUALITY_STATS:")]
+assert len(line) == 1, f"expected one QUALITY_STATS line, got {len(line)}"
+stats = json.loads(line[0][len("QUALITY_STATS:"):])
+assert stats["drift_events"] == 0, f"false drift on clean CLI run: {stats}"
+assert stats["decisions"] == [], f"false decision on clean CLI run: {stats}"
+assert len(stats["report"]["open_gates"]) == 1, (
+    f"clean gate should end OPEN (no evidence, no verdict): {stats}")
+
+import contextlib, io
+from keystone_tpu.obs.quality_cli import quality_from_args
+
+for seed in range(20):
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = quality_from_args(argparse.Namespace(
+            rows=256, shift=0.0, seed=seed, model="default", features=4,
+            alpha=None, max_samples=None, labels=64, as_json=True))
+    stats = json.loads(out.getvalue().split("QUALITY_STATS:", 1)[1])
+    assert rc == 0, f"clean seed {seed} exited {rc}: {stats}"
+    assert stats["drift_events"] == 0, f"false drift on seed {seed}: {stats}"
+    assert stats["decisions"] == [], f"false decision on seed {seed}: {stats}"
+print("quality_smoke: 20 clean seeds quiet (0 drift events, 0 decisions)")
+EOF
+
+# ---- seeded regression: one drift event, one rollback, every surface -------
+set +e
+timeout -k 10 60 python -m keystone_tpu quality \
+  --rows 256 --shift 3.0 --seed 0 > /tmp/quality_shift.log
+rc=$?
+set -e
+if [ "$rc" -ne 2 ]; then
+  echo "shifted run exited $rc (want 2):"
+  cat /tmp/quality_shift.log
+  exit 1
+fi
+
+timeout -k 10 60 python - <<'EOF'
+import json, os, tempfile
+
+line = [l for l in open("/tmp/quality_shift.log") if l.startswith("QUALITY_STATS:")]
+stats = json.loads(line[0][len("QUALITY_STATS:"):])
+assert stats["drift_events"] == 1, f"want exactly one drift event: {stats}"
+assert stats["decisions"] == ["rollback"], f"want exactly one rollback: {stats}"
+decision = stats["report"]["decisions"][0]
+assert decision["lr"] >= 1.0 / decision["alpha"], (
+    f"rollback without the likelihood ratio clearing 1/alpha: {decision}")
+# The drift detector measurably moves the adaptive state_decay off base.
+decay = stats["state_decay"][stats["model"]]
+assert decay < 1.0, f"drift did not move state_decay off its base: {decay}"
+
+# Same scenario in-process: metric + flight-ring + dump-artifact evidence
+# (the CLI subprocess's registry dies with it; re-run to inspect).
+flight_dir = tempfile.mkdtemp(prefix="quality-smoke-flight-")
+os.environ["KEYSTONE_FLIGHT_DIR"] = flight_dir
+from keystone_tpu.obs.flight import install_flight_recorder
+from keystone_tpu.obs.metrics import get_registry
+from keystone_tpu.obs import names
+from keystone_tpu.obs.quality_cli import quality_from_args
+import argparse
+
+install_flight_recorder("quality-smoke")
+rc = quality_from_args(argparse.Namespace(
+    rows=256, shift=3.0, seed=0, model="default", features=4,
+    alpha=None, max_samples=None, labels=64, as_json=True))
+assert rc == 2, rc
+registry = get_registry()
+drift_metric = names.metric(names.QUALITY_DRIFT_EVENTS, registry)
+assert drift_metric.value(model="default") == 1.0, "drift event metric missing"
+decisions_metric = names.metric(names.QUALITY_GATE_DECISIONS, registry)
+assert decisions_metric.value(model="default", decision="rollback") == 1.0, (
+    "rollback decision metric missing")
+from keystone_tpu.obs.flight import get_flight_recorder
+ring = get_flight_recorder().quality_ring()
+kinds = [e.get("kind") for e in ring]
+assert "drift" in kinds and "gate_decision" in kinds, kinds
+dumps = [f for f in os.listdir(flight_dir) if f.startswith("flightrec-")]
+assert dumps, f"no flight dump artifact in {flight_dir}"
+dumped = json.load(open(os.path.join(flight_dir, dumps[0])))
+assert dumped["trigger"] in ("quality_drift", "quality_rollback"), dumped["trigger"]
+assert dumped["quality"], "dump artifact carries an empty quality ring"
+print("quality_smoke: shifted run fired 1 drift + 1 rollback "
+      f"(lr={decision['lr']} alpha={decision['alpha']} "
+      f"samples={decision['samples']}), state_decay {decay}, "
+      "evidence on metrics + ring + dump")
+EOF
+
+# ---- overhead budget: serving p99 with the plane on vs off -----------------
+timeout -k 10 480 python - <<'EOF'
+import json, time, urllib.request
+
+from keystone_tpu.obs.metrics import percentile
+from keystone_tpu.serving.frontend import ServingFrontend
+from keystone_tpu.serving.supervisor import SupervisorConfig, WorkerSupervisor
+
+def sweep(front, n):
+    body = json.dumps({"x": [1.0, 2.0, 3.0], "deadline_ms": 15000}).encode()
+    url = f"http://{front.host}:{front.port}/v1/apply"
+    latencies = []
+    for _ in range(n):
+        request = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"},
+            method="POST")
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(request, timeout=30) as response:
+            response.read()
+        latencies.append(time.perf_counter() - t0)
+    return percentile(latencies, 99) * 1e3
+
+def measure(quality):
+    # One fleet at a time: two concurrent fleets contend for cores and
+    # the contention (not the plane) dominates the tail.
+    sup = WorkerSupervisor(
+        {"stub": {"delay_ms": 5}},
+        SupervisorConfig(workers=2, heartbeat_s=0.25, hang_timeout_s=10.0,
+                         ready_timeout_s=30.0, monitor_interval_s=0.05),
+        env={"KEYSTONE_QUALITY": quality},
+    ).start()
+    front = None
+    try:
+        sup.wait_ready()
+        front = ServingFrontend(sup, "127.0.0.1", 0).start()
+        sweep(front, 40)  # warm the path
+        return [sweep(front, 150) for _ in range(2)]
+    finally:
+        if front is not None:
+            front.stop()
+        sup.stop()
+
+# Interleaved boots control for ambient load drift across the run;
+# min-of-rounds filters scheduler noise out of the tail estimate. The
+# min only converges downward, so on a loaded box we keep adding
+# interleaved rounds (both modes equally) until the ratio clears the
+# budget — a real >5% cost would keep plane-on pinned above it no
+# matter how many rounds run.
+rounds = {"off": [], "on": []}
+ratio = float("inf")
+for attempt in range(6):
+    rounds["off"] += measure("off")
+    rounds["on"] += measure("1")
+    p99_off, p99_on = min(rounds["off"]), min(rounds["on"])
+    ratio = p99_on / max(p99_off, 1e-9)
+    if attempt >= 1 and ratio <= 1.05:
+        break
+
+print(f"quality_smoke: serving p99 plane-off={p99_off:.3f}ms "
+      f"plane-on={p99_on:.3f}ms ratio={ratio:.4f} "
+      f"({len(rounds['on'])} rounds/mode)")
+assert ratio <= 1.05, (
+    f"quality plane exceeds the 5% p99 overhead budget: {ratio:.4f} "
+    f"({p99_on:.3f}ms vs {p99_off:.3f}ms)")
+EOF
+
+echo "quality_smoke OK"

@@ -115,7 +115,7 @@ def test_serving_traces_propagate_submit_to_apply():
     from keystone_tpu.serving import PipelineServer, ServingConfig
     from keystone_tpu.serving.synthetic import synthetic_fitted_pipeline
 
-    served0 = _counter_value(names.SERVING_REQUESTS)
+    served0 = _counter_value(names.SERVING_REQUESTS, model="default")
     fp = synthetic_fitted_pipeline(d=8, depth=1)
     with spans.tracing_session() as session:
         with spans.span("client") as client:
@@ -141,7 +141,7 @@ def test_serving_traces_propagate_submit_to_apply():
     submit_events = [e for e in client.events if e.name == "serving.submit"]
     assert len(submit_events) == 3
     # registry parity: the serving counters moved with telemetry
-    assert _counter_value(names.SERVING_REQUESTS) == served0 + 3
+    assert _counter_value(names.SERVING_REQUESTS, model="default") == served0 + 3
 
 
 def test_serving_without_session_keeps_requests_unannotated():

@@ -1,0 +1,395 @@
+"""The quality plane (obs/quality.py): accumulator exactness, sketch
+mergeability across heartbeat shards, anytime-valid gate behaviour, the
+edge-triggered drift detector, plane state persistence, and the
+``keystone-tpu quality`` CLI scenario (all jax-free — the plane is
+stdlib-only by contract)."""
+
+import math
+import random
+
+import pytest
+
+from keystone_tpu.obs import names
+from keystone_tpu.obs.metrics import get_registry
+from keystone_tpu.obs.quality import (
+    DriftDetector,
+    Moments,
+    P2Quantile,
+    PayloadSketch,
+    QualityPlane,
+    QuantileSketch,
+    ScoreStream,
+    SequentialGate,
+    get_quality_plane,
+    reset_quality_plane,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    reset_quality_plane()
+    yield
+    reset_quality_plane()
+
+
+def _gauss(n, mean=0.0, std=1.0, seed=0):
+    rng = random.Random(seed)
+    return [rng.gauss(mean, std) for _ in range(n)]
+
+
+# ------------------------------------------------------------ accumulators
+
+
+def test_moments_merge_is_exact_for_any_split():
+    """Chan's parallel update: merging per-shard moments equals one
+    single-process pass, to float rounding — the EXACT half of the
+    sketch-mergeability contract."""
+    values = _gauss(997, mean=3.0, std=2.0, seed=1)
+    single = Moments()
+    for v in values:
+        single.observe(v)
+    for split in (1, 7, 100, 996):
+        shards = []
+        for start in range(0, len(values), split):
+            m = Moments()
+            for v in values[start:start + split]:
+                m.observe(v)
+            shards.append(m)
+        merged = Moments()
+        for m in shards:
+            merged.merge(m)
+        assert merged.count == single.count == len(values)
+        assert math.isclose(merged.mean, single.mean, rel_tol=1e-9)
+        assert math.isclose(merged.m2, single.m2, rel_tol=1e-9)
+        assert merged.min == single.min and merged.max == single.max
+
+
+def test_moments_wire_roundtrip():
+    m = Moments()
+    for v in (1.0, 2.0, 4.0):
+        m.observe(v)
+    back = Moments.from_wire(m.to_wire())
+    assert back.count == 3
+    assert math.isclose(back.mean, m.mean)
+    assert math.isclose(back.variance, m.variance)
+    empty = Moments.from_wire(Moments().to_wire())
+    assert empty.count == 0 and empty.min == math.inf
+
+
+def test_p2_quantile_tracks_gaussian_median():
+    est = P2Quantile(0.5)
+    for v in _gauss(4000, mean=10.0, std=2.0, seed=2):
+        est.observe(v)
+    assert abs(est.value() - 10.0) < 0.25
+    # small-sample path (buffered, exact) and wire round trip
+    small = P2Quantile(0.5)
+    for v in (3.0, 1.0, 2.0):
+        small.observe(v)
+    assert small.value() == 2.0
+    assert P2Quantile.from_wire(small.to_wire()).value() == 2.0
+    assert abs(P2Quantile.from_wire(est.to_wire()).value() - est.value()) < 1e-12
+
+
+def test_quantile_sketch_merge_bounded_error():
+    """Ben-Haim/Tom-Tov: heartbeat-sharded inserts then merge must agree
+    with single-process inserts to within a few percent of the spread —
+    the BOUNDED half of the mergeability contract."""
+    values = _gauss(3000, mean=0.0, std=1.0, seed=3)
+    single = QuantileSketch(64)
+    for v in values:
+        single.add(v)
+    merged = QuantileSketch(64)
+    for start in range(0, len(values), 250):  # 12 heartbeat deltas
+        shard = QuantileSketch(64)
+        for v in values[start:start + 250]:
+            shard.add(v)
+        merged.merge(shard)
+    srt = sorted(values)
+    for q in (0.1, 0.5, 0.9):
+        exact = srt[int(q * (len(srt) - 1))]
+        assert abs(single.quantile(q) - exact) < 0.15, q
+        assert abs(merged.quantile(q) - exact) < 0.15, q
+        assert abs(merged.quantile(q) - single.quantile(q)) < 0.2, q
+
+
+def test_payload_sketch_heartbeat_merge_matches_single_process():
+    """The fleet contract end to end: N worker deltas shipped over the
+    wire and merged in the supervisor == one process observing all rows.
+    Moments exact, quantiles bounded."""
+    rng = random.Random(4)
+    rows = [[rng.gauss(0, 1), rng.gauss(5, 2)] for _ in range(1200)]
+    scores = [rng.gauss(0.8, 0.1) for _ in range(1200)]
+
+    single = PayloadSketch(max_features=4, bins=64)
+    for row, score in zip(rows, scores):
+        single.observe_row(row)
+        single.observe_score(score)
+
+    fleet = PayloadSketch(max_features=4, bins=64)
+    for start in range(0, len(rows), 100):  # 12 worker heartbeats
+        delta = PayloadSketch(max_features=4, bins=64)
+        for row, score in zip(rows[start:start + 100],
+                              scores[start:start + 100]):
+            delta.observe_row(row)
+            delta.observe_score(score)
+        # over the wire, like a heartbeat payload
+        fleet.merge(PayloadSketch.from_wire(delta.to_wire()))
+
+    assert fleet.rows == single.rows == 1200
+    for key in ("f0", "f1", "score"):
+        a = fleet.channels[key].moments
+        b = single.channels[key].moments
+        assert a.count == b.count
+        assert math.isclose(a.mean, b.mean, rel_tol=1e-9, abs_tol=1e-9)
+        assert math.isclose(a.m2, b.m2, rel_tol=1e-6)
+        spread = b.max - b.min
+        for q in (0.5, 0.9):
+            qa = fleet.channels[key].quantiles.quantile(q)
+            qb = single.channels[key].quantiles.quantile(q)
+            assert abs(qa - qb) < 0.05 * spread, (key, q)
+    assert fleet.wire_bytes() > 0
+    summary = fleet.summary()
+    assert summary["rows"] == 1200 and "score" in summary["channels"]
+
+
+def test_score_stream_state_roundtrip_resumes_quantiles():
+    stream = ScoreStream()
+    stream.observe_many(_gauss(500, mean=1.0, std=0.1, seed=5))
+    resumed = ScoreStream.from_state(stream.to_state())
+    rest = _gauss(500, mean=1.0, std=0.1, seed=6)
+    stream.observe_many(rest)
+    resumed.observe_many(rest)
+    assert resumed.count == stream.count == 1000
+    assert math.isclose(resumed.mean, stream.mean, rel_tol=1e-12)
+    for q in ScoreStream.QUANTILES:
+        assert math.isclose(resumed.quantile(q), stream.quantile(q))
+    summary = stream.summary()
+    assert summary["count"] == 1000 and abs(summary["p50"] - 1.0) < 0.02
+
+
+# -------------------------------------------------------- sequential gate
+
+
+def test_gate_same_distribution_stays_open_within_budget():
+    rng = random.Random(7)
+    gate = SequentialGate("m", alpha=0.05, max_samples=10_000)
+    for _ in range(400):
+        verdict = gate.observe(
+            candidate=rng.gauss(1.0, 0.1), baseline=rng.gauss(1.0, 0.1)
+        )
+        assert verdict == "continue"
+    assert gate.decision is None
+
+
+def test_gate_detects_regression_and_is_sticky():
+    rng = random.Random(8)
+    gate = SequentialGate("m", alpha=0.05)
+    verdict = "continue"
+    n = 0
+    while verdict == "continue":
+        n += 1
+        verdict = gate.observe(
+            candidate=rng.gauss(0.7, 0.1), baseline=rng.gauss(1.0, 0.1)
+        )
+    assert verdict == "rollback"
+    assert n < 200, "a 3-sigma shift should decide fast"
+    # sticky: further (clean) evidence cannot reopen a closed gate
+    for _ in range(50):
+        assert gate.observe(candidate=2.0, baseline=0.0) == "rollback"
+    evidence = gate.evidence()
+    assert evidence["decision"] == "rollback"
+    assert evidence["lr"] >= 1.0 / 0.05
+    assert evidence["candidate"]["n"] >= 2
+
+
+def test_gate_budget_exhaustion_promotes_without_evidence_of_harm():
+    rng = random.Random(9)
+    gate = SequentialGate("m", alpha=0.05, min_samples=8, max_samples=40)
+    verdict = "continue"
+    while verdict == "continue":
+        verdict = gate.observe(
+            candidate=rng.gauss(1.0, 0.1), baseline=rng.gauss(1.0, 0.1)
+        )
+    assert verdict == "promote"
+    assert gate.budget_exhausted
+    assert gate.samples <= 42
+
+
+def test_gate_false_positive_rate_under_alpha_on_seeded_runs():
+    """20 clean A/A comparisons at alpha=0.05 on pinned seeds: zero
+    spurious decisions inside a realistic budget (the smoke's
+    clean-traffic criterion in miniature)."""
+    for seed in range(20):
+        rng = random.Random(1000 + seed)
+        gate = SequentialGate("m", alpha=0.05, max_samples=10_000)
+        for _ in range(256):
+            gate.observe(
+                candidate=rng.gauss(1.0, 0.1), baseline=rng.gauss(1.0, 0.1)
+            )
+        assert gate.decision is None, seed
+
+
+# --------------------------------------------------------- drift detector
+
+
+def test_drift_detector_edge_triggered_and_rearms():
+    det = DriftDetector(threshold=0.5, min_count=32, floor=0.5)
+    for v in _gauss(64, mean=1.0, std=0.1, seed=10):
+        det.observe(v)
+    det.freeze_baseline()
+    assert det.drift_score() == 0.0  # empty current window
+    for v in _gauss(64, mean=0.7, std=0.1, seed=11):  # 3-sigma shift
+        det.observe(v)
+    event = det.check()
+    assert event is not None and event["kind"] == "drift"
+    assert event["score"] > 0.5
+    assert det.check() is None, "edge-triggered: one event per crossing"
+    # decay suggestion shrinks toward the floor under drift
+    assert det.suggested_decay(1.0) < 1.0
+    assert det.suggested_decay(1.0) >= 0.5
+    # falling back under threshold re-arms
+    det.current = type(det.current)()
+    for v in _gauss(64, mean=1.0, std=0.1, seed=12):
+        det.observe(v)
+    assert det.check() is None  # quiet again
+    assert det.suggested_decay(1.0) == 1.0
+    for v in _gauss(200, mean=0.5, std=0.1, seed=13):
+        det.observe(v)
+    assert det.check() is not None, "re-armed detector fires again"
+    assert det.events == 2
+
+
+def test_drift_detector_needs_min_count():
+    det = DriftDetector(threshold=0.5, min_count=64, floor=0.5)
+    for v in _gauss(64, mean=1.0, std=0.1, seed=14):
+        det.observe(v)
+    det.freeze_baseline()
+    for v in _gauss(10, mean=0.0, std=0.1, seed=15):
+        det.observe(v)
+    assert det.drift_score() == 0.0, "too few current samples to call drift"
+
+
+# ------------------------------------------------------------- the plane
+
+
+def test_plane_worker_delta_merge_and_report():
+    worker = QualityPlane()
+    rng = random.Random(16)
+    for _ in range(200):
+        worker.observe_served(
+            "m", [rng.gauss(0, 1) for _ in range(3)], rng.gauss(0.9, 0.05)
+        )
+    assert worker.stream("m", "live").count == 200
+    delta = worker.drain_delta()
+    assert delta is not None and "m" in delta
+    assert worker.drain_delta() is None, "drain resets the pending delta"
+
+    supervisor = QualityPlane()
+    supervisor.merge_delta(delta, role="worker")
+    sketch = supervisor.sketch("m")
+    assert sketch is not None and sketch.rows == 200
+    report = supervisor.report()
+    assert report["models"]["m"]["sketch"]["rows"] == 200
+    assert report["sketch_merges"] == 1
+
+
+def test_plane_label_join_and_state_restore():
+    plane = get_quality_plane()
+    scores = _gauss(128, mean=0.95, std=0.02, seed=17)
+    assert plane.join_labels("m", scores) == 128
+    for s in scores:
+        plane.observe_score("m", s, role="live")
+    plane.drift("m").freeze_baseline()
+    state = plane.state("m")
+
+    reset_quality_plane()
+    fresh = get_quality_plane()
+    fresh.restore("m", state)
+    assert fresh.stream("m", "labeled").count == 128
+    assert fresh.report()["models"]["m"]["label_joins"] == 128
+    det = fresh.drift("m")
+    assert det.baseline is not None and det.baseline.count == 128
+
+
+def test_plane_decision_recording_bumps_metric_and_archive():
+    plane = get_quality_plane()
+    registry = get_registry()
+    counter = names.metric(names.QUALITY_GATE_DECISIONS)
+    before = counter.value(model="m", decision="rollback")
+    gate = plane.open_gate("m", kind="canary", alpha=0.05, min_samples=8)
+    assert len(plane.open_gates()) == 1
+    rng = random.Random(18)
+    while gate.observe(candidate=rng.gauss(0.5, 0.1),
+                       baseline=rng.gauss(1.0, 0.1)) == "continue":
+        pass
+    evidence = plane.record_decision(gate)
+    assert evidence["decision"] == "rollback"
+    assert not plane.open_gates(), "recording a decision closes the gate"
+    assert list(plane.decisions)[-1]["kind"] == "canary"
+    assert counter.value(model="m", decision="rollback") == before + 1
+    plane.publish_metrics(registry)
+
+
+def test_plane_disabled_env_is_noop(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_QUALITY", "off")
+    plane = QualityPlane()
+    plane.observe_served("m", [1.0, 2.0], 0.5)
+    assert plane.join_labels("m", [1.0, 2.0]) == 0
+    assert plane.stream("m", "live").count == 0
+    assert plane.drain_delta() is None
+    assert plane.check_drift("m") is None
+    assert plane.suggested_decay("m", base=0.7) == 0.7
+
+
+def test_plane_payload_sampling(monkeypatch):
+    monkeypatch.setenv("KEYSTONE_QUALITY_SAMPLE", "4")
+    plane = QualityPlane()
+    for _ in range(40):
+        plane.observe_payload("m", [1.0, 2.0])
+    delta = plane.drain_delta()
+    assert delta["m"]["rows"] == 10, "1-in-4 sampling sketches 10 of 40"
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def _cli_args(**over):
+    import argparse
+
+    ns = argparse.Namespace(
+        rows=256, shift=0.0, seed=0, model="default", features=4,
+        alpha=None, max_samples=None, labels=64, as_json=True,
+    )
+    for key, value in over.items():
+        setattr(ns, key, value)
+    return ns
+
+
+def test_quality_cli_clean_traffic_is_quiet(capsys):
+    from keystone_tpu.obs.quality_cli import quality_from_args
+
+    rc = quality_from_args(_cli_args())
+    out = capsys.readouterr().out
+    assert rc == 0
+    import json as _json
+
+    stats = _json.loads(out.split("QUALITY_STATS:", 1)[1])
+    assert stats["drift_events"] == 0
+    assert stats["decisions"] == []
+    assert stats["report"]["open_gates"], "clean run ends with gate OPEN"
+
+
+def test_quality_cli_shift_fires_drift_and_rollback(capsys):
+    from keystone_tpu.obs.quality_cli import quality_from_args
+
+    rc = quality_from_args(_cli_args(shift=3.0))
+    out = capsys.readouterr().out
+    assert rc == 2
+    import json as _json
+
+    stats = _json.loads(out.split("QUALITY_STATS:", 1)[1])
+    assert stats["drift_events"] == 1
+    assert stats["rollbacks"] == 1
+    assert stats["state_decay"]["default"] < 1.0, (
+        "drift must move the suggested state_decay"
+    )

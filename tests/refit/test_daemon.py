@@ -226,6 +226,150 @@ def test_watch_window_thread_inherits_round_trace_context(tmp_path):
         server.stop(drain=True)
 
 
+def test_sequential_watch_gate_rolls_back_with_archived_evidence(tmp_path):
+    """watch_gate="sequential": the anytime-valid mSPRT replaces the
+    fixed margin floor. A corrupted candidate's per-row live scores
+    separate from the incumbent's, the gate decides rollback with
+    archived evidence, and a clean next round closes promote."""
+    from keystone_tpu.obs.quality import get_quality_plane, reset_quality_plane
+    from keystone_tpu.ops.learning.linear import LinearMapper
+    from keystone_tpu.reliability.recovery import get_recovery_log
+
+    reset_quality_plane()
+    server, tap, daemon = _loop(tmp_path, watch_gate="sequential")
+    try:
+        def negate(model):
+            return LinearMapper(
+                -np.asarray(model.weights),
+                intercept=model.intercept,
+                feature_mean=model.feature_mean,
+            )
+
+        tap.feed(*_rows(seed=21))
+        with faultinject.injected(
+            faultinject.FaultSpec(
+                match="refit.candidate", kind="corrupt", calls=(1,),
+                corrupt=negate,
+            )
+        ):
+            assert daemon.run_once() == "rolled_back"
+        assert server.registry.resolve("m").version == 1
+        events = get_recovery_log().events("refit_rollback")
+        assert "sequential gate" in events[-1].detail["reason"]
+        plane = get_quality_plane()
+        decision = list(plane.decisions)[-1]
+        assert decision["kind"] == "refit_watch"
+        assert decision["decision"] == "rollback"
+        assert decision["alpha"] == daemon.config.gate_alpha
+        # The watch window's scores were label-joined into the plane.
+        assert plane.stream("m", "labeled").count > 0
+        # A clean round decides promote (by evidence or on budget) and
+        # the publish sticks — the gate does not cry wolf.
+        tap.feed(*_rows(seed=22))
+        assert daemon.run_once() == "published"
+        assert list(plane.decisions)[-1]["decision"] == "promote"
+        assert not plane.open_gates(), "every round's gate is closed"
+    finally:
+        server.stop(drain=True)
+
+
+def test_adaptive_decay_shrinks_fold_decay_under_drift(tmp_path):
+    """adaptive_decay=True: a drifting live-score stream (quality-plane
+    drift detector over threshold) shrinks the decay the fold actually
+    applies below the configured state_decay."""
+    from keystone_tpu.obs.quality import get_quality_plane, reset_quality_plane
+
+    reset_quality_plane()
+    server, tap, daemon = _loop(
+        tmp_path, adaptive_decay=True, state_decay=1.0
+    )
+    try:
+        plane = get_quality_plane()
+        rng = np.random.default_rng(23)
+        det = plane.drift("m")
+        for s in rng.normal(1.0, 0.1, size=128):
+            det.observe(float(s))
+        det.freeze_baseline()
+        for s in rng.normal(0.2, 0.1, size=128):  # 8-sigma regression
+            det.observe(float(s))
+        assert plane.check_drift("m") is not None
+        tap.feed(*_rows(seed=24))
+        assert daemon.run_once() == "published"
+        assert daemon.applied_decay < 1.0, (
+            "detected drift must shrink the applied state decay"
+        )
+        assert daemon.outcomes[-1]["state_decay"] == round(
+            daemon.applied_decay, 4
+        )
+    finally:
+        server.stop(drain=True)
+
+
+def test_daemon_kill_mid_label_join_replays_exactly_once(tmp_path):
+    """Exactly-once label joins across the journal, both kill windows:
+    (1) a crash AFTER the in-memory join but BEFORE the quality state
+    persisted loses the join with the process — the journal replay
+    re-joins it, once; (2) a crash AFTER the quality state persisted but
+    BEFORE the journal cleared replays the round, but the persisted join
+    token makes the replay skip the re-join — never double-counted."""
+    from keystone_tpu.obs.quality import get_quality_plane, reset_quality_plane
+
+    reset_quality_plane()
+    eval_rows = N // 4  # eval_fraction 0.25 of the drained batch
+
+    # -- window 1: die between the join and the quality-state persist.
+    server, tap, daemon = _loop(tmp_path)
+    try:
+        tap.feed(*_rows(seed=25))
+
+        def die(*a, **k):
+            raise RuntimeError("killed before quality persist")
+
+        daemon._persist_quality = die
+        with pytest.raises(RuntimeError, match="killed before"):
+            daemon.run_once()
+        assert get_quality_plane().stream("m", "labeled").count == eval_rows
+    finally:
+        server.stop(drain=True)
+
+    reset_quality_plane()  # the process died: in-memory joins are gone
+    server, tap, daemon2 = _loop(tmp_path)
+    try:
+        assert get_quality_plane().stream("m", "labeled").count == 0
+        assert daemon2.run_once() in ("published", "rolled_back")
+        plane = get_quality_plane()
+        assert plane.stream("m", "labeled").count == eval_rows, (
+            "journal replay joins the lost batch exactly once"
+        )
+        assert plane.report()["models"]["m"]["label_joins"] == eval_rows
+
+        # -- window 2: die between the quality persist and journal clear.
+        tap.feed(*_rows(seed=26))
+        real_clear = daemon2._clear_journal
+        daemon2._clear_journal = lambda: (_ for _ in ()).throw(
+            RuntimeError("killed before journal clear")
+        )
+        with pytest.raises(RuntimeError, match="journal clear"):
+            daemon2.run_once()
+        daemon2._clear_journal = real_clear
+        assert plane.stream("m", "labeled").count == 2 * eval_rows
+    finally:
+        server.stop(drain=True)
+
+    reset_quality_plane()
+    server, tap, daemon3 = _loop(tmp_path)
+    try:
+        # Restored from the persisted quality state: both joins present.
+        plane = get_quality_plane()
+        assert plane.stream("m", "labeled").count == 2 * eval_rows
+        assert daemon3.run_once() in ("published", "rolled_back")
+        assert plane.stream("m", "labeled").count == 2 * eval_rows, (
+            "replayed batch whose join persisted must NOT join again"
+        )
+    finally:
+        server.stop(drain=True)
+
+
 def test_watch_window_thread_exception_propagates_to_round(tmp_path):
     """An exception inside the watch thread must re-raise on the round
     thread (the supervised loop owns the error ledger) — never vanish

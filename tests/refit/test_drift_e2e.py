@@ -51,6 +51,13 @@ def _get(front, path, timeout=30):
         return response.status, json.loads(response.read())
 
 
+def _get_text(front, path, timeout=30):
+    with urllib.request.urlopen(
+        f"http://{front.host}:{front.port}{path}", timeout=timeout
+    ) as response:
+        return response.status, response.read().decode()
+
+
 # ------------------------------------------------------- tier-1 stub twin
 
 
@@ -99,6 +106,28 @@ def test_stub_fleet_publish_acks_per_worker_through_the_front_door(tmp_path):
         code, health = _get(front, "/healthz")
         assert (code, health["status"], health["alive"]) == (200, "ok", 2)
         assert sup.stats()["failures"] == 0
+
+        # Quality plane, fleet-wide: each stub worker sketched its served
+        # payloads locally and shipped the delta on a heartbeat; the
+        # supervisor merged them, so /stats carries the fleet sketch and
+        # the /metrics scrape exports the keystone_quality_* family.
+        deadline = time.monotonic() + 10
+        while True:
+            quality = sup.stats().get("quality")
+            rows = (
+                (quality or {}).get("models", {})
+                .get("default", {}).get("sketch") or {}
+            ).get("rows", 0)
+            if rows >= 2:  # both served requests reached the fleet view
+                break
+            assert time.monotonic() < deadline, quality
+            time.sleep(0.05)
+        score_channel = quality["models"]["default"]["sketch"]["channels"]["score"]
+        assert score_channel["count"] >= 2  # per-request prediction scores
+        code, exposition = _get_text(front, "/metrics")
+        assert code == 200
+        assert "keystone_quality_sketch_rows" in exposition
+        assert 'model="default"' in exposition
     finally:
         if front is not None:
             front.stop()

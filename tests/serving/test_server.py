@@ -234,3 +234,44 @@ def test_stop_without_drain_fails_queued_requests():
         except (ServerClosed, RequestShed):
             settled += 1
     assert settled == 12  # every future resolves one way or the other
+
+
+def test_two_model_registry_keeps_metric_series_distinct():
+    """Multi-tenant bugfix pin: two models behind one registry must emit
+    two distinct keystone_serving_* series (model label), not collapse
+    into a single aggregate — the per-model quality/SLO views read these."""
+    from keystone_tpu.obs import metrics, names
+    from keystone_tpu.serving.registry import ModelRegistry
+
+    requests_metric = metrics.get_registry().counter(
+        names.SERVING_REQUESTS, labels=("model",)
+    )
+    alpha0 = requests_metric.value(model="alpha")
+    beta0 = requests_metric.value(model="beta")
+    registry = ModelRegistry()
+    registry.publish("alpha", ScaleModel(2))
+    registry.publish("beta", ScaleModel(5))
+    with PipelineServer(
+        config=ServingConfig(max_batch=8, max_wait_ms=2.0), registry=registry,
+        name="alpha",
+    ) as server:
+        payloads = synthetic_requests(9, d=D)
+        futures = [server.submit(p, model="alpha") for p in payloads[:5]]
+        futures += [server.submit(p, model="beta") for p in payloads[5:]]
+        results = [f.result(timeout=30) for f in futures]
+        stats = server.stats()
+    for x, y in zip(payloads[:5], results[:5]):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 2, rtol=1e-6)
+    for x, y in zip(payloads[5:], results[5:]):
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x) * 5, rtol=1e-6)
+    # one series per tenant, each counting only its own traffic
+    assert requests_metric.value(model="alpha") == alpha0 + 5
+    assert requests_metric.value(model="beta") == beta0 + 4
+    # latency histogram split the same way
+    latency = metrics.get_registry().get(names.SERVING_LATENCY_SECONDS)
+    assert latency.count(model="alpha") >= 5
+    assert latency.count(model="beta") >= 4
+    # snapshot carries the per-tenant breakdown next to the flat totals
+    assert stats["served"] == 9
+    assert stats["per_model"]["alpha"]["served"] == 5
+    assert stats["per_model"]["beta"]["served"] == 4
