@@ -2093,6 +2093,126 @@ def _bench_sharded(small: bool) -> dict:
     return out
 
 
+def _bench_sharded2d(small: bool) -> dict:
+    """2-D data × model partitioning (docs/PARTITIONING.md "2-D
+    layouts"): the SAME streamed wide Gram fit swept over the 8×1, 4×2
+    and 2×4 layouts of the pinned 8-virtual-device mesh, the model axis
+    feature-sharding the O(d²) carry. Reports per-layout wall clocks,
+    parity vs the row-only reference, and the plan-pure invariants
+    bench-diff exact-gates: per-device peak state bytes (shrinks by the
+    model shard count) and the per-axis collective-bytes split.
+
+    Same CPU caveat as the ``sharded`` leg: virtual devices share one
+    socket, the exact-gated counters are the CI invariant, the walls
+    become the scaling curve on real multi-chip hardware."""
+    import numpy as np
+
+    import jax
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+    from keystone_tpu.ops.stats.core import LinearRectifier
+    from keystone_tpu.utils.compilation_cache import install_compile_counter
+    from keystone_tpu.workflow.executor import PipelineEnv
+    from keystone_tpu.workflow.streaming import last_stream_report
+
+    install_compile_counter()
+    if len(jax.devices()) < 8:
+        return {"skipped": f"needs 8 devices, have {len(jax.devices())}"}
+    chunk = 256 if small else 2048
+    n = 8 * chunk
+    d = 1024 if small else 8192
+    k = 8
+    layouts = ((1, "8x1"), (2, "4x2"), (4, "2x4"))
+
+    rng = np.random.default_rng(17)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = rng.normal(size=(n, k)).astype(np.float32)
+
+    prev_env = {
+        name: os.environ.get(name)
+        for name in (
+            "KEYSTONE_STREAM_CHUNK_ROWS",
+            "KEYSTONE_PARTITION_MODEL_SHARDS",
+            "KEYSTONE_PARTITION_MIN_WIDTH",
+        )
+    }
+    os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = str(chunk)
+    os.environ["KEYSTONE_PARTITION_MIN_WIDTH"] = "64"
+    out: dict = {
+        "stream": {"n": n, "d": d, "k": k, "chunk_rows": chunk},
+        "cpu_emulation_note": (
+            "virtual CPU devices share one socket — walls are flat-to-"
+            "noisy; the exact-gated state/collective counters carry the "
+            "invariant"
+        ) if jax.devices()[0].platform == "cpu" else "",
+    }
+
+    def fit():
+        PipelineEnv.reset()
+        pipe = LinearRectifier(0.0).to_pipeline().then_label_estimator(
+            LinearMapEstimator(reg=1e-2), ArrayDataset(x), ArrayDataset(y)
+        )
+        return pipe.fit()
+
+    ref = None
+    try:
+        for p_m, name in layouts:
+            os.environ["KEYSTONE_PARTITION_MODEL_SHARDS"] = str(p_m)
+            fit()  # warm once, time the re-fit
+            t0 = time.perf_counter()
+            fitted = fit()
+            wall = time.perf_counter() - t0
+            rep = last_stream_report()
+            leg = {
+                "wall_s": round(wall, 3),
+                "shards_chosen_data": rep.shards if rep else 0,
+                "shards_chosen_model": rep.model_shards if rep else 0,
+                "state_bytes_per_device": (
+                    rep.state_bytes_per_device if rep else 0
+                ),
+                "collective_bytes_data": (
+                    rep.collective_bytes_data if rep else 0
+                ),
+                "collective_bytes_model": (
+                    rep.collective_bytes_model if rep else 0
+                ),
+                "streaming_report": {
+                    "chunks": rep.chunks if rep else 0,
+                    "compiles_steady_state": (
+                        rep.compiles_steady_state if rep else None
+                    ),
+                },
+            }
+            preds = np.asarray(fitted.apply_batch(ArrayDataset(x[:64])).data)
+            if ref is None:
+                ref = preds
+            leg["parity_rel_err"] = float(
+                np.linalg.norm(preds - ref)
+                / max(np.linalg.norm(ref), 1e-30)
+            )
+            out[f"layout_{name}"] = leg
+    finally:
+        for name, val in prev_env.items():
+            if val is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = val
+
+    # The headline: feature state per device divides by the model shard
+    # count (the replicated label-sized remainder is the only residue).
+    out["state_reduction_8x1_to_2x4"] = round(
+        out["layout_8x1"]["state_bytes_per_device"]
+        / max(out["layout_2x4"]["state_bytes_per_device"], 1), 2
+    )
+    out["state_reduction_ok"] = (
+        out["layout_8x1"]["state_bytes_per_device"]
+        > out["layout_4x2"]["state_bytes_per_device"]
+        > out["layout_2x4"]["state_bytes_per_device"]
+    )
+    return out
+
+
 def _bench_sketched(small: bool) -> dict:
     """Sketched solver tier (docs/SOLVERS.md): a very-wide (d=8192)
     streamed least-squares fit the meta ladder routes onto the
@@ -2213,6 +2333,7 @@ def _workload_registry() -> dict:
         "streaming": _bench_streaming,
         "blocksparse": _bench_blocksparse,
         "sharded": _bench_sharded,
+        "sharded2d": _bench_sharded2d,
         "sketched": _bench_sketched,
         "refit": _bench_refit,
         "serving": _bench_serving,

@@ -220,9 +220,9 @@ SCHEMA: Dict[str, Tuple] = {
     STREAM_PREFETCH_DEPTH: ("gauge", "Chunks currently buffered in the host prefetch queue", ()),
     STREAM_HOST_BUFFER_PEAK: ("gauge", "Peak bytes of host chunk buffers concurrently live in the last streaming fit", ()),
     PARTITION_DECISIONS: ("counter", "Partitioner decisions recorded into plans, split by kind and eligibility", ("kind", "eligible")),
-    PARTITION_SHARDS: ("gauge", "Row shards chosen by the last eligible partition decision, per kind", ("kind",)),
-    PARTITION_FALLBACKS: ("counter", "Partition decisions that fell back to single-device, by reason key", ("reason",)),
-    PARTITION_COLLECTIVE_BYTES: ("counter", "Payload bytes entering partitioner-managed cross-device reductions (reduced payload × (shards−1))", ()),
+    PARTITION_SHARDS: ("gauge", "Shards chosen by the last eligible partition decision, per kind and mesh axis (data = rows, model = feature blocks)", ("kind", "axis")),
+    PARTITION_FALLBACKS: ("counter", "Partition decisions that fell back (whole decision or just the model axis), by reason key", ("reason",)),
+    PARTITION_COLLECTIVE_BYTES: ("counter", "Payload bytes entering partitioner-managed cross-device reductions, per mesh axis (per-device payload × (axis shards−1))", ("axis",)),
     PARTITION_IMBALANCE: ("gauge", "Fraction of sharded rows that are padding in the last partitioned dispatch, per kind", ("kind",)),
     AUTOCACHE_CACHED_NODES: ("counter", "Cacher nodes inserted by the auto-cache planner", ()),
     AUTOCACHE_HITS: ("counter", "Re-reads of a cached (Cacher) node's memoized result", ()),

@@ -110,6 +110,10 @@ class BlockLeastSquaresEstimator(GramStreamStateMixin, LabelEstimator):
     #: consume featurized row chunks incrementally via Gram accumulation.
     supports_fit_stream = True
 
+    #: 2-D partitioner protocol: the Gram carry shards its feature rows
+    #: (gram_stream_step.model_block_step) on a (data, model) mesh.
+    supports_model_axis = True
+
     def __init__(
         self,
         block_size: int,

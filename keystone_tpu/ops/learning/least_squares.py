@@ -117,8 +117,17 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
     #: through ``stream_state_kind_for`` (reliability/durable.py).
     stream_state_kind = "gram"
 
+    #: 2-D partitioner protocol (docs/PARTITIONING.md "2-D layouts"):
+    #: every rung this meta-solver delegates to folds a blocked-carry
+    #: step (gram_stream_step / sketch_stream_step), so its streamed
+    #: state can shard the feature axis.
+    supports_model_axis = True
+
     def fit_stream(self, stream, state=None):
-        inner = self._stream_solver(_stream_width(stream, self.block_size))
+        inner = self._stream_solver(
+            _stream_width(stream, self.block_size),
+            model_shards=_stream_model_shards(stream),
+        )
         fitted = inner.fit_stream(stream, state=state)
         # Surface the delegate's captured statistics as OUR export, so
         # the refit loop can hold the meta-solver and never care which
@@ -126,16 +135,20 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         self._stream_state = inner.export_stream_state()
         return fitted
 
-    def _stream_solver(self, width: int):
+    def _stream_solver(self, width: int, model_shards: int = 1):
         """The concrete streaming rung for a featurized ``width``:
         exact (narrow) → Gram-BCD (wide) → sketched (very wide, where
-        the O(d²) Gram itself is the memory problem — KV303's regime)."""
+        the O(d²) Gram itself is the memory problem — KV303's regime).
+        The rung is priced on PER-DEVICE state: a 2-D plan splits the
+        Gram's feature rows ``model_shards`` ways, so the sketch floor
+        scales with it — a mesh that feature-shards keeps the exact Gram
+        rung ``model_shards``× wider before sketching truncates."""
         from ...sketch.solvers import (
             SketchedLeastSquaresEstimator,
             sketch_min_width,
         )
 
-        if width >= sketch_min_width():
+        if width >= sketch_min_width() * max(1, model_shards):
             inner = SketchedLeastSquaresEstimator(reg=self.reg)
             tuned = getattr(self, "_tuned_sketch_size", None)
             if tuned:
@@ -165,13 +178,17 @@ class LeastSquaresEstimator(LabelEstimator, Optimizable):
         be the CHOSEN rung's, resolved after the stream geometry is
         final (a sketched fold commits kind="sketch" carries)."""
         return self._stream_solver(
-            _stream_width(stream, self.block_size)
+            _stream_width(stream, self.block_size),
+            model_shards=_stream_model_shards(stream),
         ).stream_state_kind
 
     def stream_state_meta_for(self, stream):
         """Durable-fold protocol: the chosen rung's envelope meta (the
         sketch rung's (variant, seed); empty for the Gram family)."""
-        inner = self._stream_solver(_stream_width(stream, self.block_size))
+        inner = self._stream_solver(
+            _stream_width(stream, self.block_size),
+            model_shards=_stream_model_shards(stream),
+        )
         return dict(getattr(inner, "stream_state_meta", {}) or {})
 
     # ------------------------------------------------ refit state contract
@@ -430,6 +447,15 @@ def _stream_width(stream, default: int) -> int:
     if len(leaves) == 1 and len(leaves[0].shape) == 2:
         return int(leaves[0].shape[1])
     return default
+
+
+def _stream_model_shards(stream) -> int:
+    """Feature-axis shards of the stream's pinned partition decision —
+    what makes the rung dispatch price PER-DEVICE state bytes instead of
+    the global carry (a (d, d) Gram on p model shards costs each device
+    d²/p). 1 for unpartitioned or row-only streams."""
+    part = getattr(stream, "partition", None)
+    return max(1, int(getattr(part, "model_shards", 1) or 1))
 
 
 def _sample_shape_stats(sample_x: Dataset, sample_y: Optional[Dataset]):

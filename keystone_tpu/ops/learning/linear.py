@@ -62,6 +62,10 @@ class LinearMapEstimator(GramStreamStateMixin, LabelEstimator):
     #: equations accumulate naturally over row chunks.
     supports_fit_stream = True
 
+    #: 2-D partitioner protocol: the Gram carry shards its feature rows
+    #: (gram_stream_step.model_block_step) on a (data, model) mesh.
+    supports_model_axis = True
+
     def __init__(self, reg: Optional[float] = None):
         self.reg = reg
 

@@ -989,6 +989,35 @@ def gram_stream_step(carry, x, y):
     )
 
 
+def gram_stream_block_step(carry, x, y, block_index):
+    """Model-axis (feature-sharded) variant of :func:`gram_stream_step`:
+    this device's carry holds only the ``block_index``-th row block of G
+    (and of C, Σx) — (d/p_model, d) instead of (d, d) — so the per-device
+    Gram state shrinks p_model×. Each block still sees the FULL chunk x
+    (rows already data-sharded by the engine) and takes its own column
+    slice; Σy is feature-free, so only block 0 accumulates it (the
+    finish-time model reduction SUMS non-feature leaves)."""
+    g, c, sa, sb = carry
+    b = g.shape[0]  # static block height; block_index is traced
+    x = x.astype(g.dtype)
+    y = y.astype(g.dtype)
+    xb = lax.dynamic_slice_in_dim(x, block_index * b, b, axis=1)
+    on0 = (block_index == 0).astype(g.dtype)
+    return (
+        g + mm(xb.T, x),
+        c + mm(xb.T, y),
+        sa + jnp.sum(xb, axis=0),
+        sb + on0 * jnp.sum(y, axis=0),
+    )
+
+
+# Blocked-carry protocol (workflow/streaming.py 2-D layouts): which axis
+# of each carry leaf is the feature axis (None = feature-free, kept full
+# shape and accumulated only on model block 0).
+gram_stream_step.model_layout = (0, 0, 0, None)
+gram_stream_step.model_block_step = gram_stream_block_step
+
+
 @_mode_cached()
 def _gram_finish_fn():
     def run(g, c, sa, sb, n):

@@ -51,6 +51,41 @@ def row_shard_count(mesh: Mesh) -> int:
     return math.prod(mesh.shape[a] for a in row_axes(mesh))
 
 
+def model_axis_size(mesh: Mesh) -> int:
+    """Feature-block shards the mesh carries (1 when no ``model`` axis)."""
+    return mesh.shape.get(MODEL_AXIS, 1)
+
+
+# One reshaped 2-D mesh per (base devices, model shards): the partitioner
+# re-decides every plan, and the streaming engine's step-jit cache keys on
+# mesh identity — a fresh Mesh object per plan would retrace the identical
+# program every fit and break the zero-steady-state-compile guarantee.
+_model_mesh_cache: dict = {}
+
+
+def model_mesh(base: Mesh, model_shards: int) -> Mesh:
+    """The ``(data, model)`` mesh over ``base``'s devices with the feature
+    axis split ``model_shards`` ways. Cached on (device tuple, shards) so
+    repeated plans hand back the SAME Mesh object (jit-cache identity).
+    ``model_shards`` must divide the device count (callers gate on
+    ``model-axis-indivisible`` first)."""
+    devices = tuple(base.devices.flat)
+    if len(devices) % model_shards != 0:
+        raise ValueError(
+            f"{model_shards} model shards do not divide {len(devices)} devices"
+        )
+    key = (devices, int(model_shards))
+    hit = _model_mesh_cache.get(key)
+    if hit is None:
+        hit = make_mesh(
+            (len(devices) // model_shards, model_shards),
+            (DATA_AXIS, MODEL_AXIS),
+            devices=devices,
+        )
+        _model_mesh_cache[key] = hit
+    return hit
+
+
 def make_mesh(
     shape: Optional[Tuple[int, ...]] = None,
     axis_names: Sequence[str] = (DATA_AXIS,),
@@ -110,11 +145,14 @@ def make_hybrid_mesh(
 
 
 def mesh_without(mesh: Mesh, shard_index: int) -> Mesh:
-    """The shrunken mesh after losing the device backing row-shard
-    ``shard_index``: a 1-D ``data`` mesh over the surviving devices (a
-    hybrid mesh flattens — after a loss the replica grouping is stale
-    anyway). The elastic streamed fold re-plans on this
-    (docs/RELIABILITY.md "Durable fits")."""
+    """The shrunken mesh after losing the device at FLAT index
+    ``shard_index``: a 1-D ``data`` mesh over the surviving devices. The
+    flat index covers every axis — on a 1-D mesh it is the row shard, on
+    a 2-D ``(data, model)`` mesh it is ``data_idx·model_shards +
+    model_idx``, so a loss on either axis shrinks through the same call
+    (hybrid/2-D meshes flatten — after a loss the axis grouping is stale
+    anyway, and the elastic fold re-plans the layout from scratch on the
+    survivors; docs/RELIABILITY.md "Durable fits")."""
     devices = [d for i, d in enumerate(mesh.devices.flat) if i != shard_index]
     if not devices:
         raise ValueError("cannot shrink a mesh below one device")

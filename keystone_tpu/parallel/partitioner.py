@@ -40,6 +40,12 @@ Env knobs (all via envknobs.py — no raw env reads, KV501):
 - ``KEYSTONE_PARTITION_MIN_ROWS`` — minimum LOGICAL rows per shard for a
   fit to be worth partition-managing (default 2; raise it to keep small
   fits off the partition-managed path).
+- ``KEYSTONE_PARTITION_MODEL_SHARDS`` — feature-axis (``model``) shards
+  for wide Gram/BCD/sketch fits (0 = auto from the ambient mesh's model
+  axis; >1 reshapes the mesh into (devices/p, p)).
+- ``KEYSTONE_PARTITION_MIN_WIDTH`` — minimum featurized columns per
+  model shard (default 512) below which a requested model axis records
+  ``below-width-floor`` and the layout stays row-only.
 
 See docs/PARTITIONING.md for the axis conventions, the full eligibility
 and fallback matrix, and the collective-bytes accounting model.
@@ -48,12 +54,22 @@ and fallback matrix, and the collective-bytes accounting model.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ..envknobs import env_disabled, env_int
-from .mesh import Mesh, get_mesh, row_axes, row_shard_count
+from .mesh import (
+    MODEL_AXIS,
+    REPLICA_AXIS,
+    Mesh,
+    get_mesh,
+    model_axis_size,
+    model_mesh,
+    row_axes,
+    row_shard_count,
+)
 
 # Stable reason keys (the fallback matrix in docs/PARTITIONING.md; the
 # verifier's KV203 diagnostics carry these verbatim).
@@ -65,6 +81,28 @@ R_BELOW_FLOOR = "below-rows-floor"
 R_CHUNK_TOO_NARROW = "chunk-below-shard-count"
 R_BUCKETS_INDIVISIBLE = "buckets-indivisible"
 R_OPT_OUT = "operator-opt-out"
+# Model-axis (feature-sharding) refusals: the decision may still shard
+# rows — these land in ``PartitionDecision.model_fallback`` and the
+# keystone_partition_fallbacks metric, never in ``reason`` unless the
+# whole decision is ineligible.
+R_MODEL_INDIVISIBLE = "model-axis-indivisible"
+R_BELOW_WIDTH_FLOOR = "below-width-floor"
+
+#: Every reason key a decision (or its model axis) can carry — the
+#: docs-sync surface: each must appear in docs/PARTITIONING.md's
+#: eligibility matrix (tests/workflow/test_verify.py docs-sync).
+ALL_REASON_KEYS = (
+    SHARDED,
+    R_DISABLED,
+    R_SINGLE_SHARD,
+    R_UNKNOWN_ROWS,
+    R_BELOW_FLOOR,
+    R_CHUNK_TOO_NARROW,
+    R_BUCKETS_INDIVISIBLE,
+    R_OPT_OUT,
+    R_MODEL_INDIVISIBLE,
+    R_BELOW_WIDTH_FLOOR,
+)
 
 
 # ------------------------------------------------------------------ enablement
@@ -109,6 +147,26 @@ def partition_min_rows_per_shard() -> int:
     return max(1, env_int("KEYSTONE_PARTITION_MIN_ROWS", 2))
 
 
+def partition_model_shards() -> int:
+    """Requested feature-axis (``model``) shards for wide Gram/BCD/sketch
+    fits (``KEYSTONE_PARTITION_MODEL_SHARDS``). 0 (the default) = auto:
+    adopt the ambient mesh's ``model`` axis when it has one, else stay
+    row-only. Values > 1 ask the partitioner to RESHAPE the mesh into
+    (devices/p, p) — refused per node with ``model-axis-indivisible`` /
+    ``below-width-floor`` when the device count or featurized width
+    doesn't cooperate (docs/PARTITIONING.md "2-D layouts")."""
+    return max(0, env_int("KEYSTONE_PARTITION_MODEL_SHARDS", 0))
+
+
+def partition_min_width_per_shard() -> int:
+    """Minimum featurized columns each model shard must receive
+    (``KEYSTONE_PARTITION_MIN_WIDTH``, default 512). Below this the
+    feature blocks are too small for the sharded state to matter and the
+    finish-time concat overhead dominates — the decision records
+    ``below-width-floor`` and keeps the row-only layout."""
+    return max(1, env_int("KEYSTONE_PARTITION_MIN_WIDTH", 512))
+
+
 # -------------------------------------------------------------------- decision
 
 
@@ -126,13 +184,29 @@ class PartitionDecision:
     node: str  # operator label
     eligible: bool
     reason: str  # SHARDED, or the fallback reason key
-    shards: int = 1
-    mesh_axes: Tuple[str, ...] = ()
+    shards: int = 1  # ROW shards (data × replica axes)
+    model_shards: int = 1  # feature-axis shards (1 = row-only layout)
+    mesh_axes: Tuple[str, ...] = ()  # row axes — the chunk/batch spec
     mesh_shape: Tuple[int, ...] = ()
-    spec: str = ""  # rendered row PartitionSpec
+    spec: str = ""  # rendered row (× feature) PartitionSpec
     detail: str = ""
-    chunk_rows: Optional[int] = None  # fit_stream: rounded to shards
+    model_fallback: str = ""  # why the MODEL axis was refused/demoted
+    chunk_rows: Optional[int] = None  # fit_stream: rounded to row shards
     mesh: Optional[Mesh] = field(default=None, repr=False)
+
+    @property
+    def carry_axes(self) -> Tuple[str, ...]:
+        """Axes the stacked streaming carry shards over: row axes, plus
+        ``model`` when the layout is 2-D (the carry's leading block axis
+        enumerates all ``shards × model_shards`` devices row-major)."""
+        if self.model_shards > 1:
+            return self.mesh_axes + (MODEL_AXIS,)
+        return self.mesh_axes
+
+    @property
+    def total_shards(self) -> int:
+        """Device blocks in the stacked carry: row × feature shards."""
+        return self.shards * self.model_shards
 
     def to_json(self) -> Dict[str, Any]:
         out = {
@@ -141,12 +215,15 @@ class PartitionDecision:
             "eligible": self.eligible,
             "reason": self.reason,
             "shards": self.shards,
+            "model_shards": self.model_shards,
             "mesh_axes": list(self.mesh_axes),
             "mesh_shape": list(self.mesh_shape),
             "spec": self.spec,
         }
         if self.detail:
             out["detail"] = self.detail
+        if self.model_fallback:
+            out["model_fallback"] = self.model_fallback
         if self.chunk_rows is not None:
             out["chunk_rows"] = self.chunk_rows
         return out
@@ -194,10 +271,21 @@ def record_decision(
     )
     if decision.eligible:
         _names.metric(_names.PARTITION_SHARDS).set(
-            decision.shards, kind=decision.kind
+            decision.shards, kind=decision.kind, axis="data"
         )
+        if decision.model_shards > 1:
+            _names.metric(_names.PARTITION_SHARDS).set(
+                decision.model_shards, kind=decision.kind, axis="model"
+            )
     else:
         _names.metric(_names.PARTITION_FALLBACKS).inc(reason=decision.reason)
+    if decision.model_fallback and decision.model_fallback != decision.reason:
+        # A row-sharded decision whose MODEL axis was refused still counts
+        # a fallback under the model reason — the observable trace of "why
+        # is this wide fit not feature-sharded".
+        _names.metric(_names.PARTITION_FALLBACKS).inc(
+            reason=decision.model_fallback
+        )
     return decision
 
 
@@ -207,17 +295,19 @@ def last_partition_report() -> List[PartitionDecision]:
         return list(_last_report)
 
 
-def record_collective_bytes(nbytes: int) -> None:
+def record_collective_bytes(nbytes: int, axis: str = "data") -> None:
     """Account payload bytes entering a partitioner-managed cross-device
-    reduction (the finish-time allreduce of streamed sufficient stats).
-    Counted as reduced-payload × (shards−1): the bytes that must cross at
-    least one device boundary in any reduction topology — deterministic
-    for a pinned plan, so bench-diff exact-gates it."""
+    reduction (the finish-time reductions of streamed sufficient stats),
+    labelled by the mesh axis they cross. Counted as per-device-payload ×
+    (axis shards−1): the bytes that must cross at least one device
+    boundary in any reduction topology on that axis — ``data`` carries
+    the row-partial sums, ``model`` the feature-block gather.
+    Deterministic for a pinned plan, so bench-diff exact-gates both."""
     if nbytes <= 0:
         return
     from ..obs import names as _names
 
-    _names.metric(_names.PARTITION_COLLECTIVE_BYTES).inc(int(nbytes))
+    _names.metric(_names.PARTITION_COLLECTIVE_BYTES).inc(int(nbytes), axis=axis)
 
 
 def record_imbalance(kind: str, logical_rows: int, padded_rows: int) -> None:
@@ -243,6 +333,7 @@ class Partitioner:
         self,
         mesh: Optional[Mesh] = None,
         min_rows_per_shard: Optional[int] = None,
+        model_shards: Optional[int] = None,
     ):
         self.mesh = mesh if mesh is not None else get_mesh()
         self.min_rows = (
@@ -252,24 +343,47 @@ class Partitioner:
         )
         self.axes = row_axes(self.mesh)
         self.shards = row_shard_count(self.mesh)
+        req = model_shards if model_shards is not None else partition_model_shards()
+        if req == 0:  # auto: adopt the ambient mesh's model axis
+            req = model_axis_size(self.mesh)
+        self.requested_model = max(1, int(req))
+        self.min_width = partition_min_width_per_shard()
 
     # ------------------------------------------------------------- rendering
-    def spec_str(self) -> str:
-        return f"P(({', '.join(repr(a) for a in self.axes)},), …)"
+    def spec_str(self, axes: Tuple[str, ...], model_shards: int = 1) -> str:
+        row = f"P(({', '.join(repr(a) for a in axes)},), …)"
+        if model_shards > 1:
+            return row + f" × P(…, ({MODEL_AXIS!r},))"
+        return row
 
-    def _base(self, kind: str, node: str, eligible: bool, reason: str, **kw):
+    def _base(
+        self,
+        kind: str,
+        node: str,
+        eligible: bool,
+        reason: str,
+        mesh: Optional[Mesh] = None,
+        axes: Optional[Tuple[str, ...]] = None,
+        shards: Optional[int] = None,
+        model_shards: int = 1,
+        **kw,
+    ):
+        mesh = mesh if mesh is not None else self.mesh
+        axes = axes if axes is not None else self.axes
+        shards = shards if shards is not None else self.shards
         return PartitionDecision(
             kind=kind,
             node=node,
             eligible=eligible,
             reason=reason,
-            shards=self.shards if eligible else 1,
-            mesh_axes=self.axes if eligible else (),
-            mesh_shape=tuple(self.mesh.shape[a] for a in self.mesh.shape)
+            shards=shards if eligible else 1,
+            model_shards=model_shards if eligible else 1,
+            mesh_axes=axes if eligible else (),
+            mesh_shape=tuple(mesh.shape[a] for a in mesh.shape)
             if eligible
             else (),
-            spec=self.spec_str() if eligible else "",
-            mesh=self.mesh if eligible else None,
+            spec=self.spec_str(axes, model_shards) if eligible else "",
+            mesh=mesh if eligible else None,
             **kw,
         )
 
@@ -282,6 +396,62 @@ class Partitioner:
                 detail=f"mesh has {self.shards} row shard",
             )
         return None
+
+    # ------------------------------------------------------------ model axis
+    def _model_plan(
+        self, width: Optional[int], model_ok: bool, optimistic: bool
+    ) -> Tuple[int, str, str]:
+        """How many feature-axis shards this node gets: ``(model_shards,
+        fallback_reason, detail)``. ``model_shards == 1`` with an empty
+        reason means "nothing requested / operator can't ride it" — not
+        a recorded fallback. ``optimistic`` (streams) grants the request
+        on unknown width; the fold re-validates against the real
+        featurized width and demotes via :func:`demote_model_axis`."""
+        req = self.requested_model
+        if req <= 1 or not model_ok:
+            return 1, "", ""
+        total = int(self.mesh.devices.size)
+        if req > total or total % req != 0:
+            return 1, R_MODEL_INDIVISIBLE, (
+                f"{req} model shards do not divide {total} devices"
+            )
+        if REPLICA_AXIS in self.mesh.shape and model_axis_size(self.mesh) != req:
+            return 1, R_MODEL_INDIVISIBLE, (
+                "hybrid (replica) mesh carries no model axis to reshape"
+            )
+        if width is None or width < 0:
+            if optimistic:
+                return req, "", ""
+            return 1, R_BELOW_WIDTH_FLOOR, (
+                "featurized width unknown at plan time"
+            )
+        if width % req != 0:
+            return 1, R_MODEL_INDIVISIBLE, (
+                f"width {width} not divisible by {req} model shards"
+            )
+        if width < req * self.min_width:
+            return 1, R_BELOW_WIDTH_FLOOR, (
+                f"width {width} < {req} shards × {self.min_width} "
+                "min cols/shard"
+            )
+        return req, "", ""
+
+    def _layout(
+        self, width: Optional[int], model_ok: bool, optimistic: bool
+    ) -> Tuple[Mesh, Tuple[str, ...], int, int, str, str]:
+        """The (mesh, row_axes, row_shards, model_shards, model_fallback,
+        model_detail) layout for a fit/stream decision. A granted model
+        plan reshapes the ambient devices into the cached ``(data,
+        model)`` mesh (identity-stable — jit caches key on mesh id)."""
+        p_m, mfall, mdetail = self._model_plan(width, model_ok, optimistic)
+        if p_m > 1:
+            mesh = (
+                self.mesh
+                if model_axis_size(self.mesh) == p_m
+                else model_mesh(self.mesh, p_m)
+            )
+            return mesh, row_axes(mesh), row_shard_count(mesh), p_m, mfall, mdetail
+        return self.mesh, self.axes, self.shards, 1, mfall, mdetail
 
     @staticmethod
     def _emit(record: bool, decision: PartitionDecision) -> PartitionDecision:
@@ -297,30 +467,53 @@ class Partitioner:
         rows: Optional[int],
         record: bool = True,
         opt_out: bool = False,
+        width: Optional[int] = None,
+        model_ok: bool = False,
     ) -> PartitionDecision:
         """In-core estimator fit: rows shard over the row axes, Gram/AᵀA
-        partials psummed across shards (parallel/linalg.py). Needs a
+        partials psummed across shards (parallel/linalg.py); when the
+        operator rides the model axis (``model_ok``) and the featurized
+        ``width`` clears the floor, the feature dimension additionally
+        blocks across ``model`` (block_coordinate_descent_2d). Needs a
         known row count with at least ``min_rows`` logical rows/shard."""
-        gated = self._gate("fit", node)
-        if gated is not None:
-            return self._emit(record, gated)
+        if not partition_enabled():
+            return self._emit(record, self._base("fit", node, False, R_DISABLED))
         if opt_out:
             return self._emit(
                 record, self._base("fit", node, False, R_OPT_OUT)
             )
-        if rows is None or rows < 0:
-            return self._emit(record, 
-                self._base("fit", node, False, R_UNKNOWN_ROWS)
-            )
-        if rows < self.shards * self.min_rows:
-            return self._emit(record, 
+        mesh, axes, p_d, p_m, mfall, mdetail = self._layout(
+            width, model_ok, optimistic=False
+        )
+        if p_d <= 1 and p_m <= 1:
+            return self._emit(record,
                 self._base(
-                    "fit", node, False, R_BELOW_FLOOR,
-                    detail=f"{rows} rows < {self.shards} shards × "
-                    f"{self.min_rows} min rows/shard",
+                    "fit", node, False, R_SINGLE_SHARD,
+                    detail=f"mesh has {self.shards} row shard",
+                    model_fallback=mfall,
                 )
             )
-        return self._emit(record, self._base("fit", node, True, SHARDED))
+        if rows is None or rows < 0:
+            return self._emit(record,
+                self._base("fit", node, False, R_UNKNOWN_ROWS,
+                           model_fallback=mfall)
+            )
+        if rows < p_d * self.min_rows:
+            return self._emit(record,
+                self._base(
+                    "fit", node, False, R_BELOW_FLOOR,
+                    detail=f"{rows} rows < {p_d} shards × "
+                    f"{self.min_rows} min rows/shard",
+                    model_fallback=mfall,
+                )
+            )
+        return self._emit(record,
+            self._base(
+                "fit", node, True, SHARDED,
+                mesh=mesh, axes=axes, shards=p_d, model_shards=p_m,
+                model_fallback=mfall, detail=mdetail,
+            )
+        )
 
     def decide_stream(
         self,
@@ -329,36 +522,60 @@ class Partitioner:
         rows: Optional[int] = None,
         record: bool = True,
         opt_out: bool = False,
+        width: Optional[int] = None,
+        model_ok: bool = False,
     ) -> PartitionDecision:
-        """Streamed fit: every chunk splits data-parallel across the mesh
-        (chunk_rows rounds UP to a shard multiple so the one compiled
-        chunk shape divides evenly); per-device carries hold unreduced
-        partial statistics, allreduced once at finish."""
-        gated = self._gate("fit_stream", node)
-        if gated is not None:
-            return self._emit(record, gated)
+        """Streamed fit: every chunk splits data-parallel across the row
+        axes (chunk_rows rounds UP to a row-shard multiple so the one
+        compiled chunk shape divides evenly); per-device carries hold
+        unreduced partial statistics, reduced once at finish — rows
+        summed across ``data``, feature blocks concatenated across
+        ``model`` when the layout is 2-D. Unknown width grants the model
+        axis optimistically; the fold demotes against the real
+        featurized width (:func:`demote_model_axis`)."""
+        if not partition_enabled():
+            return self._emit(
+                record, self._base("fit_stream", node, False, R_DISABLED)
+            )
         if opt_out:
             return self._emit(
                 record, self._base("fit_stream", node, False, R_OPT_OUT)
             )
-        if chunk_rows < self.shards:
-            return self._emit(record, 
+        mesh, axes, p_d, p_m, mfall, mdetail = self._layout(
+            width, model_ok, optimistic=True
+        )
+        if p_d <= 1 and p_m <= 1:
+            return self._emit(record,
+                self._base(
+                    "fit_stream", node, False, R_SINGLE_SHARD,
+                    detail=f"mesh has {self.shards} row shard",
+                    model_fallback=mfall,
+                )
+            )
+        if chunk_rows < p_d:
+            return self._emit(record,
                 self._base(
                     "fit_stream", node, False, R_CHUNK_TOO_NARROW,
-                    detail=f"chunk_rows {chunk_rows} < {self.shards} shards",
+                    detail=f"chunk_rows {chunk_rows} < {p_d} shards",
+                    model_fallback=mfall,
                 )
             )
-        if rows is not None and 0 <= rows < self.shards * self.min_rows:
-            return self._emit(record, 
+        if rows is not None and 0 <= rows < p_d * self.min_rows:
+            return self._emit(record,
                 self._base(
                     "fit_stream", node, False, R_BELOW_FLOOR,
-                    detail=f"{rows} rows < {self.shards} shards × "
+                    detail=f"{rows} rows < {p_d} shards × "
                     f"{self.min_rows} min rows/shard",
+                    model_fallback=mfall,
                 )
             )
-        rounded = -(-chunk_rows // self.shards) * self.shards
-        return self._emit(record, 
-            self._base("fit_stream", node, True, SHARDED, chunk_rows=rounded)
+        rounded = -(-chunk_rows // p_d) * p_d
+        return self._emit(record,
+            self._base(
+                "fit_stream", node, True, SHARDED, chunk_rows=rounded,
+                mesh=mesh, axes=axes, shards=p_d, model_shards=p_m,
+                model_fallback=mfall, detail=mdetail,
+            )
         )
 
     def decide_serve(
@@ -393,6 +610,41 @@ class Partitioner:
 
 
 # ------------------------------------------------------------------ consumers
+
+
+def demote_model_axis(
+    decision: PartitionDecision, reason: str, detail: str = ""
+) -> PartitionDecision:
+    """Runtime demotion of an optimistically-granted model axis (the fold
+    discovers the REAL featurized width, or a step function without the
+    blocked protocol). Keeps the 2-D mesh — ``P(('data',), …)`` on it
+    simply replicates over ``model``, so the chunk geometry and the armed
+    durable cursor stay valid — and drops ``model_shards`` to 1. If the
+    row axis alone cannot shard (a 1×N mesh), the decision turns
+    ineligible and the stream runs the single-device path. Counted in
+    keystone_partition_fallbacks under the model reason either way."""
+    from ..obs import names as _names
+
+    _names.metric(_names.PARTITION_FALLBACKS).inc(reason=reason)
+    demoted = dataclasses.replace(
+        decision,
+        model_shards=1,
+        model_fallback=reason,
+        spec=f"P(({', '.join(repr(a) for a in decision.mesh_axes)},), …)",
+        detail=detail or decision.detail,
+    )
+    if demoted.shards <= 1:
+        demoted = dataclasses.replace(
+            demoted,
+            eligible=False,
+            reason=reason,
+            shards=1,
+            mesh_axes=(),
+            mesh_shape=(),
+            spec="",
+            mesh=None,
+        )
+    return demoted
 
 
 def fit_mesh(op: Any) -> Mesh:

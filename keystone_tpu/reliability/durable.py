@@ -205,6 +205,10 @@ class StreamCursor:
     feature_dtype: str
     mesh_shape: Tuple[int, ...] = ()
     shards: int = 1
+    # Layout METADATA only (like mesh_shape/shards): the snapshot carry
+    # itself is always merged to the mesh-independent single-device
+    # shape, so resume re-plans freely across 1-D and 2-D meshes.
+    model_shards: int = 1
 
 
 @dataclass
@@ -271,6 +275,7 @@ class DurableFold:
         chunk_rows: int,
         mesh_shape: Tuple[int, ...],
         shards: int,
+        model_shards: int = 1,
     ) -> StreamCursor:
         return StreamCursor(
             chunk_index=chunk_index,
@@ -278,6 +283,7 @@ class DurableFold:
             chunk_rows=chunk_rows,
             mesh_shape=tuple(mesh_shape),
             shards=shards,
+            model_shards=model_shards,
             **self.fingerprints,
         )
 
@@ -289,6 +295,7 @@ class DurableFold:
         chunk_rows: int,
         mesh_shape: Tuple[int, ...] = (),
         shards: int = 1,
+        model_shards: int = 1,
     ) -> bool:
         """Persist one mid-fit snapshot (atomic tmp+rename underneath).
         Called by the fold with the carry ALREADY host-fetched and
@@ -305,7 +312,8 @@ class DurableFold:
         )
         entry = ResumeEntry(
             cursor=self.cursor(
-                chunk_index, rows_consumed, chunk_rows, mesh_shape, shards
+                chunk_index, rows_consumed, chunk_rows, mesh_shape, shards,
+                model_shards,
             ),
             state=state,
             seed_rows=self.seed_rows,

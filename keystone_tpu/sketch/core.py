@@ -123,11 +123,9 @@ def sketch_stream_step(variant: str, seed: int):
 
     if variant == "countsketch":
 
-        def step(carry, x, y, mask):
+        def _hash_rows(mask, s):
             import jax.numpy as jnp
 
-            sa, sy, s1, sx, sums_y = carry
-            s = sa.shape[0]
             idx1 = mask[:, 0].astype(jnp.int32)  # row index + 1; 0 = pad
             valid = (idx1 > 0).astype(jnp.float32)
             idx = jnp.maximum(idx1 - 1, 0).astype(jnp.uint32)
@@ -139,6 +137,13 @@ def sketch_stream_step(variant: str, seed: int):
                     jnp.float32
                 )
             ) * valid
+            return bucket, sign
+
+        def step(carry, x, y, mask):
+            import jax.numpy as jnp
+
+            sa, sy, s1, sx, sums_y = carry
+            bucket, sign = _hash_rows(mask, sa.shape[0])
             sa = sa.at[bucket].add(sign[:, None] * x)
             sy = sy.at[bucket].add(sign[:, None] * y)
             s1 = s1.at[bucket].add(sign)
@@ -150,14 +155,34 @@ def sketch_stream_step(variant: str, seed: int):
                 sums_y + jnp.sum(y, axis=0),
             )
 
-    else:  # srht
-
-        def step(carry, x, y, mask):
-            import jax
+        def block_step(carry, x, y, mask, block_index):
+            # Model-axis variant: this device holds the block_index-th
+            # column block of SA/Σx — (s, d/p_model) — and scatter-adds
+            # its own column slice of the chunk. SY/s1/Σy are feature-
+            # free: block 0 owns them (finish SUMS non-feature leaves).
+            from jax import lax
             import jax.numpy as jnp
 
             sa, sy, s1, sx, sums_y = carry
-            s = sa.shape[0]
+            b = sa.shape[1]  # static block width; block_index is traced
+            bucket, sign = _hash_rows(mask, sa.shape[0])
+            xb = lax.dynamic_slice_in_dim(x, block_index * b, b, axis=1)
+            on0 = (block_index == 0).astype(jnp.float32)
+            sa = sa.at[bucket].add(sign[:, None] * xb)
+            sy = sy.at[bucket].add((on0 * sign)[:, None] * y)
+            s1 = s1.at[bucket].add(on0 * sign)
+            return (
+                sa, sy, s1,
+                sx + jnp.sum(xb, axis=0),
+                sums_y + on0 * jnp.sum(y, axis=0),
+            )
+
+    else:  # srht
+
+        def _mix_matrix(mask, s):
+            import jax
+            import jax.numpy as jnp
+
             idx1 = mask[:, 0].astype(jnp.int32)
             valid = (idx1 > 0).astype(jnp.float32)
             idx = jnp.maximum(idx1 - 1, 0).astype(jnp.uint32)
@@ -173,7 +198,13 @@ def sketch_stream_step(variant: str, seed: int):
                     jnp.float32
                 )
             ) * valid
-            m = (1.0 - 2.0 * parity) * sign[None, :] * (1.0 / np.sqrt(s))
+            return (1.0 - 2.0 * parity) * sign[None, :] * (1.0 / np.sqrt(s))
+
+        def step(carry, x, y, mask):
+            import jax.numpy as jnp
+
+            sa, sy, s1, sx, sums_y = carry
+            m = _mix_matrix(mask, sa.shape[0])
             return (
                 sa + m @ x,
                 sy + m @ y,
@@ -182,9 +213,30 @@ def sketch_stream_step(variant: str, seed: int):
                 sums_y + jnp.sum(y, axis=0),
             )
 
+        def block_step(carry, x, y, mask, block_index):
+            from jax import lax
+            import jax.numpy as jnp
+
+            sa, sy, s1, sx, sums_y = carry
+            b = sa.shape[1]
+            m = _mix_matrix(mask, sa.shape[0])
+            xb = lax.dynamic_slice_in_dim(x, block_index * b, b, axis=1)
+            on0 = (block_index == 0).astype(jnp.float32)
+            return (
+                sa + m @ xb,
+                sy + on0 * (m @ y),
+                s1 + on0 * jnp.sum(m, axis=1),
+                sx + jnp.sum(xb, axis=0),
+                sums_y + on0 * jnp.sum(y, axis=0),
+            )
+
     step.needs_mask = True
     step.sketch_variant = variant
     step.sketch_seed = seed
+    # Blocked-carry protocol (workflow/streaming.py 2-D layouts): the
+    # feature axis of each carry leaf (SA cols, Σx) — None = feature-free.
+    step.model_layout = (1, None, None, 0, None)
+    step.model_block_step = block_step
     return step
 
 

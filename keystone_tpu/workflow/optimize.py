@@ -190,13 +190,22 @@ class PartitionPlanRule(Rule):
             target = op.estimator if streaming else op
             opt_out = getattr(target, "partitionable", True) is False
             rows = _upstream_rows(graph, node)
+            # The model (feature) axis only helps operators whose carry
+            # declares a blocked layout; width is the RAW upstream column
+            # count — a best-effort floor proxy for the featurized width
+            # (streams re-validate against the real width at fold time).
+            model_ok = getattr(target, "supports_model_axis", False)
+            width = _upstream_width(graph, node)
             if streaming:
                 decision = part.decide_stream(
                     label, op.chunk_rows or stream_chunk_rows(), rows=rows,
-                    opt_out=opt_out,
+                    opt_out=opt_out, width=width, model_ok=model_ok,
                 )
             else:
-                decision = part.decide_fit(label, rows, opt_out=opt_out)
+                decision = part.decide_fit(
+                    label, rows, opt_out=opt_out, width=width,
+                    model_ok=model_ok,
+                )
             # Pin only ELIGIBLE decisions, and always onto a COPY: the
             # user still holds the original estimator, and a fit that is
             # not partition-managed must run the user's own object on
@@ -228,6 +237,34 @@ def _upstream_rows(graph: Graph, node: NodeId) -> Optional[int]:
                 return len(op.dataset)
             except Exception:
                 return None
+        deps = graph.get_dependencies(cur)
+        cur = deps[0] if deps else None
+    return None
+
+
+def _upstream_width(graph: Graph, node: NodeId) -> Optional[int]:
+    """Column count of the bound dataset feeding a fit — the planner's
+    proxy for the featurized width when deciding the model (feature)
+    axis. Only a proxy: featurizers may widen or narrow it, so streamed
+    fits re-validate against the real featurized width at fold time
+    (``demote_model_axis``). ``None`` when the head is unbound or not a
+    2-D array dataset."""
+    seen = set()
+    cur = graph.get_dependencies(node)
+    cur = cur[0] if cur else None
+    while isinstance(cur, NodeId) and cur not in seen:
+        seen.add(cur)
+        op = graph.get_operator(cur)
+        if isinstance(op, DatasetOperator):
+            ds = op.dataset
+            if isinstance(ds, ArrayDataset):
+                import jax
+
+                for leaf in jax.tree_util.tree_leaves(ds.data):
+                    shape = getattr(leaf, "shape", ())
+                    if len(shape) >= 2:
+                        return int(shape[1])
+            return None
         deps = graph.get_dependencies(cur)
         cur = deps[0] if deps else None
     return None

@@ -221,12 +221,19 @@ class StreamReport:
     stall_s: float = 0.0
     compiles_first_chunk: int = 0
     compiles_steady_state: int = 0
-    #: Partitioned (multi-device) chunk plan: shards the chunk rows split
-    #: across, the mesh shape, and the payload bytes of the finish-time
-    #: statistics allreduce (docs/PARTITIONING.md; 1/()/0 = single-device).
+    #: Partitioned (multi-device) chunk plan: row shards the chunk rows
+    #: split across, feature-block (model) shards of a 2-D layout, the
+    #: mesh shape, and the payload bytes of the finish-time statistics
+    #: reductions (docs/PARTITIONING.md; 1/()/0 = single-device).
+    #: ``collective_bytes`` totals both axes; the per-axis split and the
+    #: per-device carry bytes are what bench-diff exact-gates.
     shards: int = 1
+    model_shards: int = 1
     mesh_shape: Tuple[int, ...] = ()
     collective_bytes: int = 0
+    collective_bytes_data: int = 0
+    collective_bytes_model: int = 0
+    state_bytes_per_device: int = 0
     #: Durable-fit evidence (docs/RELIABILITY.md "Durable fits"):
     #: mid-stream checkpoints committed, the absolute chunk a crashed
     #: fit resumed from (None = fresh), chunks re-ingested by resume or
@@ -349,7 +356,10 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
 
     key = tuple(id(m) for m in members) + (id(step_fn),)
     if partition is not None:
-        key += ("sharded", id(partition.mesh), partition.shards)
+        key += (
+            "sharded", id(partition.mesh), partition.shards,
+            getattr(partition, "model_shards", 1),
+        )
     with _step_cache_lock:
         if _STEP_JIT_CACHE is None:
             from collections import OrderedDict
@@ -385,9 +395,18 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.collectives import shard_map as _smap
+        from ..parallel.mesh import MODEL_AXIS
 
         mesh = partition.mesh
+        model_shards = getattr(partition, "model_shards", 1)
+        # Chunks shard rows over the ROW axes only (replicated over a
+        # model axis if present); the stacked carry's leading block axis
+        # additionally shards over ``model`` in a 2-D layout.
         spec = P(tuple(partition.mesh_axes))
+        carry_spec = P(
+            tuple(getattr(partition, "carry_axes", partition.mesh_axes))
+        )
+        block_step = getattr(step_fn, "model_block_step", None)
 
         def fused(carry, x_raw, y, mask):
             traces.append(())
@@ -402,7 +421,17 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
                 # m is this device's row slice of the mask, so an
                 # index-keyed step sees exactly its rows' absolute
                 # indices — per-shard sketch partials stay exact.
-                if needs_mask:
+                if model_shards > 1:
+                    # 2-D layout: this device accumulates only its
+                    # feature block — the step's blocked protocol takes
+                    # the (traced) model-axis position and slices its own
+                    # columns out of the full-width featurized chunk.
+                    j = jax.lax.axis_index(MODEL_AXIS)
+                    if needs_mask:
+                        c1 = block_step(c0, feats, yb, m, j)
+                    else:
+                        c1 = block_step(c0, feats, yb, j)
+                elif needs_mask:
                     c1 = step_fn(c0, feats, yb, m)
                 else:
                     c1 = step_fn(c0, feats, yb)
@@ -410,7 +439,8 @@ def _shared_step_jit(members: tuple, step_fn, partition=None):
 
             new_carry = _smap(
                 local, mesh=mesh,
-                in_specs=(spec, spec, spec, spec), out_specs=spec,
+                in_specs=(carry_spec, spec, spec, spec),
+                out_specs=carry_spec,
             )(carry, x_raw, y, mask)
             leaf = jax.tree_util.tree_leaves(new_carry)[0]
             probe = leaf.ravel()[:1]
@@ -458,6 +488,82 @@ def _stack_carry(carry, shards: int, sharding):
         return jax.device_put(z.at[0].set(a), sharding)
 
     return jax.tree_util.tree_map(stack, carry)
+
+
+def _carry_layout(step_fn, carry) -> Optional[Tuple[Optional[int], ...]]:
+    """The blocked-carry protocol's per-leaf feature axes, validated
+    against the actual carry structure — ``None`` when the step doesn't
+    declare the protocol or the declaration doesn't match the carry."""
+    import jax
+
+    layout = getattr(step_fn, "model_layout", None)
+    if layout is None or getattr(step_fn, "model_block_step", None) is None:
+        return None
+    leaves = jax.tree_util.tree_leaves(carry)
+    if len(leaves) != len(layout):
+        return None
+    return tuple(layout)
+
+
+def _stack_carry_2d(carry, row_shards: int, model_shards: int, layout, sharding):
+    """2-D per-device carry blocks: leading axis ``row_shards ×
+    model_shards`` sharded over ``(row axes, model)`` — flat block index
+    ``data_idx·model_shards + model_idx``, row-major. Feature leaves
+    (``layout`` axis int) split into model blocks; the SEED therefore
+    lands spread over blocks 0..model_shards−1 (data row 0). Feature-free
+    leaves (``layout`` None) keep full shape per block and seed only
+    block 0 — the finish reduce SUMS them across both axes, so the
+    additive contract holds leaf-wise."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    total = row_shards * model_shards
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+
+    def stack(a, ax):
+        a = jnp.asarray(a)
+        if ax is None:
+            z = jnp.zeros((total,) + tuple(a.shape), a.dtype)
+            return jax.device_put(z.at[0].set(a), sharding)
+        b = a.shape[ax] // model_shards
+        block_shape = a.shape[:ax] + (b,) + a.shape[ax + 1:]
+        z = jnp.zeros((total,) + block_shape, a.dtype)
+        for j in range(model_shards):
+            blk = lax.slice_in_dim(a, j * b, (j + 1) * b, axis=ax)
+            z = z.at[j].set(blk)
+        return jax.device_put(z, sharding)
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [stack(a, ax) for a, ax in zip(leaves, layout)]
+    )
+
+
+def _merge_blocks(carry, row_shards: int, model_shards: int, layout, np_mod):
+    """Reduce a stacked ``(row_shards·model_shards, …)`` carry back to the
+    estimator's single-device shape: partials SUM across the data axis;
+    feature leaves then CONCATENATE their model blocks along the layout
+    axis, feature-free leaves sum (only model block 0 accumulated them).
+    ``np_mod`` is numpy for host merges (checkpoints, salvage) or
+    jax.numpy for the on-device finish reduce."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(carry)
+    if layout is None:
+        layout = (None,) * len(leaves)
+
+    def merge(a, ax):
+        a = np_mod.asarray(a)
+        a = a.reshape((row_shards, model_shards) + a.shape[1:]).sum(axis=0)
+        if ax is None or model_shards == 1:
+            return a.sum(axis=0) if ax is None else a[0]
+        return np_mod.concatenate(
+            [a[j] for j in range(model_shards)], axis=ax
+        )
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [merge(a, ax) for a, ax in zip(leaves, layout)]
+    )
 
 
 def _labels_host(labels: Dataset):
@@ -578,6 +684,13 @@ class ChunkStream:
         carry = init_fn(feat_aval, y_spec)
 
         part = self.partition
+        if part is not None and getattr(part, "model_shards", 1) > 1:
+            # The plan granted the model axis optimistically (raw-width
+            # proxy); re-validate against the REAL carry the estimator
+            # built — the step's blocked protocol, the featurized width's
+            # divisibility, and the width floor — and demote to row-only
+            # (same mesh, replicated over model) when any fail.
+            part = self._validate_model_axis(part, step_fn, carry)
         durable = self.durable
         sharding = None
         # Shard-loss recovery must be able to re-add the fold's seed when
@@ -591,7 +704,16 @@ class ChunkStream:
             from ..parallel.partitioner import NamedShardingCache
 
             sharding = NamedShardingCache.get(part.mesh, part.mesh_axes)
-            carry = _stack_carry(carry, part.shards, sharding)
+            if part.model_shards > 1:
+                carry_sharding = NamedShardingCache.get(
+                    part.mesh, part.carry_axes
+                )
+                carry = _stack_carry_2d(
+                    carry, part.shards, part.model_shards,
+                    _carry_layout(step_fn, carry), carry_sharding,
+                )
+            else:
+                carry = _stack_carry(carry, part.shards, sharding)
 
         _quiet_unused_donation_warnings()  # carries are donated each step
         step, traces = _shared_step_jit(self.members, step_fn, part)
@@ -612,7 +734,16 @@ class ChunkStream:
             num_examples=n,
             prefetch_depth=self.prefetch,
             shards=part.shards if part is not None else 1,
+            model_shards=part.model_shards if part is not None else 1,
             mesh_shape=tuple(part.mesh_shape) if part is not None else (),
+            # The acceptance number for 2-D layouts: bytes of streamed
+            # solver state each device actually holds — shrinks with
+            # model shards while the row-only plan replicates it.
+            state_bytes_per_device=(
+                _tree_nbytes(carry) // part.total_shards
+                if part is not None
+                else _tree_nbytes(carry)
+            ),
         )
         if start_chunk:
             # Crash-resume: chunks before the cursor live in the seeded
@@ -722,11 +853,16 @@ class ChunkStream:
             host = jax.device_get(carry)
             if part is not None:
                 # Per-shard partials merge via the additive contract into
-                # a mesh-INDEPENDENT snapshot: resume may re-plan on any
-                # mesh shape. Operates on the already-fetched HOST tree,
-                # never a device array.  # keystone: allow-sync
-                host = jax.tree_util.tree_map(
-                    lambda a: np.asarray(a).sum(axis=0), host
+                # a mesh-INDEPENDENT snapshot (rows summed, feature
+                # blocks reassembled): resume may re-plan on any mesh
+                # shape, 1-D or 2-D. Operates on the already-fetched HOST
+                # tree, never a device array.  # keystone: allow-sync
+                host = _merge_blocks(
+                    host, part.shards, part.model_shards,
+                    _carry_layout(step_fn, host)
+                    if part.model_shards > 1
+                    else None,
+                    np,
                 )
             ok = durable.commit(
                 tuple(
@@ -738,6 +874,7 @@ class ChunkStream:
                 chunk_rows=chunk_rows,
                 mesh_shape=tuple(part.mesh_shape) if part is not None else (),
                 shards=part.shards if part is not None else 1,
+                model_shards=part.model_shards if part is not None else 1,
             )
             if ok:
                 report.checkpoints += 1
@@ -763,10 +900,13 @@ class ChunkStream:
                     # observing a device gone from the mesh before this
                     # chunk could dispatch — the elastic recovery below
                     # owns it.
+                    # Indexed over ALL carry blocks (row × model shards,
+                    # flat row-major) so a seeded fault can land on
+                    # either axis of a 2-D layout.
                     raise ShardLossError(
-                        shard_loss_index(part.shards),
+                        shard_loss_index(part.total_shards),
                         start_chunk + dispatched,
-                        part.shards,
+                        part.total_shards,
                     ) from exc
             probe("streaming.chunk")
             if not report.chunks and _cost.current_frame() is not None:
@@ -855,13 +995,16 @@ class ChunkStream:
                         queue_peak = max(queue_peak, queue.peak_live_bytes)
                     break
                 if part is not None:
-                    # THE cross-shard collective of the whole fit: sum
-                    # the per-device partial statistics once, at finish
-                    # — O(d²) payload independent of how many chunks
-                    # streamed (docs/PARTITIONING.md). Unconditional on
-                    # chunk count: the stacked carry must ALWAYS come
-                    # back to the estimator's single-device shape (a
-                    # zero-chunk fold reduces to the seeded init carry).
+                    # THE cross-shard reduction of the whole fit, once at
+                    # finish — O(d²) payload independent of how many
+                    # chunks streamed (docs/PARTITIONING.md): partials
+                    # SUM across the data axis; a 2-D layout then
+                    # reassembles the feature blocks across the model
+                    # axis (concat for feature leaves, sum for the
+                    # feature-free remainder). Unconditional on chunk
+                    # count: the stacked carry must ALWAYS come back to
+                    # the estimator's single-device shape (a zero-chunk
+                    # fold reduces to the seeded init carry).
                     import jax.numpy as jnp
 
                     from ..parallel.partitioner import (
@@ -869,13 +1012,50 @@ class ChunkStream:
                         record_imbalance,
                     )
 
-                    carry = jax.tree_util.tree_map(
-                        lambda a: jnp.sum(a, axis=0), carry
+                    p_m = part.model_shards
+                    layout = (
+                        _carry_layout(step_fn, carry) if p_m > 1 else None
+                    )
+                    carry = _merge_blocks(
+                        carry, part.shards, p_m, layout, jnp
                     )
                     if report.chunks:
+                        # Per-axis accounting, plan-pure: with reduced
+                        # leaf bytes split into feature (B_f, sharded
+                        # over model) and remainder (B_r, replicated),
+                        # each device block holds B_f/p_m + B_r. The
+                        # data-axis sum moves one block per non-root row
+                        # shard per model column; the model-axis
+                        # reassembly moves one block per non-root model
+                        # column. At p_m = 1 the data term reduces to
+                        # the historical bytes × (shards − 1).
                         reduced = _tree_nbytes(carry)
-                        report.collective_bytes = reduced * (part.shards - 1)
-                        record_collective_bytes(report.collective_bytes)
+                        if layout is not None:
+                            leaves = jax.tree_util.tree_leaves(carry)
+                            b_f = sum(
+                                leaf.nbytes
+                                for leaf, ax in zip(leaves, layout)
+                                if ax is not None
+                            )
+                        else:
+                            b_f = reduced
+                        b_r = reduced - b_f
+                        report.collective_bytes_data = (
+                            b_f + p_m * b_r
+                        ) * (part.shards - 1)
+                        report.collective_bytes_model = (
+                            b_f // p_m + b_r
+                        ) * (p_m - 1)
+                        report.collective_bytes = (
+                            report.collective_bytes_data
+                            + report.collective_bytes_model
+                        )
+                        record_collective_bytes(
+                            report.collective_bytes_data, axis="data"
+                        )
+                        record_collective_bytes(
+                            report.collective_bytes_model, axis="model"
+                        )
                         record_imbalance(
                             "fit_stream", n, len(windows) * chunk_rows
                         )
@@ -935,6 +1115,57 @@ class ChunkStream:
         }
         return carry, info
 
+    def _validate_model_axis(self, part, step_fn, carry):
+        """Fold-time re-validation of an optimistically-granted model
+        axis against ground truth the planner lacked: the step function's
+        blocked-carry protocol and the REAL featurized width sitting in
+        the estimator's init carry. Any failure demotes to the row-only
+        layout on the SAME mesh (``demote_model_axis`` — chunk geometry
+        and the armed durable cursor stay valid); a demotion that leaves
+        no row axis to shard returns ``None`` (single-device fold)."""
+        import jax
+
+        from ..parallel.partitioner import (
+            R_BELOW_WIDTH_FLOOR,
+            R_MODEL_INDIVISIBLE,
+            demote_model_axis,
+            partition_min_width_per_shard,
+        )
+
+        p_m = part.model_shards
+        layout = _carry_layout(step_fn, carry)
+        reason = detail = ""
+        if layout is None or all(ax is None for ax in layout):
+            reason = R_MODEL_INDIVISIBLE
+            detail = (
+                f"step {getattr(step_fn, '__name__', type(step_fn).__name__)}"
+                " declares no blocked-carry protocol"
+            )
+        else:
+            leaves = jax.tree_util.tree_leaves(carry)
+            widths = {
+                leaf.shape[ax]
+                for leaf, ax in zip(leaves, layout)
+                if ax is not None
+            }
+            width = max(widths)
+            if any(w % p_m for w in widths):
+                reason = R_MODEL_INDIVISIBLE
+                detail = (
+                    f"featurized width {sorted(widths)} not divisible by "
+                    f"{p_m} model shards"
+                )
+            elif width < p_m * partition_min_width_per_shard():
+                reason = R_BELOW_WIDTH_FLOOR
+                detail = (
+                    f"featurized width {width} < {p_m} shards × "
+                    f"{partition_min_width_per_shard()} min cols/shard"
+                )
+        if not reason:
+            return part
+        demoted = demote_model_axis(part, reason, detail)
+        return demoted if demoted.eligible else None
+
     def _salvage_shard_loss(
         self,
         loss,
@@ -976,31 +1207,55 @@ class ChunkStream:
         from ..reliability.recovery import get_recovery_log
 
         label = f"fit_stream[{len(self.members)}ops]"
-        lost, old_shards = loss.lost_shard, part.shards
+        lost, old_rows, p_m = loss.lost_shard, part.shards, part.model_shards
+        # The flat block index is row-major over (data, model): a loss on
+        # EITHER axis maps to one data row-group, and the whole group is
+        # dropped — with feature-sharded blocks no single column holds a
+        # complete partial, so group-mates of a lost device contribute
+        # nothing usable on their own. Their rows are re-ingested below.
+        lost_row = lost // p_m
         get_recovery_log().record(
             "shard_loss",
             label,
             lost_shard=lost,
-            shards=old_shards,
+            shards=part.total_shards,
             chunk_index=loss.chunk_index,
         )
         _names.metric(_names.DURABLE_SHARD_LOSSES).inc()
         report.shard_losses += 1
 
         # Surviving per-shard partials, merged once on host (O(d²) — the
-        # same additive algebra the finish-time reduce runs).
+        # same additive algebra the finish-time reduce runs): sum the
+        # surviving data row-groups, then reassemble feature blocks
+        # across the model axis.
         # keystone: allow-sync
         host_blocks = jax.device_get(carry)
+        layout = _carry_layout(step_fn, host_blocks) if p_m > 1 else None
+        leaves, treedef = jax.tree_util.tree_flatten(host_blocks)
+        if layout is None:
+            layout = (None,) * len(leaves)
 
-        def merge(a):
+        def merge(a, ax):
             # Already device_get above — host data.  # keystone: allow-sync
             a = np.asarray(a)
-            keep = [a[i] for i in range(old_shards) if i != lost]
-            return np.sum(np.stack(keep), axis=0)
+            a = a.reshape((old_rows, p_m) + a.shape[1:])
+            keep = [i for i in range(old_rows) if i != lost_row]
+            summed = (
+                a[keep].sum(axis=0) if keep else np.zeros_like(a[0])
+            )  # (p_m, …)
+            if ax is None:
+                return summed.sum(axis=0)
+            if p_m == 1:
+                return summed[0]
+            return np.concatenate([summed[j] for j in range(p_m)], axis=ax)
 
-        surviving = jax.tree_util.tree_map(merge, host_blocks)
-        if lost == 0:
-            # Block 0 carried the fold's seed; it survives on the host.
+        surviving = jax.tree_util.tree_unflatten(
+            treedef, [merge(a, ax) for a, ax in zip(leaves, layout)]
+        )
+        if lost_row == 0:
+            # Data row-group 0 carried the fold's seed (spread over its
+            # feature blocks in a 2-D layout) and the whole group was
+            # dropped; the seed survives on the host.
             if attempt_seed_host is None:
                 # keystone: allow-sync
                 attempt_seed_host = jax.device_get(seed_carry_dev)
@@ -1010,14 +1265,15 @@ class ChunkStream:
                 attempt_seed_host,
             )
 
-        # Rows only the lost shard had absorbed: shard i held padded rows
-        # [i·rps, (i+1)·rps) of each chunk, so the lost LOGICAL rows of a
-        # window (s, e) are the contiguous [s+lost·rps, min(s+(lost+1)·rps, e)).
+        # Rows only the lost row-group had absorbed: group i held padded
+        # rows [i·rps, (i+1)·rps) of each chunk, so the lost LOGICAL rows
+        # of a window (s, e) are the contiguous
+        # [s+lost_row·rps, min(s+(lost_row+1)·rps, e)).
         recovery: List[Tuple[int, int]] = []
         for (s, e, shards_f, cr_f) in folded_log:
             rps = cr_f // shards_f
-            lo = s + lost * rps
-            hi = min(s + (lost + 1) * rps, e)
+            lo = s + lost_row * rps
+            hi = min(s + (lost_row + 1) * rps, e)
             if lo < hi:
                 recovery.append((lo, hi))
         remaining = list(attempt_windows[dispatched:])
@@ -1042,6 +1298,9 @@ class ChunkStream:
             carry = jax.tree_util.tree_map(jnp.asarray, surviving)
         step, traces = _shared_step_jit(self.members, step_fn, new_part)
         report.shards = new_part.shards if new_part is not None else 1
+        report.model_shards = (
+            new_part.model_shards if new_part is not None else 1
+        )
         report.mesh_shape = (
             tuple(new_part.mesh_shape) if new_part is not None else ()
         )

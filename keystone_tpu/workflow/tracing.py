@@ -183,6 +183,10 @@ def timed_execute(op, deps):
                 "mesh_shape", "x".join(str(s) for s in partition.mesh_shape)
             )
             sp.set_attribute("partition_spec", partition.spec)
+            sp.set_attribute(
+                "model_shards",
+                int(getattr(partition, "model_shards", 1) or 1),
+            )
         try:
             if frame is not None:
                 # Compile events during the forcing mark the wall as

@@ -834,14 +834,21 @@ def _partition_diagnostics(
         else:
             target = op.estimator if streaming else op
             opt_out = getattr(target, "partitionable", True) is False
+            # Same inputs the plan rule feeds the partitioner: the raw
+            # upstream width as the featurized-width proxy, and the
+            # estimator's 2-D protocol opt-in.
+            model_ok = getattr(target, "supports_model_axis", False)
+            width = _width(in_spec)
             if streaming:
                 decision = part.decide_stream(
                     label, op.chunk_rows or stream_chunk_rows(), rows=rows,
                     record=False, opt_out=opt_out,
+                    width=width, model_ok=model_ok,
                 )
             else:
                 decision = part.decide_fit(
-                    label, rows, record=False, opt_out=opt_out
+                    label, rows, record=False, opt_out=opt_out,
+                    width=width, model_ok=model_ok,
                 )
         report.partition.append(decision.to_json())
         if not decision.eligible:
@@ -879,20 +886,33 @@ def _partition_diagnostics(
         k = 1
         if len(deps) > 1:
             k = _width(interp.specs.get(deps[1])) or 1
-        stat_bytes = 2 * 4 * (d * d + d * k + d + k) if d else 0
+        # 2-D layouts block the feature-indexed statistics (Gram rows,
+        # cross-product rows, feature sums) over the model axis — only
+        # the label-sized remainder stays replicated per model shard.
+        p_m = max(1, int(getattr(decision, "model_shards", 1) or 1))
+        stat_bytes = 2 * 4 * ((d * d + d * k + d) // p_m + k) if d else 0
         per_device = slice_bytes + stat_bytes
         if per_device > memory_limit:
+            axis_hint = (
+                "raise KEYSTONE_PARTITION_MODEL_SHARDS or use the "
+                "sketched tier"
+                if p_m > 1
+                else "sharding divides rows, not the O(d²) state; use "
+                "the sketched tier or a model-axis layout"
+            )
             interp.diag(
                 "KV304",
-                f"{label}: sharded over {decision.shards} devices the "
+                f"{label}: sharded over {decision.shards}"
+                + (f"×{p_m}" if p_m > 1 else "")
+                + " devices the "
                 f"per-device residency is still ~{per_device / 1e9:.2f} GB "
-                f"(row slice {slice_bytes / 1e9:.2f} GB + replicated "
-                f"statistics {stat_bytes / 1e9:.2f} GB) against a "
-                f"{memory_limit / 1e9:.2f} GB budget — sharding divides "
-                "rows, not the O(d²) state; use the sketched tier or a "
-                "model-axis layout",
+                f"(row slice {slice_bytes / 1e9:.2f} GB + "
+                + ("feature-blocked" if p_m > 1 else "replicated")
+                + f" statistics {stat_bytes / 1e9:.2f} GB) against a "
+                f"{memory_limit / 1e9:.2f} GB budget — " + axis_hint,
                 node=node,
                 shards=decision.shards,
+                model_shards=p_m,
                 per_device_bytes=per_device,
                 memory_limit=memory_limit,
             )

@@ -1,0 +1,143 @@
+#!/usr/bin/env bash
+# 2-D shard smoke test: the model-axis invariants behind the data × model
+# partitioner (docs/PARTITIONING.md "2-D layouts"), on 8 virtual CPU
+# devices:
+#   1. PARITY — the SAME streamed pipeline fit on 1-device / 1×8 / 2×4
+#      meshes matches the 1-device reference to rel_err <= 1e-5 with
+#      ZERO steady-state XLA compiles;
+#   2. RESIDENCY — per-device peak Gram/sketch state bytes SHRINK with
+#      the model shard count (the point of feature-sharding);
+#   3. WIDE — a d >= 32768 streamed wide fit runs feature-sharded on the
+#      sketched rung (2×4) with bounded per-device state;
+#   4. FALLBACK — a seeded indivisible model request demotes to the
+#      row-only layout with the reason recorded in the plan report.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+export KEYSTONE_STREAM_CHUNK_ROWS=64
+export KEYSTONE_PARTITION_MIN_WIDTH=8
+
+timeout -k 10 420 python - <<'EOF'
+import os
+import numpy as np
+
+import jax
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.sketch.solvers import SketchedLeastSquaresEstimator
+from keystone_tpu.parallel.partitioner import (
+    last_partition_report, partition_disabled,
+)
+from keystone_tpu.workflow.executor import PipelineEnv
+from keystone_tpu.workflow.pipeline import BatchTransformer
+from keystone_tpu.workflow.streaming import last_stream_report
+
+assert len(jax.devices()) == 8, jax.devices()
+CHUNK, N, D, K = 64, 8 * 64, 64, 3
+rng = np.random.default_rng(0)
+x = rng.normal(size=(N, D)).astype(np.float32)
+w = rng.normal(size=(D, K)).astype(np.float32)
+y = (x @ w + 0.01 * rng.normal(size=(N, K))).astype(np.float32)
+
+
+class Scale(BatchTransformer):
+    def __init__(self, c):
+        self.c = float(c)
+
+    def apply_arrays(self, a):
+        return a * self.c
+
+
+def rel_err(a, b):
+    return float(np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-30))
+
+
+def build(est=None, xx=None, yy=None):
+    est = est or LinearMapEstimator(reg=1e-3)
+    return Scale(2.0).to_pipeline().then_label_estimator(
+        est, ArrayDataset(x if xx is None else xx),
+        ArrayDataset(y if yy is None else yy),
+    )
+
+
+# ---- 1+2. parity across mesh shapes, residency shrinks with p_m -------
+with partition_disabled():
+    PipelineEnv.reset()
+    ref = np.asarray(build().fit().apply_batch(ArrayDataset(x[:32])).data)
+
+state = {}
+for p_m, shape in ((1, (8,)), (8, (1, 8)), (4, (2, 4))):
+    os.environ["KEYSTONE_PARTITION_MODEL_SHARDS"] = str(p_m)
+    PipelineEnv.reset()
+    fitted = build().fit()
+    rep = last_stream_report()
+    assert rep.mesh_shape == shape, (p_m, rep.mesh_shape)
+    assert rep.model_shards == p_m, rep.model_shards
+    assert rep.compiles_steady_state == 0, rep.compiles_steady_state
+    preds = np.asarray(fitted.apply_batch(ArrayDataset(x[:32])).data)
+    r = rel_err(preds, ref)
+    assert r <= 1e-5, f"parity {r} at model_shards={p_m}"
+    state[p_m] = rep.state_bytes_per_device
+    print(f"PASS mesh={'x'.join(map(str, shape))}: parity={r:.2e} "
+          f"state_bytes_per_device={rep.state_bytes_per_device} "
+          f"collective=({rep.collective_bytes_data},"
+          f"{rep.collective_bytes_model}) steady_compiles=0")
+assert state[1] > state[4] > state[8], state
+# the FEATURE state (everything but the K-sized replicated remainder)
+# divides exactly by the model shard count
+b_r = 4 * K
+assert state[1] - b_r == 4 * (state[4] - b_r) == 8 * (state[8] - b_r), state
+print(f"PASS residency: state_bytes_per_device {state[1]} -> "
+      f"{state[4]} -> {state[8]} shrinks with model shards")
+
+# ---- 3. d >= 32768 wide fit runs feature-sharded on the sketch rung ---
+D_WIDE = 32768
+os.environ["KEYSTONE_PARTITION_MODEL_SHARDS"] = "4"
+os.environ["KEYSTONE_SKETCH_SIZE"] = "256"     # keep the CPU solve small
+os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = "64"
+os.environ["KEYSTONE_STREAM_MIN_ROWS"] = "1"   # stream despite few rows
+n_wide = 128
+xw = rng.normal(size=(n_wide, D_WIDE)).astype(np.float32)
+ww = rng.normal(size=(D_WIDE, K)).astype(np.float32) / np.sqrt(D_WIDE)
+yw = (xw @ ww).astype(np.float32)
+PipelineEnv.reset()
+fitted_w = build(
+    est=SketchedLeastSquaresEstimator(reg=1e-3), xx=xw, yy=yw
+).fit()
+rep_w = last_stream_report()
+assert rep_w.chunks == 2, rep_w.chunks  # 128 rows / 64-row chunks
+assert rep_w.mesh_shape == (2, 4), rep_w.mesh_shape
+assert rep_w.model_shards == 4, rep_w.model_shards
+assert rep_w.compiles_steady_state == 0, rep_w.compiles_steady_state
+# sketch carry (SA s×d + Σx d dominate) feature-shards 4 ways
+full_leaves = 4 * (256 * D_WIDE + 256 * K + 256 + D_WIDE + K)
+assert rep_w.state_bytes_per_device < full_leaves // 3, (
+    rep_w.state_bytes_per_device, full_leaves)
+preds_w = np.asarray(fitted_w.apply_batch(ArrayDataset(xw[:16])).data)
+assert np.isfinite(preds_w).all()
+print(f"PASS wide: d={D_WIDE} mesh=2x4 sketch-rung "
+      f"state_bytes_per_device={rep_w.state_bytes_per_device} "
+      f"steady_compiles=0")
+del os.environ["KEYSTONE_SKETCH_SIZE"]
+del os.environ["KEYSTONE_STREAM_MIN_ROWS"]
+
+# ---- 4. seeded indivisible model request demotes with a reason --------
+os.environ["KEYSTONE_PARTITION_MODEL_SHARDS"] = "3"  # does not divide 8
+os.environ["KEYSTONE_STREAM_CHUNK_ROWS"] = "64"
+PipelineEnv.reset()
+fitted_fb = build().fit()
+rep_fb = last_stream_report()
+assert rep_fb.shards == 8 and rep_fb.model_shards == 1, (
+    rep_fb.shards, rep_fb.model_shards)
+fallbacks = {d.model_fallback for d in last_partition_report()}
+assert "model-axis-indivisible" in fallbacks, fallbacks
+preds_fb = np.asarray(fitted_fb.apply_batch(ArrayDataset(x[:16])).data)
+assert rel_err(preds_fb, ref[:16]) <= 1e-5
+print("PASS fallback: reason=model-axis-indivisible rows-only shards=8")
+print("SHARD2D_SMOKE_OK")
+EOF

@@ -635,6 +635,18 @@ def test_docs_codes_sync():
     ]
     assert not missing, f"codes undocumented in docs/VERIFICATION.md: {missing}"
 
+    # The partitioner's reason keys are a documented surface too: every
+    # key must appear in docs/PARTITIONING.md's fallback matrix.
+    from keystone_tpu.parallel.partitioner import ALL_REASON_KEYS
+
+    pdoc = open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "..", "docs", "PARTITIONING.md"
+        )
+    ).read()
+    missing = [key for key in ALL_REASON_KEYS if f"`{key}`" not in pdoc]
+    assert not missing, f"reason keys undocumented in PARTITIONING.md: {missing}"
+
 
 def test_report_json_roundtrip():
     x, y = _xy(n=64, rows_y=32)
