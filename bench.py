@@ -1544,6 +1544,43 @@ def _bench_refit(small: bool) -> dict:
     return out
 
 
+def _bench_cosched(small: bool) -> dict:
+    """Cost-governed co-scheduler (docs/SCHEDULING.md): the same paced
+    serving trace and the same refit rounds run twice — serialized
+    (serve, THEN fold: the legacy two-phase mesh) and co-scheduled
+    (the fold admitted as a priced lease into the serving idle gaps),
+    with one seeded mid-fold preemption proving the chunk-boundary
+    contract (durable-cursor resume, exact parity with the unscheduled
+    serial chain).
+
+    Headline: ``cosched_vs_serial_ratio`` (<1 = co-residency beat
+    context-switching; bool-gated via ``cosched_faster`` — both walls
+    see the same ambient load). Exact-gated by bench-diff: leases,
+    preemptions, dropped requests (0), publishes, and the post-settle
+    steady-state serving compile count (0) — the schedule is
+    deterministic in its seed, so a changed count is a changed
+    admission policy."""
+    from keystone_tpu.sched.demo import CoschedDemoConfig, run_cosched_demo
+    from keystone_tpu.utils.compilation_cache import install_compile_counter
+
+    install_compile_counter()
+    config = CoschedDemoConfig(
+        d=16 if small else 32,
+        rows_per_round=4096 if small else 8192,
+        chunk_rows=512 if small else 1024,
+        serve_requests=64 if small else 96,
+        seed=0,
+    )
+    out = run_cosched_demo(config)
+    # Per-round detail and the full lease log are smoke-log material;
+    # the leg keeps counters + the headline ratio (the schedule stays
+    # under "obs", which bench-diff skips by key prefix).
+    out["outcomes"] = ",".join(
+        "/".join(r["outcomes"]) for r in out.pop("rounds")
+    )
+    return out
+
+
 def _bench_fusion(small: bool) -> dict:
     """Whole-pipeline fusion (docs/OPTIMIZER.md): an 8-node dense chain
     applied through a FittedPipeline both fused (ONE XLA dispatch per
@@ -2336,6 +2373,7 @@ def _workload_registry() -> dict:
         "sharded2d": _bench_sharded2d,
         "sketched": _bench_sketched,
         "refit": _bench_refit,
+        "cosched": _bench_cosched,
         "serving": _bench_serving,
         "serving_multiworker": _bench_serving_multiworker,
         "serving_autoscale": _bench_serving_autoscale,

@@ -230,6 +230,13 @@ def add_explain_arguments(parser: argparse.ArgumentParser) -> None:
         "--json", action="store_true", dest="as_json",
         help="print EXPLAIN_JSON: line instead of the human table",
     )
+    parser.add_argument(
+        "--schedule", action="store_true",
+        help="run the co-scheduled serving+refit demo and print the mesh "
+        "schedule instead: per lease — who ran, what displaced or "
+        "deferred it, predicted vs measured wall, price provenance "
+        "(docs/SCHEDULING.md)",
+    )
 
 
 def add_tune_arguments(parser: argparse.ArgumentParser) -> None:
@@ -561,6 +568,10 @@ def main(argv: Optional[list] = None) -> int:
         print(
             f"{'refit':28s} continuous-refit loop: incremental retrain + "
             "shadow eval + auto-rollback"
+        )
+        print(
+            f"{'explain --schedule':28s} mesh co-scheduler: serving + "
+            "leased background folds on one mesh, preempt/resume proof"
         )
         print(
             f"{'fit':28s} durable streamed fit: mid-stream checkpoints + "

@@ -84,6 +84,11 @@ _EXACT_SUBSTRINGS = (
     # is a changed state layout, not noise. (Matched before the skip
     # list's generic "bytes".)
     "state_bytes",
+    # Co-scheduler invariants (docs/SCHEDULING.md): the cosched leg's
+    # seeded pressure window admits, defers, preempts, and resumes
+    # EXACTLY the same leases every run — a changed count is a changed
+    # admission policy, not noise.
+    "leases", "preemptions",
 )
 _SKIP_SUBSTRINGS = (
     # Environment-dependent measurements no two runs share: compile

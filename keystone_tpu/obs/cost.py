@@ -696,6 +696,35 @@ def note_stream_result(
     frame.num_examples = num_examples
 
 
+def note_lease_result(
+    name: str,
+    kind: str,
+    predicted_s: Optional[float],
+    measured_s: Optional[float],
+    source: str,
+) -> None:
+    """The mesh scheduler joins a retired lease's predicted wall (by
+    pricing provenance — tune/store/roofline/default) to the wall it
+    measured, inside whatever harvest frame is open: ``explain`` and the
+    bench legs read the observatory, not the scheduler's internals
+    (docs/SCHEDULING.md "Observability")."""
+    frame = current_frame()
+    if frame is None:
+        return
+    leases = getattr(frame, "leases", None)
+    if leases is None:
+        leases = frame.leases = []  # type: ignore[attr-defined]
+    leases.append(
+        {
+            "name": name,
+            "kind": kind,
+            "predicted_s": predicted_s,
+            "measured_s": measured_s,
+            "source": source,
+        }
+    )
+
+
 # --------------------------------------------------------------- the sentinel
 
 

@@ -158,6 +158,12 @@ REFIT_STATE_ROWS = "keystone_refit_state_rows"
 REFIT_FOLD_SECONDS = "keystone_refit_fold_seconds"
 REFIT_SCORE = "keystone_refit_score"
 
+# ----------------------------------------------------------- mesh co-scheduler
+SCHED_LEASES = "keystone_sched_leases_total"
+SCHED_IDLE_HARVEST_SECONDS = "keystone_sched_idle_harvest_seconds_total"
+SCHED_LEASE_WALL_RATIO = "keystone_sched_lease_wall_ratio"
+SCHED_REFIT_INTERVAL_SECONDS = "keystone_sched_refit_interval_seconds"
+
 # --------------------------------------------------------------- fleet tracing
 FLEET_SPAN_FRAGMENTS = "keystone_fleet_span_fragments_total"
 FLEET_TRACE_BYTES = "keystone_fleet_trace_bytes_total"
@@ -301,6 +307,10 @@ SCHEMA: Dict[str, Tuple] = {
     REFIT_STATE_ROWS: ("gauge", "Examples absorbed into the persisted refit sufficient statistics", ()),
     REFIT_FOLD_SECONDS: ("histogram", "Incremental refit folds (drain + fold + finish wall time)", ()),
     REFIT_SCORE: ("gauge", "Latest shadow-evaluation score, per role (candidate/incumbent/live)", ("role",)),
+    SCHED_LEASES: ("counter", "Mesh-scheduler leases, by work kind and outcome (admitted/deferred/preempted/resumed/completed)", ("kind", "outcome")),
+    SCHED_IDLE_HARVEST_SECONDS: ("counter", "Serving idle-gap seconds harvested by admitted background leases", ()),
+    SCHED_LEASE_WALL_RATIO: ("histogram", "Measured / predicted lease wall, by price provenance (tune/store/roofline/default); >1 = lease ran slower than priced", ("source",), "ratio"),
+    SCHED_REFIT_INTERVAL_SECONDS: ("gauge", "Last pressure-aware refit cadence chosen by the scheduler-governed daemon loop", ()),
     FLEET_SPAN_FRAGMENTS: ("counter", "Span fragments folded into the fleet trace collector, per shipping process role", ("role",)),
     FLEET_TRACE_BYTES: ("counter", "Serialized span-fragment bytes shipped over the heartbeat channel", ()),
     FLEET_CLOCK_SKEW: ("gauge", "Estimated per-process wall-clock offset vs the collector at heartbeat receipt", ("role",)),

@@ -157,6 +157,19 @@ class RefitConfig:
     max_journal_replays: int = 3
     #: persisted-state key in the checkpoint store.
     state_key: str = "refit-state"
+    #: scheduled-path chunk policy: under a MeshScheduler, let the
+    #: roofline placement (or a tuned ProfileStore entry) choose
+    #: chunk_rows/prefetch instead of the static default
+    #: (KEYSTONE_SCHED_AUTO_CHUNKS; docs/SCHEDULING.md "Pricing").
+    auto_chunk_rows: bool = field(
+        default_factory=lambda: env_flag("KEYSTONE_SCHED_AUTO_CHUNKS", False)
+    )
+    #: cursor cadence for SCHEDULED folds (chunks between commits): the
+    #: preemption contract needs a committable cursor even on folds far
+    #: below the durable auto-arm row threshold.
+    sched_ckpt_every: int = field(
+        default_factory=lambda: env_int("KEYSTONE_SCHED_CKPT_EVERY", 1)
+    )
 
 
 class RefitDaemon:
@@ -172,10 +185,18 @@ class RefitDaemon:
         config: Optional[RefitConfig] = None,
         partition: Any = None,
         state: Optional[StreamState] = None,
+        scheduler: Any = None,
     ):
         self.estimator = estimator
         self.tap = tap
         self.publisher = publisher
+        #: optional sched.MeshScheduler: rounds become cost-priced
+        #: leases — admitted only into serving idle gaps, preempted at
+        #: chunk boundaries under sustained SLO pressure (the deferred
+        #: fold resumes from its durable cursor), and the sleep cadence
+        #: turns backlog/pressure-driven (docs/SCHEDULING.md).
+        self.scheduler = scheduler
+        self._last_preempted_chunk: Optional[int] = None
         #: reliability CheckpointStore for the stream state (None = the
         #: state lives only in this process).
         self.store = store
@@ -248,6 +269,26 @@ class RefitDaemon:
         self._rounds += 1
         round_index = self._rounds
         journal = self._load_journal()
+        if journal is not None and journal.get("deferred"):
+            # A scheduler preemption parked this batch mid-fold: a
+            # deferral is a PLANNED resume, not a crash replay — the
+            # attempts budget is untouched (satellite contract,
+            # docs/SCHEDULING.md "Preemption"). Re-admission goes back
+            # through the scheduler; still-pressured meshes keep the
+            # batch parked (the journal and the durable cursor survive).
+            lease = self._acquire_lease(
+                round_index,
+                rows=int(journal["x"].shape[0]),
+                resume_of=journal.get("lease"),
+            )
+            if lease is not None and not lease.admitted:
+                return self._outcome(
+                    "deferred", round_index, keep_journal=True,
+                    rows=int(journal["x"].shape[0]),
+                    displaced_by=lease.displaced_by,
+                )
+            journal.pop("deferred", None)
+            return self._resume_from_journal(journal, round_index, lease=lease)
         if journal is not None:
             # A previous round died mid-flight (kill between drain and
             # outcome). Its rows left the tap when they were drained —
@@ -283,11 +324,49 @@ class RefitDaemon:
             )
             return self._outcome("skipped_nodata", round_index, rows=depth)
 
+        # Admission BEFORE drain: a deferred fresh round costs nothing —
+        # the rows stay in the tap (bounded, drop-oldest) and the
+        # pressure-aware cadence retries sooner as it fills.
+        lease = self._acquire_lease(
+            round_index, rows=min(depth, self.config.max_rows_per_round)
+        )
+        if lease is not None and not lease.admitted:
+            return self._outcome(
+                "deferred", round_index, rows=depth,
+                displaced_by=lease.displaced_by,
+            )
         drained = self.tap.drain(self.config.max_rows_per_round)
         if drained is None:  # raced another drainer
+            if lease is not None:
+                self.scheduler.release(lease)
             return self._outcome("skipped_nodata", round_index, rows=0)
         x, y = drained
-        return self._round_body(x, y, round_index)
+        return self._round_body(x, y, round_index, lease=lease)
+
+    def _acquire_lease(
+        self, round_index: int, rows: int, resume_of: Optional[str] = None
+    ):
+        """Price this round's fold and ask the scheduler for mesh time
+        (None when unscheduled — the legacy path, byte for byte)."""
+        if self.scheduler is None:
+            return None
+        from ..sched.scheduler import LeaseRequest
+
+        width = classes = 0
+        if self._state is not None:
+            meta = getattr(self._state, "meta", {}) or {}
+            width = int(meta.get("d", 0) or 0)
+            classes = int(meta.get("k", 0) or 0)
+        return self.scheduler.submit(
+            LeaseRequest(
+                name=f"{self.config.name}:round-{round_index}",
+                kind="refit_fold",
+                rows=int(rows),
+                width=width,
+                classes=classes,
+                resume_of=resume_of,
+            )
+        )
 
     # -------------------------------------------------------- round journal
     #
@@ -334,7 +413,7 @@ class RefitDaemon:
             self.store.delete(self._journal_key())
 
     def _resume_from_journal(
-        self, journal: Dict[str, Any], round_index: int
+        self, journal: Dict[str, Any], round_index: int, lease: Any = None
     ) -> str:
         phase = str(journal.get("phase"))
         get_recovery_log().record(
@@ -349,19 +428,23 @@ class RefitDaemon:
         if phase == "drained":
             # The fold may have half-applied (or fully applied but died
             # before the phase advanced): rewind to the journaled
-            # pre-fold snapshot so the re-fold is exactly once.
+            # pre-fold snapshot so the re-fold is exactly once. For a
+            # scheduler deferral the re-fold is still cheap: the durable
+            # cursor (armed in _fold) holds the committed prefix and the
+            # fold resumes mid-stream instead of from row zero.
             self._state = journal.get("state_before")
         return self._round_body(
             journal["x"], journal["y"], round_index,
             skip_fold=(phase == "folded"),
             attempts=int(journal.get("attempts", 0)),
             token=journal.get("token"),
+            lease=lease,
         )
 
     def _round_body(
         self, x: np.ndarray, y: np.ndarray, round_index: int,
         skip_fold: bool = False, attempts: int = 0,
-        token: Optional[str] = None,
+        token: Optional[str] = None, lease: Any = None,
     ) -> str:
         n = x.shape[0]
         eval_n = max(min(int(n * self.config.eval_fraction), n - 1), 1)
@@ -395,6 +478,7 @@ class RefitDaemon:
             )
 
         # ---------------------------------------------------- incremental fold
+        preempted_at: Optional[int] = None
         with _spans.span("refit:fold", rows=int(train_x.shape[0])):
             probe("refit.fold")
             t_fold = time.perf_counter()
@@ -403,23 +487,51 @@ class RefitDaemon:
                 # candidate from the persisted statistics alone.
                 candidate = self.estimator.finish_from_state(self._state)
             else:
-                candidate = self._fold(train_x, train_y)
-                self._state = self.estimator.export_stream_state()
-                if self.store is not None and self._state is not None:
-                    save_stream_state(
-                        self.store, self.config.state_key, self._state
-                    )
-                    self._save_journal(
-                        {
-                            "phase": "folded",
-                            "round": round_index,
-                            "x": x,
-                            "y": y,
-                            "attempts": attempts,
-                            "token": token,
-                        }
-                    )
+                candidate = self._fold(train_x, train_y, lease=lease)
+                preempted_at = self._last_preempted_chunk
+                if preempted_at is None:
+                    self._state = self.estimator.export_stream_state()
+                    if self.store is not None and self._state is not None:
+                        save_stream_state(
+                            self.store, self.config.state_key, self._state
+                        )
+                        self._save_journal(
+                            {
+                                "phase": "folded",
+                                "round": round_index,
+                                "x": x,
+                                "y": y,
+                                "attempts": attempts,
+                                "token": token,
+                            }
+                        )
             fold_s = time.perf_counter() - t_fold
+        if lease is not None:
+            self.scheduler.release(lease)
+        if preempted_at is not None:
+            # Preempted at a chunk boundary under sustained SLO
+            # pressure: the durable cursor holds the committed prefix.
+            # Park the batch back in the journal as a PLANNED resume
+            # (attempts untouched — not a crash) and leave self._state
+            # at the pre-fold snapshot so nothing partial publishes.
+            self._save_journal(
+                {
+                    "phase": "drained",
+                    "round": round_index,
+                    "x": x,
+                    "y": y,
+                    "state_before": self._state,
+                    "attempts": attempts,
+                    "token": token,
+                    "deferred": True,
+                    "lease": getattr(lease, "id", None),
+                }
+            )
+            return self._outcome(
+                "deferred", round_index, keep_journal=True,
+                preempted_at_chunk=preempted_at, fold_s=fold_s,
+                displaced_by=getattr(lease, "displaced_by", None),
+            )
         self._m_fold_s.observe(fold_s)
         self._m_state_rows.set(self.state_rows())
 
@@ -482,17 +594,38 @@ class RefitDaemon:
             version=ticket.version, state_decay=round(self.applied_decay, 4),
         )
 
-    def _fold(self, train_x: np.ndarray, train_y: np.ndarray):
+    def _fold(self, train_x: np.ndarray, train_y: np.ndarray, lease: Any = None):
         """Fold new rows into the stored statistics through the existing
-        chunked (optionally sharded) fit_stream plan."""
+        chunked (optionally sharded) fit_stream plan.
+
+        Under a scheduler lease the fold also becomes *preemptible*: a
+        durable cursor (PR-15) is armed so every chunk boundary commits
+        the fold prefix, and the lease's ``should_yield`` is consulted
+        between chunks — sustained SLO pressure stops the fold at the
+        boundary with the cursor intact (``self._last_preempted_chunk``
+        carries the boundary out to ``_round_body``).
+        """
         from ..data.dataset import ArrayDataset
         from ..workflow.streaming import ChunkStream
 
+        self._last_preempted_chunk = None
+        chunk_rows = self.config.chunk_rows
+        if self.scheduler is not None and self.config.auto_chunk_rows:
+            # Roofline-priced chunk geometry for the scheduled path: a
+            # memory-bound fold wants larger chunks (fewer dispatch
+            # boundaries per byte moved) up to the residency budget —
+            # replacing the static default on this path only.
+            chunk_rows, _prefetch, _src = self.scheduler.chunk_rows_for(
+                rows=len(train_x),
+                width=int(train_x.shape[1]),
+                classes=int(train_y.shape[1]) if train_y.ndim > 1 else 1,
+                default=self.config.chunk_rows,
+            )
         stream = ChunkStream(
             ArrayDataset(train_x),
             ArrayDataset(train_y),
             (),
-            chunk_rows=min(self.config.chunk_rows, max(len(train_x), 1)),
+            chunk_rows=min(chunk_rows, max(len(train_x), 1)),
             partition=self.partition,
         )
         state = self._state
@@ -505,9 +638,47 @@ class RefitDaemon:
                 self.config.name, base=decay
             )
         self.applied_decay = decay
+
+        durable = None
+        if self.scheduler is not None and self.store is not None:
+            # Preemption substrate: chunk-boundary checkpoints in the
+            # SAME store the journal lives in. A valid cursor (resume
+            # after deferral) already holds the decayed base plus the
+            # committed prefix — seeding from it and skipping the decay
+            # below is what keeps resume ≡ uninterrupted fold.
+            from ..reliability.durable import arm_durable_fold
+
+            durable, resume_state = arm_durable_fold(
+                stream, self.estimator, self.store,
+                ckpt_every=self.config.sched_ckpt_every,
+            )
+            if resume_state is not None:
+                state = resume_state
+                decay = 1.0
         if state is not None and decay < 1.0:
             state = state.scaled(decay)
-        return self.estimator.fit_stream(stream, state=state)
+        if durable is not None:
+            # seed_rows AFTER decay: StreamState.scaled multiplies
+            # num_examples too, and the cursor's row arithmetic is in
+            # post-decay units.
+            if durable.resume_rows == 0:
+                durable.seed_rows = (
+                    int(state.num_examples) if state is not None else 0
+                )
+            stream.durable = durable
+            stream.lease = lease
+
+        from ..workflow.streaming import last_stream_report
+
+        result = self.estimator.fit_stream(stream, state=state)
+        report = last_stream_report()
+        if (
+            lease is not None
+            and report is not None
+            and report.preempted_at_chunk is not None
+        ):
+            self._last_preempted_chunk = int(report.preempted_at_chunk)
+        return result
 
     def _watch(
         self, ticket, shadow_report, watch_x, watch_y, round_index: int,
@@ -679,12 +850,17 @@ class RefitDaemon:
         except Exception:
             pass  # quality is evidence, not correctness: never fail a round
 
-    def _outcome(self, outcome: str, round_index: int, **detail) -> str:
+    def _outcome(
+        self, outcome: str, round_index: int,
+        keep_journal: bool = False, **detail,
+    ) -> str:
         # The round reached a decision: persist the quality join state,
         # then retire its journal (a no-op when none was written — skips
-        # journal before the fold phase).
+        # journal before the fold phase). A scheduler deferral KEEPS the
+        # journal: it is the parked batch's survival, not a crash relic.
         self._persist_quality()
-        self._clear_journal()
+        if not keep_journal:
+            self._clear_journal()
         # Join lag: labeled rows already in the tap that this round did
         # not reach — the backlog the next round's label join clears.
         _names.metric(_names.QUALITY_JOIN_LAG_ROWS).set(
@@ -720,9 +896,31 @@ class RefitDaemon:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def _next_interval(self) -> float:
+        """Pressure-aware cadence (docs/SCHEDULING.md): unscheduled
+        daemons keep the fixed ``interval_s``; scheduled ones drain
+        sooner as the tap fills toward its drop-oldest bound and back
+        off while the mesh is under SLO pressure."""
+        base = self.config.interval_s
+        if self.scheduler is None:
+            return base
+        from ..sched.scheduler import pressure_aware_interval
+
+        stats = self.tap.stats()
+        fill = min(
+            float(stats.get("labeled_depth", 0))
+            / max(float(stats.get("capacity_rows", 1)), 1.0),
+            1.0,
+        )
+        interval = pressure_aware_interval(
+            base, fill, self.scheduler.pressure()
+        )
+        _names.metric(_names.SCHED_REFIT_INTERVAL_SECONDS).set(interval)
+        return interval
+
     def _loop(self) -> None:
         failures = 0
-        while not self._stop.wait(self.config.interval_s):
+        while not self._stop.wait(self._next_interval()):
             try:
                 self.run_once()
                 failures = 0

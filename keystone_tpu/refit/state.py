@@ -227,12 +227,30 @@ class GramStreamStateMixin:
         return merge_stream_states(a, b)
 
     def finish_from_state(self, state: StreamState):
-        """A fitted transformer from statistics alone (no data pass)."""
+        """A fitted transformer from statistics alone (no data pass).
+
+        The finish is a standalone mesh reduction (the Gram/sketch
+        solve), so it opts into the co-scheduler when one is installed
+        (docs/SCHEDULING.md): admitted into an idle gap it is priced,
+        spanned, and harvested; under pressure the deferral is ledgered
+        but the solve still runs — callers (publish, rollback, boot)
+        need the model synchronously."""
         import jax.numpy as jnp
+
+        from ..sched.scheduler import maybe_lease
 
         self._check_state_kind(state)
         carry = tuple(jnp.asarray(a) for a in state.carry)
-        return self._finish_from_stats(carry, int(state.num_examples))
+        width, classes = (
+            (int(carry[1].shape[0]), int(carry[1].shape[-1]))
+            if len(carry) > 1 and getattr(carry[1], "ndim", 0) >= 1
+            else (0, 0)
+        )
+        with maybe_lease(
+            f"{type(self).__name__}:finish", "finish",
+            rows=int(state.num_examples), width=width, classes=classes,
+        ):
+            return self._finish_from_stats(carry, int(state.num_examples))
 
     # ------------------------------------------------------- fold-side hooks
     def _check_state_kind(self, state: StreamState) -> None:

@@ -349,7 +349,10 @@ class DurableFold:
 # -------------------------------------------------------------------- arming
 
 
-def arm_durable_fold(stream: Any, estimator: Any, store: Any):
+def arm_durable_fold(
+    stream: Any, estimator: Any, store: Any,
+    ckpt_every: Optional[int] = None,
+):
     """Build a stream's durability plan and, when a valid resume entry
     exists, the :class:`StreamState` that seeds the fold.
 
@@ -357,6 +360,12 @@ def arm_durable_fold(stream: Any, estimator: Any, store: Any):
     durability stays off (no store, checkpointing off for this size and
     no entry to resume). Called by ``StreamingFitOperator`` after the
     chunk geometry is final (partition rounding included).
+
+    ``ckpt_every`` overrides the size-based :func:`stream_ckpt_chunks`
+    cadence — the mesh scheduler arms checkpoints on folds far below the
+    auto-arm row threshold because its preemption contract (yield at a
+    chunk boundary, resume from the cursor) needs a committable cursor
+    regardless of fold size (docs/SCHEDULING.md).
 
     Refusal ladder for an existing entry:
 
@@ -379,7 +388,7 @@ def arm_durable_fold(stream: Any, estimator: Any, store: Any):
 
     members = stream.members
     n = stream.num_examples
-    every = stream_ckpt_chunks(n)
+    every = ckpt_every if ckpt_every is not None else stream_ckpt_chunks(n)
     key = resume_key(estimator, members, n)
     entry = load_resume_entry(store, key)
     if every <= 0 and entry is None:

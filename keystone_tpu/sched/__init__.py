@@ -1,0 +1,20 @@
+"""Cost-governed co-scheduling: background work admitted into serving
+idle gaps on one mesh, priced from the PR-14 cost observatory and
+preempted at chunk boundaries through the PR-15 durable-fold substrate
+(docs/SCHEDULING.md)."""
+
+from .pricing import (  # noqa: F401
+    LeasePrice,
+    choose_chunk_rows,
+    gram_stream_facts,
+    price_stream_fold,
+)
+from .scheduler import (  # noqa: F401
+    Lease,
+    LeaseRequest,
+    MeshScheduler,
+    get_scheduler,
+    maybe_lease,
+    pressure_aware_interval,
+    set_scheduler,
+)

@@ -1,0 +1,285 @@
+"""Co-scheduled serving + refit on one mesh: the demo CI drives.
+
+One `PipelineServer` serves a paced, seeded request trace while a
+`RefitDaemon` folds labeled traffic under `MeshScheduler` leases. The
+demo measures the question the scheduler exists to answer — *is
+co-locating background folds inside serving idle gaps cheaper than
+serializing them?* — and stages one deterministic preemption to prove
+the contract:
+
+- **serial phase**: each round serves its trace to completion, THEN
+  runs a full refit round over its rows on an *unscheduled* daemon (the
+  legacy deployment: the mesh context-switches; nothing overlaps). Its
+  final state doubles as the *parity reference*.
+- **co-scheduled phase**: the same traces and the same rows, but the
+  refit round runs as an admitted lease *while* the trace is in flight
+  on a scheduler-governed daemon.
+- **seeded preemption**: in ``pressure_round`` the scheduler's
+  deterministic door (:meth:`MeshScheduler.seed_pressure_after`) turns
+  pressure on after admission — the fold yields at a chunk boundary
+  with its durable cursor committed, the round defers, and the very
+  next round resumes from the cursor and publishes. Zero requests drop
+  throughout, and the final co-scheduled state must match the serial
+  reference to ≤1e-6 (resume ≡ uninterrupted fold).
+
+Everything deterministic in ``seed``; the evidence dict is what
+``scripts/sched_smoke.sh`` and the ``cosched`` bench leg gate on
+(docs/SCHEDULING.md "The demo").
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..reliability.recovery import get_recovery_log
+from .scheduler import MeshScheduler
+
+
+@dataclass
+class CoschedDemoConfig:
+    d: int = 32
+    classes: int = 4
+    rounds: int = 4
+    rows_per_round: int = 8192
+    chunk_rows: int = 1024
+    serve_requests: int = 96        # per round, per phase
+    serve_rps: float = 320.0        # paced — the idle gaps ARE the point
+    pressure_round: int = 2         # seeded mid-fold preemption here
+    settle_round: int = 1           # steady-compile assertions start after
+    slo_target_ms: float = 500.0
+    seed: int = 0
+    reg: float = 1e-2
+    store_dir: Optional[str] = None
+
+
+def run_cosched_demo(config: CoschedDemoConfig) -> Dict[str, Any]:
+    from ..data.dataset import ArrayDataset
+    from ..obs.quality import reset_quality_plane
+    from ..ops.learning.linear import LinearMapEstimator
+    from ..refit.daemon import RefitConfig, RefitDaemon
+    from ..refit.publish import InProcessPublisher
+    from ..refit.shadow import ShadowEvaluator
+    from ..refit.tap import TrafficTap
+    from ..reliability.checkpoint import CheckpointStore
+    from ..serving.config import ServingConfig
+    from ..serving.loadgen import run_load
+    from ..serving.server import PipelineServer
+    from ..workflow.streaming import ChunkStream
+
+    cfg = config
+    reset_quality_plane()
+    rng = np.random.default_rng(cfg.seed)
+    w_true = rng.standard_normal((cfg.d, cfg.classes)).astype(np.float32)
+
+    def make_rows(n: int):
+        x = rng.standard_normal((n, cfg.d)).astype(np.float32)
+        labels = np.argmax(x @ w_true, axis=1)
+        y = np.eye(cfg.classes, dtype=np.float32)[labels]
+        return x, y
+
+    def stream_over(x, y):
+        return ChunkStream(
+            ArrayDataset(x), ArrayDataset(y), (),
+            chunk_rows=min(cfg.chunk_rows, len(x)),
+        )
+
+    # All round data up front: both phases serve and fold the SAME rows.
+    x0, y0 = make_rows(cfg.rows_per_round)
+    rounds_data = [make_rows(cfg.rows_per_round) for _ in range(cfg.rounds)]
+    offsets = [i / cfg.serve_rps for i in range(cfg.serve_requests)]
+
+    store_root = cfg.store_dir or tempfile.mkdtemp(prefix="keystone-cosched-")
+
+    estimator = LinearMapEstimator(reg=cfg.reg)
+    v1_model = estimator.fit_stream(stream_over(x0, y0))
+    v1_state = estimator.export_stream_state()
+
+    tap = TrafficTap(capacity_rows=cfg.rows_per_round * 4, mirror_rows=512)
+    server = PipelineServer(
+        config=ServingConfig(
+            max_batch=8, queue_depth=cfg.serve_requests + 64
+        ),
+        name="cosched",
+        tap=tap,
+    )
+    server.registry.publish("cosched", v1_model, source="fit")
+    # The serial baseline daemon publishes under its own name; serving
+    # stays pinned to the default "cosched" model either way.
+    server.registry.publish("cosched-serial", v1_model, source="fit")
+    server.start()
+    example = np.zeros((cfg.d,), np.float32)
+    server.warmup(example)
+
+    # sustain_checks pinned (not env-read): the seeded preemption lands
+    # at a deterministic chunk boundary on every machine.
+    scheduler = MeshScheduler(store=None, name="cosched", sustain_checks=2)
+
+    def make_daemon(name: str, est, daemon_tap, sched):
+        return RefitDaemon(
+            est,
+            daemon_tap,
+            InProcessPublisher(server, name=name, example=example),
+            store=CheckpointStore(f"{store_root}/{name}"),
+            scheduler=sched,
+            # Wide-open gates: this demo pins scheduling and parity, not
+            # candidate quality (the refit demo owns the gate behaviors).
+            shadow=ShadowEvaluator(margin=0.5),
+            config=RefitConfig(
+                name=name,
+                min_rows=cfg.rows_per_round // 2,
+                chunk_rows=cfg.chunk_rows,
+                watch_margin=0.5,
+                state_decay=1.0,  # pure accumulation → exact parity
+            ),
+            state=v1_state,
+        )
+
+    daemon = make_daemon("cosched", estimator, tap, scheduler)
+    # The serial baseline: identical rounds on the LEGACY, unscheduled
+    # path (scheduler=None — byte-for-byte the pre-scheduler daemon). It
+    # publishes under its own model name, so serving (pinned to the
+    # default "cosched" model) never sees it.
+    serial_est = LinearMapEstimator(reg=cfg.reg)
+    serial_tap = TrafficTap(
+        capacity_rows=cfg.rows_per_round * 4, mirror_rows=512
+    )
+    serial_daemon = make_daemon(
+        "cosched-serial", serial_est, serial_tap, None
+    )
+
+    def serve_round(r: int) -> Dict[str, Any]:
+        x, _y = rounds_data[r - 1]
+        payloads = [row for row in x[: cfg.serve_requests]]
+        report = run_load(
+            server.submit,
+            offsets,
+            payload=lambda i: payloads[i % len(payloads)],
+            deadline_s=60.0,
+            settle_timeout_s=120.0,
+        )
+        return report.summary()
+
+    # ------------------------------------------------------- serial phase
+    # Serve to completion, THEN run the refit round — the mesh
+    # context-switches, nothing overlaps. Identical rows, identical
+    # chunk grid, identical round machinery to the co-scheduled phase.
+    serial_wall = 0.0
+    dropped = 0
+    for r in range(1, cfg.rounds + 1):
+        x, y = rounds_data[r - 1]
+        serial_tap.feed(x, y)
+        t0 = time.perf_counter()
+        load = serve_round(r)
+        serial_daemon.run_once()
+        serial_wall += time.perf_counter() - t0
+        dropped += int(load["dropped"])
+        server.restamp_compile_baseline()
+
+    # ------------------------------------------------- co-scheduled phase
+    cosched_wall = 0.0
+    steady_compiles = 0
+    round_records: List[Dict[str, Any]] = []
+    preempted_at_chunk = None
+    for r in range(1, cfg.rounds + 1):
+        x, y = rounds_data[r - 1]
+        tap.feed(x, y)
+        if r == cfg.pressure_round:
+            # One idle consultation (admission), then pressure: the fold
+            # preempts at the first sustained chunk boundary.
+            scheduler.seed_pressure_after(1)
+        box: Dict[str, Any] = {}
+
+        def load_body() -> None:
+            box["load"] = serve_round(r)
+
+        t0 = time.perf_counter()
+        load_thread = threading.Thread(target=load_body, name="cosched-load")
+        load_thread.start()
+        outcomes = [daemon.run_once()]
+        if r == cfg.pressure_round:
+            preempted_at_chunk = daemon.outcomes[-1].get("preempted_at_chunk")
+            scheduler.seed_pressure_after(None)
+            # Resume INSIDE the same serving window: the deferred fold
+            # picks up from its durable cursor, not from row zero.
+            outcomes.append(daemon.run_once())
+        load_thread.join()
+        cosched_wall += time.perf_counter() - t0
+        load = box["load"]
+        dropped += int(load["dropped"])
+        stats = server.stats()
+        if r > cfg.settle_round:
+            steady_compiles = max(
+                steady_compiles,
+                int(stats.get("xla_compiles_since_warmup") or 0),
+            )
+        server.restamp_compile_baseline()
+        round_records.append(
+            {
+                "round": r,
+                "outcomes": outcomes,
+                "p99_ms": load["p99_ms"],
+                "completed": load["completed"],
+                "dropped": load["dropped"],
+            }
+        )
+    server.stop(drain=True)
+
+    # ------------------------------------------------------------ evidence
+    # Parity: the scheduled chain (including the preempt→resume round)
+    # against the unscheduled serial chain — resume ≡ uninterrupted fold,
+    # and the scheduled path ≡ the legacy path on the same rows.
+    live_model = daemon.estimator.finish_from_state(daemon._state)
+    serial_model = serial_daemon.estimator.finish_from_state(
+        serial_daemon._state
+    )
+    parity = float(
+        np.max(
+            np.abs(
+                np.asarray(live_model.weights, dtype=np.float64)
+                - np.asarray(serial_model.weights, dtype=np.float64)
+            )
+        )
+    )
+
+    sched_stats = scheduler.stats()
+    outcomes_flat = [o for rec in round_records for o in rec["outcomes"]]
+    p99_worst = max(rec["p99_ms"] for rec in round_records)
+    ledger_kinds = sorted(
+        {
+            e.kind
+            for e in get_recovery_log().events()
+            if e.kind.startswith("sched_")
+        }
+    )
+    ratio = cosched_wall / serial_wall if serial_wall else None
+    return {
+        "d": cfg.d,
+        "classes": cfg.classes,
+        "rounds": round_records,
+        "publishes": outcomes_flat.count("published"),
+        "deferred_rounds": outcomes_flat.count("deferred"),
+        "dropped": int(dropped),
+        "compiles_steady_state_post_settle": int(steady_compiles),
+        "serial_wall_s": round(serial_wall, 4),
+        "cosched_wall_s": round(cosched_wall, 4),
+        "cosched_vs_serial_ratio": round(ratio, 4) if ratio else None,
+        "cosched_faster": bool(ratio is not None and ratio < 1.0),
+        "p99_ms_worst": p99_worst,
+        "slo_target_ms": cfg.slo_target_ms,
+        "p99_within_slo": bool(p99_worst < cfg.slo_target_ms),
+        "leases": int(sched_stats["leases"]),
+        "leases_completed": int(sched_stats["outcomes"].get("completed", 0)),
+        "preemptions": int(sched_stats["outcomes"].get("preempted", 0)),
+        "preempted_at_chunk": preempted_at_chunk,
+        "parity_max_abs_diff": parity,
+        "parity_ok": bool(parity <= 1e-6),
+        "idle_harvest_s": sched_stats["idle_harvest_s"],
+        "ledger_kinds": ledger_kinds,
+        "obs": {"schedule": scheduler.schedule()},
+    }

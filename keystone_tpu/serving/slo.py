@@ -88,6 +88,9 @@ class SLOController:
         self._under_since: Optional[float] = None
         self._last_served: Dict[str, int] = {}
         self.transitions = 0
+        #: worst fresh per-worker p99 from the latest observe sweep —
+        #: the mesh scheduler's headroom signal (docs/SCHEDULING.md).
+        self.last_p99_ms: Optional[float] = None
         self._g_p99 = _names.metric(_names.SERVING_SLO_P99_MS)
         self._g_target = _names.metric(_names.SERVING_SLO_TARGET_MS)
         self._g_rung = _names.metric(_names.SERVING_SLO_RUNG)
@@ -115,6 +118,7 @@ class SLOController:
             worst = p99 if worst is None else max(worst, p99)
         if worst is None:
             return None
+        self.last_p99_ms = float(worst)
         self._g_p99.set(float(worst), worker="aggregate")
 
         index = self.admission.rung_index
@@ -155,6 +159,17 @@ class SLOController:
         get_recovery_log().record("slo", self.label, **record)
         return record
 
+    # --------------------------------------------------------------- headroom
+    def headroom(self) -> Optional[float]:
+        """Fraction of the p99 budget currently unspent, clamped to
+        [0, 1]: 1.0 = serving far under target (the mesh is harvestable),
+        0.0 = at/over target. None before the first fresh observation —
+        the scheduler treats an absent signal as idle rather than
+        wedging background work on a mesh nobody measured."""
+        if self.last_p99_ms is None:
+            return None
+        return min(max(1.0 - self.last_p99_ms / self.target_p99_ms, 0.0), 1.0)
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict:
         return {
@@ -162,4 +177,6 @@ class SLOController:
             "rung": self.admission.rungs[self.admission.rung_index].name,
             "rung_index": self.admission.rung_index,
             "transitions": self.transitions,
+            "last_p99_ms": self.last_p99_ms,
+            "headroom": self.headroom(),
         }

@@ -1147,6 +1147,17 @@ class WorkerSupervisor:
                     row[counter] = row.get(counter, 0.0) + value
             return totals
 
+    # ---------------------------------------------------------------- backlog
+    def backlog(self) -> int:
+        """Requests the fleet has accepted but not answered: the pending
+        queue plus every worker's in-flight window. The mesh scheduler's
+        second idle signal (docs/SCHEDULING.md) — p99 headroom says how
+        serving has been doing, backlog says what is about to land."""
+        with self._lock:
+            return len(self._pending) + sum(
+                len(w.inflight) for w in self._workers.values()
+            )
+
     # ------------------------------------------------------------------ stats
     def stats(self) -> Dict[str, Any]:
         """Aggregate across workers (counters summed, p99 worst-case) plus

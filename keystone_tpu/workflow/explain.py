@@ -235,9 +235,79 @@ def _render_human(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def _render_schedule(evidence: Dict[str, Any]) -> str:
+    """The mesh schedule, human-readable: one row per lease — who ran,
+    its outcome, what displaced it, predicted vs measured wall and the
+    price's provenance rung."""
+    lines = [
+        "keystone-tpu explain --schedule  (docs/SCHEDULING.md)",
+        f"  serial wall {evidence['serial_wall_s']:.3f}s vs co-scheduled "
+        f"{evidence['cosched_wall_s']:.3f}s "
+        f"(ratio {evidence['cosched_vs_serial_ratio']}), "
+        f"p99 {evidence['p99_ms_worst']:.1f}ms / "
+        f"target {evidence['slo_target_ms']:.0f}ms, "
+        f"dropped {evidence['dropped']}, "
+        f"idle harvested {evidence['idle_harvest_s']:.3f}s",
+        f"  {'lease':14s} {'work':24s} {'kind':10s} {'outcome':10s} "
+        f"{'rows':>6s} {'price':>9s} {'pred ms':>9s} {'meas ms':>9s} "
+        f"{'ratio':>6s}  displaced by",
+    ]
+    for entry in evidence.get("obs", {}).get("schedule", []):
+        pred = entry.get("predicted_s")
+        meas = entry.get("measured_s")
+        ratio = entry.get("ratio")
+        displaced = entry.get("displaced_by") or "-"
+        if entry.get("preempted_at_chunk") is not None:
+            displaced += f" (preempted at chunk {entry['preempted_at_chunk']})"
+        if entry.get("resume_of"):
+            displaced += f" (resumes {entry['resume_of']})"
+        lines.append(
+            f"  {entry['lease']:14s} {entry['name'][:24]:24s} "
+            f"{entry['kind']:10s} {entry['outcome']:10s} "
+            f"{entry['rows']:>6d} {entry['price'].get('source', '-'):>9s} "
+            f"{(pred * 1e3 if pred is not None else float('nan')):9.3f} "
+            f"{(meas * 1e3 if meas is not None else float('nan')):9.3f} "
+            f"{(ratio if ratio is not None else float('nan')):6.2f}  "
+            f"{displaced}"
+        )
+    lines.append(
+        f"  leases={evidence['leases']} "
+        f"preemptions={evidence['preemptions']} "
+        f"publishes={evidence['publishes']} "
+        f"parity_max_abs_diff={evidence['parity_max_abs_diff']:.2e}"
+    )
+    return "\n".join(lines)
+
+
+def _explain_schedule(args: argparse.Namespace) -> int:
+    """``explain --schedule``: run the co-scheduled demo and print who
+    got the mesh, what was displaced or deferred, and predicted vs
+    measured wall per lease."""
+    from ..sched.demo import CoschedDemoConfig, run_cosched_demo
+
+    evidence = run_cosched_demo(CoschedDemoConfig(seed=args.seed))
+    body = json.dumps(evidence)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body)
+    if args.as_json:
+        print("SCHED_JSON:" + body)
+    else:
+        print(_render_schedule(evidence))
+    ok = (
+        evidence["dropped"] == 0
+        and evidence["parity_ok"]
+        and evidence["p99_within_slo"]
+    )
+    return 0 if ok else 2
+
+
 def explain_from_args(args: argparse.Namespace) -> int:
     from ..obs import cost as _cost
     from ..utils.compilation_cache import install_compile_counter
+
+    if getattr(args, "schedule", False):
+        return _explain_schedule(args)
 
     install_compile_counter()
     override_before = _cost._enabled_override

@@ -152,6 +152,19 @@ class StreamingFallback(Exception):
     those propagate to the reliability layer."""
 
 
+class FoldPreempted(Exception):
+    """Raised inside ``ChunkStream.fold``'s dispatch loop when the armed
+    scheduler lease yields at a chunk boundary (sustained SLO pressure).
+    Caught by the fold itself — it returns normally with the partial
+    prefix carry and ``report.preempted_at_chunk`` set; the durable
+    cursor was committed before the raise, so the deferred fold resumes
+    from the boundary instead of restarting (docs/SCHEDULING.md)."""
+
+    def __init__(self, chunk_index: int):
+        self.chunk_index = int(chunk_index)
+        super().__init__(f"fold preempted at chunk {chunk_index}")
+
+
 # ------------------------------------------------------------- pipelined loop
 
 
@@ -242,6 +255,11 @@ class StreamReport:
     resumed_from_chunk: Optional[int] = None
     reingested_chunks: int = 0
     shard_losses: int = 0
+    #: Scheduler preemption (docs/SCHEDULING.md): the absolute chunk a
+    #: leased fold yielded at under sustained SLO pressure (None = ran
+    #: to completion). The durable cursor committed at this boundary —
+    #: the deferred fold resumes from it instead of restarting.
+    preempted_at_chunk: Optional[int] = None
     #: perf_counter at fold start — the event lists below are offsets
     #: from this, so exporters can place chunk slices on a session
     #: timeline (obs/export.py Perfetto view).
@@ -636,6 +654,12 @@ class ChunkStream:
         #: the streaming operator when a checkpoint store is attached.
         #: None = today's fold, byte for byte.
         self.durable = None
+        #: Mesh-scheduler lease (sched/scheduler.py), armed by scheduled
+        #: callers (the refit daemon under a MeshScheduler): consulted at
+        #: every chunk boundary; sustained SLO pressure preempts the fold
+        #: there, committing the durable cursor first. None = unscheduled
+        #: fold, byte for byte.
+        self.lease = None
 
     def feature_aval(self):
         """Shape/dtype of one FEATURIZED chunk (shape-only trace of the
@@ -692,6 +716,7 @@ class ChunkStream:
             # (same mesh, replicated over model) when any fail.
             part = self._validate_model_axis(part, step_fn, carry)
         durable = self.durable
+        lease = self.lease
         sharding = None
         # Shard-loss recovery must be able to re-add the fold's seed when
         # the device holding carry block 0 dies: keep the PRE-STACK device
@@ -883,6 +908,26 @@ class ChunkStream:
             nonlocal carry, dispatched, rows_folded, last_committed
             x_dev, y_dev, mask_dev, _rows = staged_chunk
             if (
+                lease is not None
+                and dispatched > 0
+                and lease.should_yield()
+            ):
+                # Preempt-at-chunk-boundary: commit the durable cursor
+                # FIRST (the preemption contract — a deferred fold must
+                # resume from here, not restart), then unwind. The
+                # prefix carry stays valid statistics; the caller reads
+                # report.preempted_at_chunk and re-leases later.
+                if (
+                    durable is not None
+                    and not ckpt_suspended
+                    and dispatched != last_committed
+                ):
+                    last_committed = dispatched
+                    commit_checkpoint()
+                report.preempted_at_chunk = start_chunk + dispatched
+                lease.mark_preempted(start_chunk + dispatched)
+                raise FoldPreempted(start_chunk + dispatched)
+            if (
                 durable is not None
                 and durable.ckpt_every > 0
                 and not ckpt_suspended
@@ -989,6 +1034,11 @@ class ChunkStream:
                         dispatched = 0
                         ckpt_suspended = True
                         continue
+                    except FoldPreempted:
+                        # Graceful yield: fall through to the finish
+                        # merge with the prefix carry — the cursor is
+                        # already committed, the report already marked.
+                        pass
                     finally:
                         queue.close()
                         queue_stall_s += queue.stall_s
@@ -1072,9 +1122,10 @@ class ChunkStream:
             )
             _publish_report(report)
 
-        if durable is not None:
+        if durable is not None and report.preempted_at_chunk is None:
             # The fit completed: a resume entry pointing into its middle
-            # must not outlive it.
+            # must not outlive it. A PREEMPTED fold is the opposite case
+            # — its cursor IS the resume point the next lease needs.
             durable.complete()
 
         # A COMPLETED fold is a knob observation: remember what this
@@ -1085,12 +1136,14 @@ class ChunkStream:
         if (
             report.chunks == len(windows)
             and report.resumed_from_chunk is None
+            and report.preempted_at_chunk is None
             and not report.shard_losses
         ):
             self._record_observation(report, data_shape)
         if (
             report.compute_done_t
             and report.resumed_from_chunk is None
+            and report.preempted_at_chunk is None
             and not report.shard_losses
         ):
             # Achieved throughput to the enclosing harvest frame: a
@@ -1099,16 +1152,23 @@ class ChunkStream:
             # Resumed/recovered folds measured recovery, not steady
             # state — feeding suffix-only walls against full-dataset
             # rows would inflate rows/s and mis-score the drift
-            # sentinel (same guard as _record_observation).
+            # sentinel (same guard as _record_observation). A
+            # scheduler-PREEMPTED fold is the mirror image — a partial
+            # wall against full num_examples would inflate the same way
+            # (the PR-15 suffix-wall guard extended to deferrals).
             wall = max(report.compute_done_t[-1], 1e-9)
             _cost.note_stream_result(report.num_examples / wall, n)
 
+        resume_rows = durable.resume_rows if durable is not None else 0
         info = {
             # Rows THIS fold absorbed: a resumed fold re-ingests only the
             # suffix past the cursor — the cursor's rows already live in
             # the seeding state, and estimators add state.num_examples.
-            "num_examples": n - (
-                durable.resume_rows if durable is not None else 0
+            # A preempted fold absorbed only the dispatched prefix.
+            "num_examples": (
+                rows_folded - resume_rows
+                if report.preempted_at_chunk is not None
+                else n - resume_rows
             ),
             "chunks": report.chunks,
             "report": report,

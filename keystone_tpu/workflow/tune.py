@@ -270,7 +270,28 @@ class Tuner:
         def run(cand: Dict[str, Any], proposed_by: str) -> Optional[Measurement]:
             seen.add(key(cand))
             try:
-                result = measure(cand)
+                # Each probe is mesh time stolen from serving: under a
+                # process scheduler it runs as a cost-tagged lease — a
+                # pressured mesh defers the probe (skipping a candidate
+                # costs accuracy of the tune, not correctness), an idle
+                # one admits it (docs/SCHEDULING.md).
+                from ..sched.scheduler import LeaseRequest, get_scheduler
+
+                scheduler = get_scheduler()
+                if scheduler is None:
+                    result = measure(cand)
+                else:
+                    with scheduler.lease(
+                        LeaseRequest(
+                            name=f"tune:{space.name}", kind="tune_probe"
+                        )
+                    ) as probe_lease:
+                        if probe_lease is None:  # deferred: skip candidate
+                            _spans.add_span_event(
+                                "tune_candidate_deferred", task=space.name
+                            )
+                            return None
+                        result = measure(cand)
             except Exception as e:
                 logger.warning(
                     "tune[%s]: candidate %s failed (%s)", space.name, cand, e

@@ -1,0 +1,72 @@
+"""Pressure-aware refit cadence (satellite of the co-scheduler PR): the
+fixed daemon sleep becomes backlog/pressure-driven. The interval law is
+pure in its inputs, so these tests are fully deterministic — no clocks,
+no threads, no sleeping."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.refit.daemon import RefitConfig, RefitDaemon
+from keystone_tpu.refit.tap import TrafficTap
+from keystone_tpu.sched.scheduler import MeshScheduler, pressure_aware_interval
+
+pytestmark = pytest.mark.sched
+
+BASE = 30.0
+
+
+def test_interval_law_shape():
+    # Empty tap, idle mesh: the configured cadence stands.
+    assert pressure_aware_interval(BASE, 0.0, False) == BASE
+    # Filling tap drains sooner, down to base/8 at the drop-oldest bound.
+    assert pressure_aware_interval(BASE, 0.5, False) == BASE / 2
+    assert pressure_aware_interval(BASE, 1.0, False) == BASE / 8
+    # SLO pressure backs off — serving owns the mesh right now…
+    assert pressure_aware_interval(BASE, 0.0, True) == BASE * 2
+    # …even when the tap is nearly full: pressure wins the argument.
+    assert pressure_aware_interval(BASE, 0.95, True) == BASE * 2
+    # Explicit clamps bound both directions.
+    assert pressure_aware_interval(BASE, 0.0, True, max_s=45.0) == 45.0
+    assert pressure_aware_interval(BASE, 0.999, False, min_s=5.0) == 5.0
+    # Out-of-range fill fractions are clamped, not trusted.
+    assert pressure_aware_interval(BASE, -1.0, False) == BASE
+    assert pressure_aware_interval(BASE, 7.0, False) == BASE / 8
+
+
+def test_interval_monotone_in_fill():
+    prev = None
+    for fill in (0.0, 0.25, 0.5, 0.75, 1.0):
+        cur = pressure_aware_interval(BASE, fill, False)
+        assert prev is None or cur <= prev
+        prev = cur
+
+
+def _daemon(tap, scheduler):
+    return RefitDaemon(
+        estimator=None,
+        tap=tap,
+        publisher=None,
+        scheduler=scheduler,
+        config=RefitConfig(name="cadence", interval_s=BASE),
+    )
+
+
+def test_next_interval_unscheduled_keeps_fixed_sleep():
+    tap = TrafficTap(capacity_rows=1024)
+    tap.feed(np.zeros((1024, 4), np.float32), np.zeros((1024,), np.float32))
+    # Even a full tap: an unscheduled daemon is byte-for-byte the old
+    # fixed-cadence loop.
+    assert _daemon(tap, None)._next_interval() == BASE
+
+
+def test_next_interval_tracks_tap_fill_and_pressure():
+    tap = TrafficTap(capacity_rows=1024)
+    scheduler = MeshScheduler(name="cadence")
+    daemon = _daemon(tap, scheduler)
+    assert daemon._next_interval() == BASE  # empty tap, idle mesh
+    tap.feed(np.zeros((512, 4), np.float32), np.zeros((512,), np.float32))
+    assert daemon._next_interval() == BASE / 2  # half-full: drain sooner
+    tap.feed(np.zeros((512, 4), np.float32), np.zeros((512,), np.float32))
+    assert daemon._next_interval() == BASE / 8  # at the drop-oldest bound
+    scheduler.force_pressure(True)
+    assert daemon._next_interval() == BASE * 2  # pressure: back off

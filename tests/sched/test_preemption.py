@@ -1,0 +1,207 @@
+"""Preemption correctness: seeded SLO pressure mid-fold preempts at a
+chunk boundary with the durable cursor committed, the deferred round
+resumes from the cursor to exact parity with an uninterrupted fold, and
+a preempted round feeds NO partial-wall evidence into the profile store
+or the cost drift sentinel (the PR-15 suffix-wall guard extended to
+scheduler deferrals)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.dataset import ArrayDataset
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.refit.daemon import RefitConfig, RefitDaemon
+from keystone_tpu.refit.publish import InProcessPublisher
+from keystone_tpu.refit.shadow import ShadowEvaluator
+from keystone_tpu.refit.tap import TrafficTap
+from keystone_tpu.reliability.checkpoint import CheckpointStore
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.sched.scheduler import MeshScheduler
+from keystone_tpu.serving.config import ServingConfig
+from keystone_tpu.serving.server import PipelineServer
+from keystone_tpu.workflow.streaming import ChunkStream
+
+pytestmark = pytest.mark.sched
+
+D, K = 8, 3
+RNG = np.random.default_rng(11)
+W_TRUE = RNG.normal(size=(D, K)).astype(np.float32)
+
+
+def _rows(n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, D)).astype(np.float32)
+    return x, (x @ W_TRUE).astype(np.float32)
+
+
+def _stream(x, y, chunk_rows=64):
+    return ChunkStream(
+        ArrayDataset(x), ArrayDataset(y), (), chunk_rows=chunk_rows
+    )
+
+
+def _pair(tmp_path):
+    """A scheduler-governed daemon and an unscheduled control daemon
+    publishing into one live server — same seed state, same rounds."""
+    x0, y0 = _rows(512, seed=0)
+    est = LinearMapEstimator(reg=1e-3)
+    model = est.fit_stream(_stream(x0, y0))
+    v1 = est.export_stream_state()
+    server = PipelineServer(
+        model=model, config=ServingConfig(max_batch=4, queue_depth=64), name="m"
+    )
+    server.registry.publish("m-ctrl", model, source="fit")
+    server.start()
+    server.warmup(np.zeros((D,), np.float32))
+
+    scheduler = MeshScheduler(name="m", sustain_checks=2)
+
+    def daemon(name, estimator, tap, sched, subdir):
+        return RefitDaemon(
+            estimator,
+            tap,
+            InProcessPublisher(
+                server, name=name, example=np.zeros((D,), np.float32)
+            ),
+            store=CheckpointStore(str(tmp_path / subdir)),
+            scheduler=sched,
+            shadow=ShadowEvaluator(margin=0.5),
+            config=RefitConfig(
+                name=name,
+                min_rows=128,
+                chunk_rows=64,
+                watch_margin=0.5,
+                state_decay=1.0,
+            ),
+            state=v1,
+        )
+
+    tap = TrafficTap(capacity_rows=4096)
+    ctrl_tap = TrafficTap(capacity_rows=4096)
+    sched_daemon = daemon("m", LinearMapEstimator(reg=1e-3), tap, scheduler, "s")
+    ctrl_daemon = daemon(
+        "m-ctrl", LinearMapEstimator(reg=1e-3), ctrl_tap, None, "c"
+    )
+    return server, scheduler, (sched_daemon, tap), (ctrl_daemon, ctrl_tap)
+
+
+def _sched_events(kind, label):
+    return [e for e in get_recovery_log().events(kind) if e.label == label]
+
+
+def test_seeded_preemption_resumes_to_parity(tmp_path):
+    server, scheduler, (daemon, tap), (ctrl, ctrl_tap) = _pair(tmp_path)
+    try:
+        x, y = _rows(512, seed=1)
+        tap.feed(x, y)
+        ctrl_tap.feed(x, y)
+
+        # One idle consultation (admission), then sustained pressure:
+        # 512 rows − 128 eval = 384 train rows = 6 chunks of 64; with
+        # sustain_checks=2 the fold yields at the 2nd chunk boundary.
+        scheduler.seed_pressure_after(1)
+        assert daemon.run_once() == "deferred"
+        record = daemon.outcomes[-1]
+        assert record["preempted_at_chunk"] == 2
+        preempts = _sched_events("sched_preempt", "m:round-1")
+        assert preempts and preempts[-1].detail["chunk_index"] == 2
+
+        # The round journal is parked, not cleared: the next round must
+        # find the drained rows and the cursor, not re-drain the tap.
+        assert tap.stats()["labeled_depth"] == 0
+
+        scheduler.seed_pressure_after(None)
+        assert daemon.run_once() == "published"
+        resumes = _sched_events("sched_resume", "m:round-2")
+        assert resumes and resumes[-1].detail["resume_of"]
+
+        # Parity: preempt→resume ≡ the uninterrupted control fold.
+        assert ctrl.run_once() == "published"
+        got = np.asarray(
+            daemon.estimator.finish_from_state(daemon._state).weights,
+            dtype=np.float64,
+        )
+        want = np.asarray(
+            ctrl.estimator.finish_from_state(ctrl._state).weights,
+            dtype=np.float64,
+        )
+        assert float(np.max(np.abs(got - want))) <= 1e-6
+
+        outcomes = scheduler.stats()["outcomes"]
+        assert outcomes.get("preempted") == 1
+        assert outcomes.get("completed") == 1
+    finally:
+        server.stop(drain=True)
+
+
+def test_preempted_round_feeds_no_observations(tmp_path, monkeypatch):
+    """Satellite regression: a fold preempted at a chunk boundary ran a
+    partial round — its wall must reach neither the profile store's
+    chunk-winner observations nor the cost drift sentinel's rows/s
+    stream (partial rows over partial wall would mis-score both)."""
+    server, scheduler, (daemon, tap), _ = _pair(tmp_path)
+    try:
+        import keystone_tpu.obs.cost as cost
+
+        calls = []
+        monkeypatch.setattr(
+            ChunkStream,
+            "_record_observation",
+            lambda self, report, shape: calls.append("store"),
+        )
+        real_note = cost.note_stream_result
+        monkeypatch.setattr(
+            cost,
+            "note_stream_result",
+            lambda *a, **k: calls.append("cost"),
+        )
+
+        x, y = _rows(512, seed=2)
+        tap.feed(x, y)
+        scheduler.seed_pressure_after(1)
+        assert daemon.run_once() == "deferred"
+        assert calls == []  # preempted: no evidence recorded
+
+        scheduler.seed_pressure_after(None)
+        assert daemon.run_once() == "published"
+        # The RESUMED fold measured recovery, not steady state — the
+        # original suffix-wall guard still holds on the resume leg.
+        assert calls == []
+
+        tap.feed(*_rows(512, seed=3))
+        assert daemon.run_once() == "published"
+        assert "cost" in calls  # a clean round records evidence again
+        monkeypatch.setattr(cost, "note_stream_result", real_note)
+    finally:
+        server.stop(drain=True)
+
+
+def test_cosched_demo_contract():
+    """The demo the smoke script and bench leg gate on, at test scale:
+    zero dropped requests under load, exactly one seeded preemption at
+    a chunk boundary, resume parity, and the sched_* ledger trail."""
+    from keystone_tpu.sched.demo import CoschedDemoConfig, run_cosched_demo
+
+    evidence = run_cosched_demo(
+        CoschedDemoConfig(
+            d=D,
+            classes=K,
+            rounds=3,
+            rows_per_round=2048,
+            chunk_rows=256,
+            serve_requests=32,
+            serve_rps=400.0,
+            pressure_round=2,
+            slo_target_ms=5000.0,
+            seed=0,
+        )
+    )
+    assert evidence["dropped"] == 0
+    assert evidence["preemptions"] == 1
+    assert evidence["preempted_at_chunk"] is not None
+    assert "sched_preempt" in evidence["ledger_kinds"]
+    assert "sched_resume" in evidence["ledger_kinds"]
+    assert evidence["parity_ok"], evidence["parity_max_abs_diff"]
+    assert evidence["publishes"] >= 2
+    assert evidence["deferred_rounds"] == 1
+    assert evidence["leases"] == evidence["publishes"] + 1

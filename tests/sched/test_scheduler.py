@@ -1,0 +1,314 @@
+"""MeshScheduler unit behaviors: the pricing provenance ladder,
+admission vs deferral, sustained chunk-boundary preemption, the
+deterministic pressure doors, and roofline chunk placement."""
+
+import types
+
+import pytest
+
+from keystone_tpu.reliability.recovery import get_recovery_log
+from keystone_tpu.sched import pricing
+from keystone_tpu.sched.pricing import (
+    LeasePrice,
+    choose_chunk_rows,
+    gram_stream_facts,
+    price_stream_fold,
+)
+from keystone_tpu.sched.scheduler import (
+    LeaseRequest,
+    MeshScheduler,
+    get_scheduler,
+    maybe_lease,
+    set_scheduler,
+)
+
+pytestmark = pytest.mark.sched
+
+
+class _Store:
+    """Minimal ProfileStore face: just ``entries(key_prefix=...)``."""
+
+    def __init__(self, entries):
+        self._entries = entries
+
+    def entries(self, key_prefix=""):
+        return [e for e in self._entries if e[0].startswith(key_prefix)]
+
+
+_TUNED = _Store(
+    [
+        (
+            "stream:():cr2048",
+            "2048x8",
+            {
+                "rows_per_s": 1_000_000.0,
+                "source": "tune",
+                "chunk_rows": 2048,
+                "prefetch_depth": 3,
+            },
+        ),
+        # A worse merely-observed rate: the best rate must win.
+        (
+            "stream:():cr512",
+            "512x8",
+            {"rows_per_s": 250_000.0, "chunk_rows": 512},
+        ),
+    ]
+)
+
+
+class _SLO:
+    def __init__(self, rung=0, headroom=None):
+        self.admission = types.SimpleNamespace(rung_index=rung)
+        self._h = headroom
+
+    def headroom(self):
+        return self._h
+
+
+def _sched_events(kind, label):
+    return [e for e in get_recovery_log().events(kind) if e.label == label]
+
+
+# ------------------------------------------------------------------- pricing
+
+
+def test_gram_stream_facts_formula():
+    flops, by = gram_stream_facts(100, 8, 3)
+    assert flops == 100 * (2 * 64 + 2 * 24)
+    assert by == 4 * 100 * 11 + 8 * (64 + 24)
+
+
+def test_price_ladder_measured_beats_models():
+    price = price_stream_fold(500_000, 8, 3, store=_TUNED)
+    assert price.source == "tune"
+    assert price.rows_per_s == 1_000_000.0
+    assert price.seconds == pytest.approx(0.5)
+
+
+def test_price_ladder_default_closes(monkeypatch):
+    import keystone_tpu.obs.cost as cost
+
+    monkeypatch.setattr(cost, "get_roofline", lambda: None)
+    monkeypatch.setenv("KEYSTONE_SCHED_DEFAULT_ROWS_PER_S", "100000")
+    price = price_stream_fold(200_000, 8, 3, store=None)
+    assert price.source == "default"
+    assert price.seconds == pytest.approx(2.0)
+
+
+def test_choose_chunk_rows_tuned_entry_wins():
+    assert choose_chunk_rows(1 << 20, 8, 3, store=_TUNED) == (
+        2048,
+        3,
+        "tune",
+    )
+
+
+def test_choose_chunk_rows_memory_bound_grows(monkeypatch):
+    width, classes = 31, 1  # per-row staged bytes = 4*(31+1) = 128
+    monkeypatch.setattr(
+        pricing,
+        "price_stream_fold",
+        lambda *a, **k: LeasePrice(
+            seconds=1e-3, source="roofline", roofline="memory-bound"
+        ),
+    )
+    # Budget sized so the cap lands exactly on 16384 rows across the
+    # 5-deep staged pipeline (prefetch 4 + 1 in flight).
+    monkeypatch.setenv(
+        "KEYSTONE_SCHED_RESIDENCY_BYTES", str(128 * 5 * 16384)
+    )
+    assert choose_chunk_rows(1 << 20, width, classes) == (
+        16384,
+        4,
+        "roofline",
+    )
+
+
+def test_choose_chunk_rows_compute_bound_keeps_default(monkeypatch):
+    monkeypatch.setattr(
+        pricing,
+        "price_stream_fold",
+        lambda *a, **k: LeasePrice(
+            seconds=1e-3, source="roofline", roofline="compute-bound"
+        ),
+    )
+    assert choose_chunk_rows(1 << 20, 8, 3) == (4096, 2, "roofline")
+    # Always bounded by the dataset.
+    assert choose_chunk_rows(100, 8, 3)[0] == 100
+
+
+# ----------------------------------------------------------------- admission
+
+
+def test_idle_mesh_admits_and_completes():
+    sched = MeshScheduler(name="t1")
+    lease = sched.submit(LeaseRequest(name="t1:a", rows=64, width=4, classes=2))
+    assert lease.admitted and lease.state == "running"
+    assert _sched_events("sched_admit", "t1:a")
+    sched.release(lease)
+    assert lease.state == "completed"
+    stats = sched.stats()
+    assert stats["leases"] == 1
+    assert stats["outcomes"] == {"completed": 1}
+    assert stats["idle_harvest_s"] >= 0.0
+    assert sched.schedule()[0]["outcome"] == "completed"
+
+
+def test_pressure_defers_without_wait_budget():
+    sched = MeshScheduler(name="t2")
+    sched.force_pressure(True)
+    lease = sched.submit(LeaseRequest(name="t2:a", rows=64))
+    assert not lease.admitted and lease.state == "deferred"
+    assert lease.deferrals >= 1
+    assert "forced pressure" in lease.displaced_by
+    assert _sched_events("sched_defer", "t2:a")
+    # The contextmanager face yields None for a deferred lease.
+    with sched.lease(LeaseRequest(name="t2:b")) as handle:
+        assert handle is None
+
+
+def test_deferred_submit_admits_when_pressure_clears():
+    consults = []
+
+    def backlog():
+        consults.append(1)
+        return 99 if len(consults) <= 1 else 0
+
+    sched = MeshScheduler(backlog_fn=backlog, name="t3", backlog_limit=8)
+    lease = sched.submit(
+        LeaseRequest(name="t3:a", rows=64), wait_s=10.0, poll_s=0.001
+    )
+    assert lease.admitted and lease.deferrals >= 1
+    assert _sched_events("sched_defer", "t3:a")
+    assert _sched_events("sched_admit", "t3:a")
+    sched.release(lease)
+
+
+def test_pressure_ladder_signals():
+    assert MeshScheduler(slo=_SLO(rung=2)).pressure_reason() == (
+        "serving-slo rung_index=2"
+    )
+    low = MeshScheduler(slo=_SLO(headroom=0.1)).pressure_reason()
+    assert low is not None and "headroom" in low
+    assert MeshScheduler(slo=_SLO(headroom=0.9)).pressure_reason() is None
+    backlog = MeshScheduler(backlog_fn=lambda: 99).pressure_reason()
+    assert backlog is not None and "backlog" in backlog
+    assert MeshScheduler(backlog_fn=lambda: 3).pressure_reason() is None
+    # No signals at all degrades to always-admit, never wedged.
+    assert MeshScheduler().pressure_reason() is None
+
+
+def test_seed_pressure_after_counts_consultations():
+    sched = MeshScheduler(name="t4")
+    sched.seed_pressure_after(2)
+    assert sched.pressure_reason() is None
+    assert sched.pressure_reason() is None
+    assert sched.pressure_reason() == "seeded pressure (mid-fold)"
+    assert sched.pressure_reason() is not None  # stays pressured
+    sched.seed_pressure_after(None)
+    assert sched.pressure_reason() is None
+
+
+# ---------------------------------------------------------------- preemption
+
+
+def test_should_yield_requires_sustained_pressure():
+    sched = MeshScheduler(name="t5", sustain_checks=2)
+    lease = sched.submit(LeaseRequest(name="t5:a", rows=64))
+    sched.force_pressure(True)
+    assert not lease.should_yield()  # streak 1 of 2
+    assert lease.should_yield()  # sustained
+    assert "forced pressure" in lease.displaced_by
+
+
+def test_pressure_streak_resets_on_idle_boundary():
+    sched = MeshScheduler(name="t6", sustain_checks=2)
+    lease = sched.submit(LeaseRequest(name="t6:a", rows=64))
+    sched.force_pressure(True)
+    assert not lease.should_yield()
+    sched.force_pressure(None)
+    assert not lease.should_yield()  # idle boundary clears the streak
+    sched.force_pressure(True)
+    assert not lease.should_yield()  # streak restarts at 1
+    assert lease.should_yield()
+
+
+def test_preempted_release_ledgers_chunk_index():
+    sched = MeshScheduler(name="t7")
+    lease = sched.submit(LeaseRequest(name="t7:a", rows=64))
+    lease.displaced_by = "test pressure"
+    lease.mark_preempted(3)
+    sched.release(lease)
+    events = _sched_events("sched_preempt", "t7:a")
+    assert events and events[-1].detail["chunk_index"] == 3
+    assert sched.stats()["outcomes"] == {"preempted": 1}
+
+
+def test_resume_lease_ledgers_sched_resume():
+    sched = MeshScheduler(name="t8")
+    lease = sched.submit(
+        LeaseRequest(name="t8:a", rows=64, resume_of="t8-1")
+    )
+    assert lease.admitted
+    events = _sched_events("sched_resume", "t8:a")
+    assert events and events[-1].detail["resume_of"] == "t8-1"
+    assert not _sched_events("sched_admit", "t8:a")
+
+
+# ------------------------------------------------------------- global handle
+
+
+def test_finish_reduction_opts_into_installed_scheduler():
+    import numpy as np
+
+    from keystone_tpu.data.dataset import ArrayDataset
+    from keystone_tpu.ops.learning.linear import LinearMapEstimator
+    from keystone_tpu.workflow.streaming import ChunkStream
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    y = rng.normal(size=(64, 2)).astype(np.float32)
+    est = LinearMapEstimator(reg=1e-3)
+    est.fit_stream(
+        ChunkStream(ArrayDataset(x), ArrayDataset(y), (), chunk_rows=32)
+    )
+    state = est.export_stream_state()
+
+    sched = MeshScheduler(name="t10")
+    set_scheduler(sched)
+    try:
+        est.finish_from_state(state)
+    finally:
+        set_scheduler(None)
+    log = sched.schedule()
+    assert len(log) == 1
+    assert log[0]["kind"] == "finish"
+    assert log[0]["outcome"] == "completed"
+    assert log[0]["rows"] == 64
+
+    # Under pressure the solve still runs (callers need the model
+    # synchronously) — the deferral is just ledgered.
+    sched.force_pressure(True)
+    set_scheduler(sched)
+    try:
+        model = est.finish_from_state(state)
+    finally:
+        set_scheduler(None)
+    assert model is not None
+    assert sched.schedule()[-1]["outcome"] == "deferred"
+
+
+def test_global_handle_and_env_kill_switch(monkeypatch):
+    sched = MeshScheduler(name="t9")
+    set_scheduler(sched)
+    try:
+        assert get_scheduler() is sched
+        with maybe_lease("t9:a", "tune_probe") as handle:
+            assert handle is not None and handle.admitted
+        monkeypatch.setenv("KEYSTONE_SCHED", "0")
+        assert get_scheduler() is None
+        with maybe_lease("t9:b", "tune_probe") as handle:
+            assert handle is None  # unscheduled no-op path
+    finally:
+        set_scheduler(None)
